@@ -1,0 +1,259 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas/pjit.
+
+The public surface mirrors `import paddle` (reference: python/paddle/__init__.py):
+Tensor + functional ops at top level, nn/optimizer/amp/io/jit/distributed/...
+as submodules. The implementation is brand-new and TPU-first — see SURVEY.md.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+from .base import dtype as _dtype_mod
+from .base import global_state as _gs
+from .base.flags import define_flag as _define_flag, get_flag as _get_flag
+
+# Reference semantics: fp32 matmul is true fp32 (cuBLAS). XLA's default on
+# TPU decomposes fp32 matmuls into fewer bf16 passes; "highest" restores full
+# precision. The perf path is bf16/AMP anyway (FLAGS_matmul_precision to tune).
+_define_flag("matmul_precision", "highest", "default|high|highest for fp32 matmuls")
+_jax.config.update("jax_default_matmul_precision", _get_flag("matmul_precision"))
+from .base.dtype import (  # noqa: F401
+    bfloat16,
+    bool_ as bool,  # noqa: A001
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    finfo,
+    iinfo,
+)
+from .base.flags import get_flags, set_flags  # noqa: F401
+from .core.tensor import Parameter, Tensor  # noqa: F401
+
+dtype = _dtype_mod.DType
+
+# ---- functional namespaces -------------------------------------------------
+from .ops.creation import (  # noqa: F401
+    arange,
+    assign,
+    clone,
+    complex,  # noqa: A001
+    diag,
+    diag_embed,
+    diagflat,
+    empty,
+    empty_like,
+    eye,
+    full,
+    full_like,
+    linspace,
+    logspace,
+    meshgrid,
+    one_hot,
+    ones,
+    ones_like,
+    to_tensor,
+    tril,
+    tril_indices,
+    triu,
+    triu_indices,
+    zeros,
+    zeros_like,
+)
+from .ops.math import *  # noqa: F401,F403
+from .ops.math import abs, all, any, max, min, pow, round, sum  # noqa: F401,A001
+from .ops.manipulation import (  # noqa: F401
+    as_complex,
+    as_real,
+    broadcast_shape,
+    broadcast_tensors,
+    broadcast_to,
+    cast,
+    chunk,
+    concat,
+    crop,
+    expand,
+    expand_as,
+    flatten,
+    flip,
+    gather,
+    gather_nd,
+    index_add,
+    index_fill,
+    index_put,
+    index_sample,
+    index_select,
+    masked_fill,
+    masked_scatter,
+    masked_select,
+    moveaxis,
+    numel,
+    pad,
+    put_along_axis,
+    repeat_interleave,
+    reshape,
+    reshape_,
+    roll,
+    rot90,
+    scatter,
+    scatter_,
+    scatter_nd,
+    scatter_nd_add,
+    shard_index,
+    slice,  # noqa: A001
+    split,
+    squeeze,
+    squeeze_,
+    stack,
+    strided_slice,
+    swapaxes,
+    swapdims,
+    take_along_axis,
+    tensordot,
+    tile,
+    tolist,
+    transpose,
+    unbind,
+    unique,
+    unique_consecutive,
+    unsqueeze,
+    unsqueeze_,
+    view,
+    where,
+)
+from .ops.logic import (  # noqa: F401
+    allclose,
+    bitwise_and,
+    bitwise_left_shift,
+    bitwise_not,
+    bitwise_or,
+    bitwise_right_shift,
+    bitwise_xor,
+    equal,
+    equal_all,
+    greater_equal,
+    greater_than,
+    is_empty,
+    is_tensor,
+    isclose,
+    isin,
+    less_equal,
+    less_than,
+    logical_and,
+    logical_not,
+    logical_or,
+    logical_xor,
+    not_equal,
+)
+from .ops.search import (  # noqa: F401
+    argmax,
+    argmin,
+    argsort,
+    bucketize,
+    kthvalue,
+    mode,
+    nonzero,
+    searchsorted,
+    sort,
+    topk,
+)
+from .ops.stat import median, nanmedian, nanquantile, quantile, std, var  # noqa: F401
+from .ops.linalg import (  # noqa: F401
+    cdist,
+    cholesky,
+    cholesky_solve,
+    corrcoef,
+    cov,
+    det,
+    dist,
+    eig,
+    eigh,
+    eigvals,
+    eigvalsh,
+    householder_product,
+    inv,
+    lstsq,
+    lu,
+    matrix_power,
+    matrix_rank,
+    multi_dot,
+    norm,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    t,
+    triangular_solve,
+)
+from .ops.random import (  # noqa: F401
+    bernoulli,
+    binomial,
+    multinomial,
+    normal,
+    poisson,
+    rand,
+    randint,
+    randint_like,
+    randn,
+    randperm,
+    standard_normal,
+    uniform,
+)
+from .ops.einsum_ops import einsum  # noqa: F401
+
+# cross / histogram live in linalg/math in paddle; re-exported above via linalg
+from .ops.math import cross, histogram, bincount  # noqa: F401,F811
+
+# ---- grad / framework state -----------------------------------------------
+from .core import autograd as _autograd_mod
+
+grad = _autograd_mod.grad
+no_grad = _gs.no_grad_guard
+enable_grad = _gs.enable_grad_guard
+set_grad_enabled = _gs.set_grad_enabled
+is_grad_enabled = _gs.grad_enabled
+seed = _gs.seed
+
+
+def get_default_dtype():
+    return _gs.default_dtype
+
+
+def set_default_dtype(d):
+    _gs.default_dtype = _dtype_mod.convert_dtype(d).name
+
+
+def in_dynamic_mode():
+    return True
+
+
+def in_dygraph_mode():
+    return True
+
+
+# ---- submodules ------------------------------------------------------------
+from . import device  # noqa: F401,E402
+
+set_device = device.set_device
+get_device = device.get_device
+
+from . import autograd  # noqa: F401,E402
+from .version import __version__  # noqa: F401,E402
+
+# Further submodules (nn, optimizer, amp, io, jit, metric, vision, hapi,
+# distributed, framework.io save/load) are imported at the bottom of this file
+# as they are part of the package; see _late_imports.
+from . import _late_imports  # noqa: F401,E402
+from ._late_imports import *  # noqa: F401,F403,E402
+
+CPUPlace = lambda: "cpu"  # noqa: E731 — place compat shims
+TPUPlace = lambda idx=0: f"tpu:{idx}"  # noqa: E731
+CUDAPlace = lambda idx=0: f"tpu:{idx}"  # noqa: E731 — CUDA maps to the accelerator

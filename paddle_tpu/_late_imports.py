@@ -1,0 +1,157 @@
+"""Second-stage package imports.
+
+Submodules that depend on the core (nn, optimizer, ...) are imported here so
+paddle_tpu/__init__.py stays importable while the package is built out layer
+by layer. Names listed in __all__ are re-exported at top level.
+"""
+from __future__ import annotations
+
+__all__ = []
+
+try:
+    from .nn.layer.layers import Layer  # noqa: F401
+
+    __all__.append("Layer")
+except ImportError:
+    pass
+
+try:
+    from . import nn  # noqa: F401
+
+    __all__.append("nn")
+except ImportError:
+    pass
+
+try:
+    from . import optimizer  # noqa: F401
+
+    __all__.append("optimizer")
+except ImportError:
+    pass
+
+try:
+    from . import amp  # noqa: F401
+
+    __all__.append("amp")
+except ImportError:
+    pass
+
+try:
+    from . import jit  # noqa: F401
+
+    __all__.append("jit")
+except ImportError:
+    pass
+
+try:
+    from . import io  # noqa: F401
+
+    __all__.append("io")
+except ImportError:
+    pass
+
+try:
+    from .framework.io import load, save  # noqa: F401
+
+    __all__ += ["save", "load"]
+except ImportError:
+    pass
+
+try:
+    from . import metric  # noqa: F401
+
+    __all__.append("metric")
+except ImportError:
+    pass
+
+try:
+    from . import vision  # noqa: F401
+
+    __all__.append("vision")
+except ImportError:
+    pass
+
+try:
+    from . import distributed  # noqa: F401
+    from .distributed.parallel import DataParallel  # noqa: F401
+
+    __all__ += ["distributed", "DataParallel"]
+except ImportError:
+    pass
+
+try:
+    from .hapi.model import Model  # noqa: F401
+
+    __all__.append("Model")
+except ImportError:
+    pass
+
+try:
+    from . import profiler  # noqa: F401
+
+    __all__.append("profiler")
+except ImportError:
+    pass
+
+try:
+    from . import incubate  # noqa: F401
+
+    __all__.append("incubate")
+except ImportError:
+    pass
+
+try:
+    from . import sparse  # noqa: F401
+
+    __all__.append("sparse")
+except ImportError:
+    pass
+
+try:
+    from . import distribution  # noqa: F401
+
+    __all__.append("distribution")
+except ImportError:
+    pass
+
+try:
+    from . import fft  # noqa: F401
+
+    __all__.append("fft")
+except ImportError:
+    pass
+
+try:
+    from . import signal  # noqa: F401
+
+    __all__.append("signal")
+except ImportError:
+    pass
+
+try:
+    from . import linalg  # noqa: F401
+
+    __all__.append("linalg")
+except ImportError:
+    pass
+
+try:
+    from . import static  # noqa: F401
+
+    __all__.append("static")
+except ImportError:
+    pass
+
+try:
+    from . import text  # noqa: F401
+
+    __all__.append("text")
+except ImportError:
+    pass
+
+try:
+    from . import audio  # noqa: F401
+
+    __all__.append("audio")
+except ImportError:
+    pass

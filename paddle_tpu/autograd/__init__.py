@@ -1,0 +1,6 @@
+"""paddle.autograd surface (reference: python/paddle/autograd/__init__.py)."""
+from ..core.autograd import backward, grad  # noqa: F401
+from ..base.global_state import no_grad_guard as no_grad  # noqa: F401
+from ..base.global_state import enable_grad_guard as enable_grad  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401

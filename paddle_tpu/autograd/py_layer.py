@@ -1,0 +1,115 @@
+"""PyLayer: user-defined autograd functions.
+
+Rebuild of the reference's PyLayer (python/paddle/autograd/py_layer.py +
+paddle/fluid/eager/pylayer): the user's ``backward`` staticmethod becomes the
+GradNode's backward, wired into the same tape as builtin ops.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+
+from ..base import global_state
+from ..core.autograd import GradNode
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self.saved_tensor_list: List[Tensor] = []
+        self._materialize_grads = True
+        self.non_differentiable: List[Tensor] = []
+
+    def save_for_backward(self, *tensors):
+        self.saved_tensor_list = list(tensors)
+
+    def saved_tensor(self):
+        return self.saved_tensor_list
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable.extend(tensors)
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class _PyLayerNode(GradNode):
+    """GradNode whose backward calls the user's staticmethod."""
+
+    def __init__(self, layer_cls, ctx, inputs, n_outputs, out_specs):
+        super().__init__(
+            name=layer_cls.__name__,
+            vjp_fn=None,
+            inputs=inputs,
+            n_outputs=n_outputs,
+            out_specs=out_specs,
+        )
+        self.layer_cls = layer_cls
+        self.ctx = ctx
+
+    def run_backward(self, create_graph: bool):
+        gouts = self._ready_outputs(create_graph)
+        guard = global_state.enable_grad_guard if create_graph else global_state.no_grad_guard
+        with guard():
+            res = self.layer_cls.backward(self.ctx, *gouts)
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        out = []
+        for g in res:
+            if g is None:
+                out.append(None)
+            elif isinstance(g, Tensor):
+                out.append(g)
+            else:
+                out.append(Tensor(jnp.asarray(g), stop_gradient=True))
+        return list(out)
+
+    def release(self):
+        self.ctx = None
+        self._out_grads = None
+
+
+class PyLayer:
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = global_state.grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = (outputs,) if single else tuple(outputs)
+        outs = tuple(o if isinstance(o, Tensor) else Tensor(o) for o in outs)
+        if needs_grad:
+            def _is_non_diff(o):
+                return any(o is t for t in ctx.non_differentiable)
+
+            node = _PyLayerNode(
+                cls,
+                ctx,
+                inputs=tensor_inputs,
+                n_outputs=len(outs),
+                out_specs=[(tuple(o._value.shape), o._value.dtype) for o in outs],
+            )
+            for i, o in enumerate(outs):
+                if _is_non_diff(o):
+                    continue
+                o._grad_node = node
+                o._output_index = i
+                o.stop_gradient = False
+        return outs[0] if single else outs
+
+
+# Paddle also exposes PyLayer with once_differentiable etc.; keep names available.
+def once_differentiable(fn):
+    return fn

@@ -1,0 +1,26 @@
+"""saved_tensors_hooks (reference: python/paddle/autograd/saved_tensors_hooks.py).
+
+On TPU the residuals are jax arrays inside VJP closures; the hook pair is
+applied to tensors explicitly saved through PyLayerContext.save_for_backward.
+Provided for API parity; pack/unpack run eagerly.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_tls = threading.local()
+
+
+def current_hooks():
+    return getattr(_tls, "hooks", None)
+
+
+@contextlib.contextmanager
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    prev = getattr(_tls, "hooks", None)
+    _tls.hooks = (pack_hook, unpack_hook)
+    try:
+        yield
+    finally:
+        _tls.hooks = prev

@@ -1,0 +1,1 @@
+from . import dtype, enforce, flags, global_state, log  # noqa: F401

@@ -1,0 +1,127 @@
+"""Dtype system.
+
+Paddle-style dtype handles (``paddle.float32`` etc., reference
+/root/reference/paddle/phi/common/data_type.h) backed by numpy/jnp dtypes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import ml_dtypes  # noqa: F401  (gives numpy a bfloat16 type; ships with jax)
+    _BF16 = np.dtype("bfloat16")
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+
+class DType:
+    """A framework dtype: hashable, comparable with strings and numpy dtypes."""
+
+    __slots__ = ("name", "np_dtype", "is_floating", "is_integer", "is_complex")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        kind = self.np_dtype.kind
+        self.is_floating = kind == "f" or name == "bfloat16"
+        self.is_integer = kind in ("i", "u")
+        self.is_complex = kind == "c"
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or ("paddle." + self.name) == other
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+
+_registry = {}
+
+
+def _def(name, np_dtype):
+    d = DType(name, np_dtype)
+    _registry[name] = d
+    return d
+
+
+bool_ = _def("bool", np.bool_)
+uint8 = _def("uint8", np.uint8)
+int8 = _def("int8", np.int8)
+int16 = _def("int16", np.int16)
+int32 = _def("int32", np.int32)
+int64 = _def("int64", np.int64)
+float16 = _def("float16", np.float16)
+float32 = _def("float32", np.float32)
+float64 = _def("float64", np.float64)
+complex64 = _def("complex64", np.complex64)
+complex128 = _def("complex128", np.complex128)
+if _BF16 is not None:
+    bfloat16 = _def("bfloat16", _BF16)
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize str / numpy dtype / DType / jnp dtype to a framework DType."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = dtype.replace("paddle.", "").replace("paddle_tpu.", "")
+        if name == "bool":
+            return bool_
+        if name in _registry:
+            return _registry[name]
+        raise ValueError(f"unknown dtype '{dtype}'")
+    npd = np.dtype(dtype)
+    if _BF16 is not None and npd == _BF16:
+        return _registry["bfloat16"]
+    if npd == np.bool_:
+        return bool_
+    for d in _registry.values():
+        if d.np_dtype == npd:
+            return d
+    raise ValueError(f"unsupported dtype {dtype!r}")
+
+
+# TPU-first canonical device dtypes: 64-bit types are stored as 32-bit on
+# device (XLA x64 is disabled; int32 covers indices, float32/bfloat16 cover
+# compute). ``np_dtype`` returns the on-device dtype; use ``.np_dtype`` on the
+# DType object for the declared host dtype.
+_DEVICE_NARROWING = {
+    "int64": np.int32,
+    "float64": np.float32,
+    "complex128": np.complex64,
+}
+
+
+def np_dtype(dtype):
+    d = convert_dtype(dtype)
+    if d is None:
+        return None
+    narrowed = _DEVICE_NARROWING.get(d.name)
+    return np.dtype(narrowed) if narrowed is not None else d.np_dtype
+
+
+def default_float_dtype() -> DType:
+    from . import global_state
+
+    return _registry[global_state.default_dtype]
+
+
+def iinfo(dtype):
+    return np.iinfo(np_dtype(dtype))
+
+
+def finfo(dtype):
+    import ml_dtypes
+
+    return ml_dtypes.finfo(np_dtype(dtype))

@@ -1,0 +1,65 @@
+"""Structured error reporting.
+
+Analog of the reference's PADDLE_ENFORCE macro family
+(/root/reference/paddle/common/enforce.h, paddle/phi/core/enforce.h): typed error
+categories with readable messages, raised as Python exceptions.
+"""
+from __future__ import annotations
+
+
+class EnforceError(ValueError):
+    category = "InvalidArgument"
+
+    def __init__(self, message: str):
+        super().__init__(f"({self.category}) {message}")
+
+
+class InvalidArgumentError(EnforceError):
+    category = "InvalidArgument"
+
+
+class NotFoundError(EnforceError):
+    category = "NotFound"
+
+
+class OutOfRangeError(EnforceError):
+    category = "OutOfRange"
+
+
+class AlreadyExistsError(EnforceError):
+    category = "AlreadyExists"
+
+
+class PreconditionNotMetError(EnforceError):
+    category = "PreconditionNotMet"
+
+
+class UnimplementedError(EnforceError):
+    category = "Unimplemented"
+
+
+class UnavailableError(EnforceError):
+    category = "Unavailable"
+
+
+class ExecutionTimeoutError(EnforceError):
+    category = "ExecutionTimeout"
+
+
+def enforce(cond: bool, message: str, exc: type = InvalidArgumentError) -> None:
+    if not cond:
+        raise exc(message)
+
+
+def enforce_eq(a, b, what: str = "value") -> None:
+    if a != b:
+        raise InvalidArgumentError(f"expected {what} == {b!r}, got {a!r}")
+
+
+def enforce_in(a, options, what: str = "value") -> None:
+    if a not in options:
+        raise InvalidArgumentError(f"expected {what} in {options!r}, got {a!r}")
+
+
+def not_implemented(message: str) -> None:
+    raise UnimplementedError(message)

@@ -1,0 +1,80 @@
+"""Layered process-level flag system.
+
+TPU-native analog of the reference's gflags registry
+(/root/reference/paddle/common/flags.h, flags.cc): flags are defined in-process,
+overridable by ``FLAGS_<name>`` environment variables, and settable at runtime via
+:func:`set_flags` (mirroring ``paddle.set_flags``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Iterable, Mapping
+
+_lock = threading.Lock()
+_registry: Dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "help", "type")
+
+    def __init__(self, name: str, default: Any, help: str):
+        self.name = name
+        self.default = default
+        self.help = help
+        self.type = type(default)
+        env = os.environ.get("FLAGS_" + name)
+        self.value = _parse(env, self.type) if env is not None else default
+
+
+def _parse(text: str, ty: type) -> Any:
+    if ty is bool:
+        return text.strip().lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(text)
+    if ty is float:
+        return float(text)
+    return text
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    """Register a flag (idempotent; later definitions keep the first default)."""
+    with _lock:
+        if name not in _registry:
+            _registry[name] = _Flag(name, default, help)
+
+
+def get_flag(name: str) -> Any:
+    f = _registry.get(name)
+    if f is None:
+        raise KeyError(f"flag '{name}' is not defined")
+    return f.value
+
+
+def get_flags(names: Iterable[str] | str | None = None) -> Dict[str, Any]:
+    if names is None:
+        return {k: f.value for k, f in _registry.items()}
+    if isinstance(names, str):
+        names = [names]
+    return {n: get_flag(n) for n in names}
+
+
+def set_flags(flags: Mapping[str, Any]) -> None:
+    with _lock:
+        for name, value in flags.items():
+            f = _registry.get(name)
+            if f is None:
+                raise KeyError(f"flag '{name}' is not defined")
+            f.value = _parse(value, f.type) if isinstance(value, str) and f.type is not str else f.type(value)
+
+
+# Core flags (subset of the reference's 183 exported flags that are meaningful on TPU).
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf and raise")
+define_flag("check_nan_inf_level", 0, "0: raise on nan/inf; >0: log only")
+define_flag("benchmark", False, "synchronize after each op for timing")
+define_flag("eager_jit_ops", True, "cache per-op jitted callables for eager dispatch")
+define_flag("use_pallas_kernels", True, "use Pallas TPU kernels for fused ops when available")
+define_flag("log_level", 1, "framework log verbosity (higher = chattier)")
+define_flag("allocator_strategy", "xla", "memory allocator strategy (informational on TPU; XLA owns HBM)")
+define_flag("embedding_deterministic", False, "deterministic embedding grad accumulation")
+define_flag("cudnn_deterministic", False, "accepted for compat; XLA is deterministic by default")

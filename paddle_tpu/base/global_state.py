@@ -1,0 +1,110 @@
+"""Process-global framework state: grad mode, default dtype, device, RNG.
+
+Analog of the reference's imperative tracer state
+(/root/reference/paddle/fluid/imperative/tracer.cc — HasGrad / AMP state) and
+``phi::Generator`` (/root/reference/paddle/phi/core/generator.cc) rebuilt on
+JAX's explicit-key RNG: a global key cell that splits per draw, and which the
+jit functionalizer captures as mutable state (see paddle_tpu/jit/functionalize.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+default_dtype = "float32"
+
+_tls = threading.local()
+
+
+def _state():
+    if not hasattr(_tls, "grad_enabled"):
+        _tls.grad_enabled = True
+        _tls.amp_state = None  # set by paddle_tpu.amp.auto_cast
+    return _tls
+
+
+def grad_enabled() -> bool:
+    return _state().grad_enabled
+
+
+def set_grad_enabled(mode: bool) -> bool:
+    s = _state()
+    prev = s.grad_enabled
+    s.grad_enabled = bool(mode)
+    return prev
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    prev = set_grad_enabled(False)
+    try:
+        yield
+    finally:
+        set_grad_enabled(prev)
+
+
+@contextlib.contextmanager
+def enable_grad_guard():
+    prev = set_grad_enabled(True)
+    try:
+        yield
+    finally:
+        set_grad_enabled(prev)
+
+
+def amp_state():
+    return _state().amp_state
+
+
+def set_amp_state(st):
+    s = _state()
+    prev = s.amp_state
+    s.amp_state = st
+    return prev
+
+
+class Generator:
+    """Global RNG: a mutable cell holding a jax PRNG key.
+
+    ``split()`` returns a fresh subkey and advances the cell. The cell is
+    registered with the jit functionalizer so RNG advances correctly inside
+    compiled train steps.
+    """
+
+    def __init__(self, seed: int = 0):
+        import jax
+
+        self._seed = seed
+        self._key = jax.random.PRNGKey(seed)
+
+    def manual_seed(self, seed: int):
+        import jax
+
+        self._seed = seed
+        self._key = jax.random.PRNGKey(seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def split(self):
+        import jax
+
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # state-cell protocol for the jit functionalizer
+    def _cell_get(self):
+        return self._key
+
+    def _cell_set(self, v):
+        self._key = v
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int):
+    """paddle.seed analog: reseed the global generator."""
+    default_generator.manual_seed(int(s))
+    return default_generator

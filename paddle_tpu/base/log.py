@@ -1,0 +1,29 @@
+"""Framework logging (analog of reference glog VLOG + python log_helper)."""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_logger = None
+
+
+def get_logger(name: str = "paddle_tpu") -> logging.Logger:
+    global _logger
+    if _logger is None:
+        log = logging.getLogger(name)
+        if not log.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s"))
+            log.addHandler(h)
+        log.setLevel(os.environ.get("PADDLE_TPU_LOG_LEVEL", "WARNING").upper())
+        log.propagate = False
+        _logger = log
+    return _logger
+
+
+def vlog(level: int, msg: str, *args):
+    from .flags import get_flag
+
+    if get_flag("log_level") >= level:
+        get_logger().info(msg, *args)

@@ -1,0 +1,137 @@
+"""Op dispatch: the bridge from functional ops to jax + the autograd tape.
+
+Rebuild of the reference's generated ``xxx_ad_func`` layer
+(/root/reference/paddle/fluid/eager/auto_code_generator/generator/eager_gen.py):
+every framework op funnels through :func:`primitive`, which
+- unwraps Tensor arguments to jax values,
+- applies AMP autocasting when an amp state is active (reference
+  paddle/fluid/imperative/amp_auto_cast.cc),
+- runs the op's jax implementation (async XLA dispatch),
+- when grad is required, captures a VJP closure via jax.vjp and wires a
+  GradNode into the tape,
+- optionally NaN/Inf-scans outputs (FLAGS_check_nan_inf, reference
+  paddle/fluid/eager/nan_inf_utils.cc).
+
+There is no KernelFactory/KernelKey here by design: on TPU, kernel selection
+is XLA compilation. The op "registry" is the set of python op functions plus
+OP_ATTRS metadata used by AMP lists and the profiler.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import global_state
+from ..base.flags import get_flag
+from .tensor import Tensor, unwrap
+
+
+def _is_float(v) -> bool:
+    try:
+        return jnp.issubdtype(jnp.asarray(v).dtype if not hasattr(v, "dtype") else v.dtype, jnp.inexact)
+    except Exception:
+        return False
+
+
+def _requires_grad(t) -> bool:
+    return isinstance(t, Tensor) and not t.stop_gradient
+
+
+def _check_nan_inf(name, values):
+    for v in values:
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact):
+            arr = np.asarray(v)
+            if not np.isfinite(arr).all():
+                from ..base.enforce import PreconditionNotMetError
+
+                raise PreconditionNotMetError(f"op '{name}' produced NaN/Inf output")
+
+
+class _TapeNodeBuilder:
+    pass
+
+
+def primitive(
+    name: str,
+    fn: Callable,
+    tensor_args: Sequence[Any],
+    attrs: dict | None = None,
+    n_outputs: int | None = None,
+):
+    """Execute op ``fn(*arg_values, **attrs)`` with autograd recording.
+
+    tensor_args may contain Tensors, jax values, numpy arrays or python
+    scalars; gradients flow to Tensor args with stop_gradient=False whose
+    dtype is floating.
+    """
+    attrs = attrs or {}
+    amp = global_state.amp_state()
+    if amp is not None:
+        tensor_args = amp.cast_inputs(name, tensor_args)
+
+    values = [unwrap(a) for a in tensor_args]
+    grad_on = global_state.grad_enabled()
+    diff_idx = [
+        i
+        for i, a in enumerate(tensor_args)
+        if grad_on and _requires_grad(a) and _is_float(values[i])
+    ]
+
+    if not diff_idx:
+        out = fn(*values, **attrs)
+        outs = _wrap_outputs(name, out, stop_gradient=True)
+        if get_flag("check_nan_inf"):
+            _check_nan_inf(name, [o._value for o in (outs if isinstance(outs, tuple) else (outs,))])
+        return outs
+
+    # Partial-application: close over non-diff args, differentiate the rest.
+    def partial_fn(*diff_vals):
+        full = list(values)
+        for i, v in zip(diff_idx, diff_vals):
+            full[i] = v
+        return fn(*full, **attrs)
+
+    diff_vals = [values[i] for i in diff_idx]
+    out, vjp_fn = jax.vjp(partial_fn, *diff_vals)
+
+    outs = _wrap_outputs(name, out, stop_gradient=False)
+    out_list = outs if isinstance(outs, tuple) else (outs,)
+
+    from .autograd import GradNode
+
+    node = GradNode(
+        name=name,
+        vjp_fn=vjp_fn,
+        inputs=[tensor_args[i] for i in diff_idx],
+        n_outputs=len(out_list),
+        out_specs=[(tuple(o._value.shape), o._value.dtype) for o in out_list],
+        recompute=(fn, values, attrs, diff_idx),
+    )
+    for i, o in enumerate(out_list):
+        o._grad_node = node
+        o._output_index = i
+
+    if get_flag("check_nan_inf"):
+        _check_nan_inf(name, [o._value for o in out_list])
+    return outs
+
+
+def _wrap_outputs(name, out, stop_gradient):
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o, stop_gradient=stop_gradient, name=f"{name}_out{i}") for i, o in enumerate(out))
+    return Tensor(out, stop_gradient=stop_gradient, name=f"{name}_out")
+
+
+def passthrough(name: str, fn: Callable, tensor_args: Sequence[Any], attrs: dict | None = None):
+    """Non-differentiable op (integer/bool outputs, comparisons, argmax...)."""
+    attrs = attrs or {}
+    values = [unwrap(a) for a in tensor_args]
+    out = fn(*values, **attrs)
+    outs = _wrap_outputs(name, out, stop_gradient=True)
+    if get_flag("check_nan_inf"):
+        _check_nan_inf(name, [o._value for o in (outs if isinstance(outs, tuple) else (outs,))])
+    return outs

@@ -1,0 +1,522 @@
+"""The eager Tensor.
+
+TPU-native rebuild of the reference's eager tensor
+(/root/reference/paddle/fluid/pybind/eager.cc p_tensor_type, autograd meta in
+paddle/fluid/eager/autograd_meta.h): a thin mutable handle over a jax.Array
+(or tracer, so the whole eager API is jit-traceable), carrying autograd state
+(stop_gradient, grad, grad_node edge) and Paddle tensor-method surface.
+
+Design notes (TPU-first):
+- the payload is ALWAYS a jax value; eager ops dispatch asynchronously through
+  XLA, so there is no per-op device synchronization;
+- mutation (inplace ops, optimizer updates) swaps the payload functionally —
+  under jit tracing the swap writes a tracer, which is how the functionalizer
+  (paddle_tpu/jit) turns eager training steps into pure compiled programs;
+- Tensor is a pytree node, so pytrees of Tensors flow through jax transforms.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype as dtype_mod
+from ..base import global_state
+from ..base.enforce import InvalidArgumentError, enforce
+
+
+def _to_jax(value, dtype=None):
+    if isinstance(value, Tensor):
+        value = value._value
+    if dtype is not None:
+        npd = dtype_mod.np_dtype(dtype)
+        if isinstance(value, (jax.Array,)) or hasattr(value, "aval"):
+            return value.astype(npd) if value.dtype != npd else value
+        return jnp.asarray(value, dtype=npd)
+    if isinstance(value, (bool, int)):
+        # Paddle promotes python ints to int64; keep int32 on TPU (native word).
+        return jnp.asarray(value, dtype=jnp.bool_ if isinstance(value, bool) else jnp.int64)
+    if isinstance(value, float):
+        return jnp.asarray(value, dtype=dtype_mod.np_dtype(global_state.default_dtype))
+    return jnp.asarray(value)
+
+
+_tensor_counter = [0]
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_output_index",
+        "name",
+        "persistable",
+        "_backward_hooks",
+        "_placements",
+        "_process_mesh",
+        "is_parameter",
+        "trainable",
+        "_version",
+        "__weakref__",
+    )
+
+    def __init__(self, value, dtype=None, stop_gradient=True, name=None, persistable=False):
+        self._value = _to_jax(value, dtype)
+        self.stop_gradient = bool(stop_gradient)
+        self._grad = None
+        self._grad_node = None
+        self._output_index = 0
+        if name is None:
+            _tensor_counter[0] += 1
+            name = f"generated_tensor_{_tensor_counter[0]}"
+        self.name = name
+        self.persistable = persistable
+        self._backward_hooks = None
+        self._placements = None  # auto-parallel placement annotation
+        self._process_mesh = None
+        self.is_parameter = False
+        self.trainable = True
+        self._version = 0
+
+    # -------------------------------------------------- meta
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return dtype_mod.convert_dtype(self._value.dtype)
+
+    @property
+    def place(self):
+        try:
+            dev = list(self._value.devices())[0]
+            return str(dev)
+        except Exception:
+            return "traced"
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numel(self):
+        from ..ops import creation
+
+        return creation.to_tensor(self.size, dtype="int64")
+
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        try:
+            val = np.asarray(self._value)
+            body = np.array2string(val, precision=8, separator=", ")
+        except Exception:
+            body = f"<traced {self._value}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"stop_gradient={self.stop_gradient},\n       {body})"
+        )
+
+    # -------------------------------------------------- value access
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __int__(self):
+        return int(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise InvalidArgumentError(
+                "The truth value of a Tensor with more than one element is ambiguous"
+            )
+        return bool(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -------------------------------------------------- autograd
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from . import autograd
+
+        autograd.backward_from(self, grad_tensor, retain_graph)
+
+    def register_hook(self, hook):
+        if self._backward_hooks is None:
+            self._backward_hooks = []
+        self._backward_hooks.append(hook)
+
+        class _Removable:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+
+        return _Removable(self._backward_hooks, hook)
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name + "_detached")
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from ..ops import math as math_ops
+
+        return math_ops.assign(self)
+
+    # -------------------------------------------------- mutation
+    def _replace_value(self, new_value):
+        """Swap the payload (functional mutation). Bumps the inplace version."""
+        self._value = new_value
+        self._version += 1
+
+    def set_value(self, value):
+        v = _to_jax(value)
+        enforce(
+            tuple(v.shape) == tuple(self._value.shape),
+            f"set_value shape mismatch: {v.shape} vs {self._value.shape}",
+        )
+        self._replace_value(v.astype(self._value.dtype))
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def fill_(self, value):
+        self._replace_value(jnp.full_like(self._value, value))
+        return self
+
+    def zero_(self):
+        self._replace_value(jnp.zeros_like(self._value))
+        return self
+
+    # -------------------------------------------------- conversion / movement
+    def astype(self, dtype):
+        from ..ops import manipulation
+
+        return manipulation.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        """to(device), to(dtype), to(device, dtype) — device moves via device_put."""
+        device = kwargs.get("device")
+        dtype = kwargs.get("dtype")
+        blocking = kwargs.get("blocking", None)  # noqa: F841 (accepted for compat)
+        for a in args:
+            if isinstance(a, str) and (
+                a.startswith(("cpu", "tpu", "gpu", "xpu")) or ":" in a
+            ):
+                device = a
+            elif isinstance(a, (dtype_mod.DType,)) or (isinstance(a, str)):
+                dtype = a
+            else:
+                device = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            from ..device import _resolve_device
+
+            out = Tensor(
+                jax.device_put(out._value, _resolve_device(device)),
+                stop_gradient=out.stop_gradient,
+            )
+        return out
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]), stop_gradient=self.stop_gradient)
+
+    def cuda(self, *a, **k):  # compat: maps to the accelerator
+        return self.to(device="tpu")
+
+    def tpu(self):
+        return self.to(device="tpu")
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -------------------------------------------------- operator protocol
+    def _binary(self, opname, other, reverse=False):
+        from ..ops import math as m
+
+        fn = getattr(m, opname)
+        return fn(other, self) if reverse else fn(self, other)
+
+    def __add__(self, o):
+        return self._binary("add", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary("subtract", o)
+
+    def __rsub__(self, o):
+        return self._binary("subtract", o, reverse=True)
+
+    def __mul__(self, o):
+        return self._binary("multiply", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary("divide", o)
+
+    def __rtruediv__(self, o):
+        return self._binary("divide", o, reverse=True)
+
+    def __floordiv__(self, o):
+        return self._binary("floor_divide", o)
+
+    def __rfloordiv__(self, o):
+        return self._binary("floor_divide", o, reverse=True)
+
+    def __mod__(self, o):
+        return self._binary("mod", o)
+
+    def __rmod__(self, o):
+        return self._binary("mod", o, reverse=True)
+
+    def __pow__(self, o):
+        return self._binary("pow", o)
+
+    def __rpow__(self, o):
+        return self._binary("pow", o, reverse=True)
+
+    def __matmul__(self, o):
+        return self._binary("matmul", o)
+
+    def __rmatmul__(self, o):
+        return self._binary("matmul", o, reverse=True)
+
+    def __neg__(self):
+        from ..ops import math as m
+
+        return m.neg(self)
+
+    def __abs__(self):
+        from ..ops import math as m
+
+        return m.abs(self)
+
+    def __eq__(self, o):
+        from ..ops import logic
+
+        return logic.equal(self, o)
+
+    def __ne__(self, o):
+        from ..ops import logic
+
+        return logic.not_equal(self, o)
+
+    def __lt__(self, o):
+        from ..ops import logic
+
+        return logic.less_than(self, o)
+
+    def __le__(self, o):
+        from ..ops import logic
+
+        return logic.less_equal(self, o)
+
+    def __gt__(self, o):
+        from ..ops import logic
+
+        return logic.greater_than(self, o)
+
+    def __ge__(self, o):
+        from ..ops import logic
+
+        return logic.greater_equal(self, o)
+
+    def __invert__(self):
+        from ..ops import logic
+
+        return logic.logical_not(self)
+
+    def __and__(self, o):
+        from ..ops import logic
+
+        return logic.logical_and(self, o) if self.dtype == dtype_mod.bool_ else logic.bitwise_and(self, o)
+
+    def __or__(self, o):
+        from ..ops import logic
+
+        return logic.logical_or(self, o) if self.dtype == dtype_mod.bool_ else logic.bitwise_or(self, o)
+
+    def __xor__(self, o):
+        from ..ops import logic
+
+        return logic.logical_xor(self, o) if self.dtype == dtype_mod.bool_ else logic.bitwise_xor(self, o)
+
+    def __getitem__(self, idx):
+        from ..ops import manipulation
+
+        return manipulation.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from ..ops import manipulation
+
+        manipulation.setitem_(self, idx, value)
+
+    # -------------------------------------------------- method surface
+    # (populated further by paddle_tpu/core/tensor_methods.py monkey-patching,
+    #  mirroring the reference's python/paddle/tensor method patching)
+
+    @property
+    def T(self):
+        from ..ops import linalg
+
+        return linalg.t_nd(self)
+
+    @property
+    def mT(self):
+        from ..ops import manipulation
+
+        perm = list(range(self.ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        return manipulation.transpose(self, perm)
+
+    # auto-parallel annotations
+    @property
+    def placements(self):
+        return self._placements
+
+    @property
+    def process_mesh(self):
+        return self._process_mesh
+
+    def is_dist(self):
+        return self._placements is not None
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    (value,) = children
+    stop_gradient, name = aux
+    out = Tensor.__new__(Tensor)
+    out._value = value
+    out.stop_gradient = stop_gradient
+    out._grad = None
+    out._grad_node = None
+    out._output_index = 0
+    out.name = name
+    out.persistable = False
+    out._backward_hooks = None
+    out._placements = None
+    out._process_mesh = None
+    out.is_parameter = False
+    out.trainable = True
+    out._version = 0
+    return out
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+class Parameter(Tensor):
+    """Trainable parameter: stop_gradient defaults to False (reference:
+    python/paddle/base/framework.py Parameter / EagerParamBase)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "init_fn")
+
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable, name=name, persistable=True)
+        self.is_parameter = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.init_fn = None
+
+
+def _param_unflatten(aux, children):
+    t = _tensor_unflatten(aux, children)
+    p = Parameter.__new__(Parameter)
+    for slot in (
+        "_value", "stop_gradient", "_grad", "_grad_node", "_output_index", "name",
+        "persistable", "_backward_hooks", "_placements", "_process_mesh",
+        "is_parameter", "trainable", "_version",
+    ):
+        setattr(p, slot, getattr(t, slot))
+    p.is_parameter = True
+    p.trainable = not t.stop_gradient
+    p.optimize_attr = {"learning_rate": 1.0}
+    p.regularizer = None
+    p.need_clip = True
+    p.init_fn = None
+    return p
+
+
+jax.tree_util.register_pytree_node(Parameter, _tensor_flatten, _param_unflatten)
+
+
+def unwrap(x):
+    """Tensor | array-like -> jax value."""
+    return x._value if isinstance(x, Tensor) else x
+
+
+def wrap(value, stop_gradient=True):
+    return Tensor(value, stop_gradient=stop_gradient)

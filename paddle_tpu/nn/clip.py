@@ -1,0 +1,82 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm etc., consumed by optimizer.grad_clip)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max), stop_gradient=True)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._value)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor(g._value * scale, stop_gradient=True)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip. In hybrid-parallel training the optimizer wrapper
+    extends the squared-norm reduction across mesh axes (reference
+    HybridParallelClipGrad, fleet/meta_optimizers/dygraph_optimizer/
+    hybrid_parallel_optimizer.py:42)."""
+
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _global_norm_sq(self, params_grads):
+        total = None
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                continue
+            s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+            total = s if total is None else total + s
+        return total
+
+    def __call__(self, params_grads):
+        total = self._global_norm_sq(params_grads)
+        if total is None:
+            return params_grads
+        gnorm = jnp.sqrt(total)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(g._value * scale.astype(g._value.dtype), stop_gradient=True)))
+        return out
+
+
+GradientClipBase = ClipGradBase
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

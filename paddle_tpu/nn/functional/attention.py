@@ -1,0 +1,130 @@
+"""Attention functionals (reference: python/paddle/nn/functional/
+flash_attention.py — flash_attention :195, scaled_dot_product_attention :976).
+
+TPU-native: the fused path is a Pallas flash-attention kernel
+(paddle_tpu/ops/pallas/flash_attention.py); off-TPU or when disabled, an XLA
+composition (which XLA still fuses well) is used. Layout follows paddle:
+[batch, seqlen, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+from ...core.tensor import unwrap
+
+
+def _xla_attention(q, k, v, *, causal, scale, bias=None, dropout=0.0, dropout_key=None):
+    # q,k,v: [B, S, H, D] -> einsum over head dim
+    logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool), t - s)
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def flash_attention(
+    query,
+    key,
+    value,
+    dropout=0.0,
+    causal=False,
+    return_softmax=False,
+    fixed_seed_offset=None,
+    rng_name="",
+    training=True,
+    name=None,
+):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    from ...base import global_state
+    from ...ops.pallas import flash_attention as pallas_fa
+
+    scale = 1.0 / math.sqrt(unwrap(query).shape[-1])
+    dkey = global_state.default_generator.split() if (dropout > 0.0 and training) else None
+
+    if pallas_fa.available() and dropout == 0.0:
+        out = primitive(
+            "flash_attention",
+            lambda q, k, v: pallas_fa.flash_attention_value(q, k, v, causal=causal, scale=scale),
+            [query, key, value],
+        )
+    else:
+        out = primitive(
+            "flash_attention_xla",
+            lambda q, k, v: _xla_attention(
+                q, k, v, causal=causal, scale=scale, dropout=dropout if training else 0.0, dropout_key=dkey
+            ),
+            [query, key, value],
+        )
+    if return_softmax:
+        return out, None
+    return out, None if not return_softmax else None
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+):
+    """paddle.nn.functional.scaled_dot_product_attention parity
+    (q/k/v: [B, S, H, D]; attn_mask broadcastable to [B, H, S, T])."""
+    from ...base import global_state
+    from ...ops.pallas import flash_attention as pallas_fa
+
+    scale = 1.0 / math.sqrt(unwrap(query).shape[-1])
+    if attn_mask is None and dropout_p == 0.0 and pallas_fa.available():
+        return primitive(
+            "sdpa_flash",
+            lambda q, k, v: pallas_fa.flash_attention_value(q, k, v, causal=is_causal, scale=scale),
+            [query, key, value],
+        )
+    dkey = global_state.default_generator.split() if (dropout_p > 0.0 and training) else None
+    if attn_mask is not None:
+        mask_v = unwrap(attn_mask)
+        if mask_v.dtype == jnp.bool_:
+            bias = jnp.where(mask_v, 0.0, -1e30)
+        else:
+            bias = mask_v
+
+        return primitive(
+            "sdpa_xla",
+            lambda q, k, v, b: _xla_attention(
+                q, k, v, causal=is_causal, scale=scale, bias=b,
+                dropout=dropout_p if training else 0.0, dropout_key=dkey,
+            ),
+            [query, key, value, attn_mask if mask_v.dtype != jnp.bool_ else __wrap(bias)],
+        )
+    return primitive(
+        "sdpa_xla",
+        lambda q, k, v: _xla_attention(
+            q, k, v, causal=is_causal, scale=scale, dropout=dropout_p if training else 0.0, dropout_key=dkey
+        ),
+        [query, key, value],
+    )
+
+
+def __wrap(arr):
+    from ...core.tensor import Tensor
+
+    return Tensor(arr)
+
+
+class sdp_kernel:
+    """Context manager selecting attention backends (compat shim)."""
+
+    def __init__(self, enable_flash=True, enable_math=True, enable_mem_efficient=True):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

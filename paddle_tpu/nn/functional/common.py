@@ -1,0 +1,287 @@
+"""Common NN functionals (reference: python/paddle/nn/functional/common.py,
+input.py, vision.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...base import global_state
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor, unwrap
+from ...ops.manipulation import pad  # noqa: F401  (re-export; paddle has F.pad)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W shaped [in, out] (reference phi matmul+add fused by XLA)."""
+    if bias is None:
+        return primitive("linear", lambda v, w: jnp.matmul(v, w), [x, weight])
+    return primitive("linear", lambda v, w, b: jnp.matmul(v, w) + b, [x, weight, bias])
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fn(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    # gradient flows to weight only; indices pass through jnp.take
+    return primitive("embedding", lambda w: fn(unwrap(x), w), [weight])
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = global_state.default_generator.split()
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [a % v.ndim for a in axes] else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0)
+        return jnp.where(keep, v, 0.0)
+
+    return primitive("dropout", fn, [x])
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [2, 3] if data_format == "NCHW" else [1, 2]
+    drop_axes = [i for i in range(4) if i not in ax]
+    return dropout(x, p, axis=drop_axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [2, 3, 4] if data_format == "NCDHW" else [1, 2, 3]
+    drop_axes = [i for i in range(5) if i not in ax]
+    return dropout(x, p, axis=drop_axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = global_state.default_generator.split()
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = ((1.0 - p) * (1.0 + p * alpha_p**2)) ** -0.5
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, alpha_p) + b
+
+    return primitive("alpha_dropout", fn, [x])
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        norm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(norm, epsilon)
+
+    return primitive("normalize", fn, [x])
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis)) * jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+
+    return primitive("cosine_similarity", fn, [x1, x2])
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *bias_arg):
+        # w: [out, in1, in2]
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bias_arg:
+            out = out + bias_arg[0]
+        return out
+
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return primitive("bilinear", fn, args)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(v):
+        k = v.shape[-1]
+        if prior_dist is not None:
+            return (1 - epsilon) * v + epsilon * unwrap(prior_dist)
+        return (1 - epsilon) * v + epsilon / k
+
+    return primitive("label_smooth", fn, [label])
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    v = unwrap(x)
+    cl = data_format in ("NHWC", "NWC", "NDHWC")
+    spatial_ndim = v.ndim - 2
+    if cl:
+        spatial = v.shape[1:-1]
+    else:
+        spatial = v.shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_size = [int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size] * spatial_ndim)]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * spatial_ndim
+        out_size = [int(np.floor(s * f)) for s, f in zip(spatial, sf)]
+
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear", "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def fn(v):
+        if cl:
+            new_shape = (v.shape[0],) + tuple(out_size) + (v.shape[-1],)
+            axes = tuple(range(1, 1 + spatial_ndim))
+        else:
+            new_shape = v.shape[:2] + tuple(out_size)
+            axes = tuple(range(2, 2 + spatial_ndim))
+        if method == "nearest":
+            # exact nearest (XLA gather): index mapping floor(i*scale)
+            out = v
+            for ax, osz in zip(axes, out_size):
+                isz = out.shape[ax]
+                idx = jnp.floor(jnp.arange(osz) * (isz / osz)).astype(jnp.int32)
+                out = jnp.take(out, idx, axis=ax)
+            return out
+        if align_corners:
+            out = v
+            for ax, osz in zip(axes, out_size):
+                isz = out.shape[ax]
+                pos = jnp.linspace(0.0, isz - 1.0, osz)
+                lo = jnp.floor(pos).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, isz - 1)
+                w = (pos - lo).astype(v.dtype)
+                shape = [1] * out.ndim
+                shape[ax] = osz
+                w = w.reshape(shape)
+                out = jnp.take(out, lo, axis=ax) * (1 - w) + jnp.take(out, hi, axis=ax) * w
+            return out
+        return jax.image.resize(v, new_shape, method=method)
+
+    return primitive("interpolate", fn, [x])
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v = v.reshape(b, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(b, c // (r * r), h * r, w * r)
+        b, h, w, c = v.shape
+        v = v.reshape(b, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(b, h * r, w * r, c // (r * r))
+
+    return primitive("pixel_shuffle", fn, [x])
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v = v.reshape(b, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(b, c * r * r, h // r, w // r)
+        b, h, w, c = v.shape
+        v = v.reshape(b, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(b, h // r, w // r, c * r * r)
+
+    return primitive("pixel_unshuffle", fn, [x])
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v = v.reshape(b, groups, c // groups, h, w)
+            return v.transpose(0, 2, 1, 3, 4).reshape(b, c, h, w)
+        b, h, w, c = v.shape
+        v = v.reshape(b, h, w, groups, c // groups)
+        return v.transpose(0, 1, 2, 4, 3).reshape(b, h, w, c)
+
+    return primitive("channel_shuffle", fn, [x])
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference phi unfold kernel)."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def fn(v):
+        b, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])))
+        oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for ki in range(ks[0]):
+            for kj in range(ks[1]):
+                sub = v[:, :, ki * dl[0] : ki * dl[0] + oh * st[0] : st[0], kj * dl[1] : kj * dl[1] + ow * st[1] : st[1]]
+                patches.append(sub)
+        out = jnp.stack(patches, axis=2)  # [b, c, k*k, oh, ow]
+        return out.reshape(b, c * ks[0] * ks[1], oh * ow)
+
+    return primitive("unfold", fn, [x])
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def fn(v):
+        b = v.shape[0]
+        c = v.shape[1] // (ks[0] * ks[1])
+        ph, pw = os_[0] + pd[0] + pd[2], os_[1] + pd[1] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v = v.reshape(b, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((b, c, ph, pw), v.dtype)
+        for ki in range(ks[0]):
+            for kj in range(ks[1]):
+                out = out.at[:, :, ki * dl[0] : ki * dl[0] + oh * st[0] : st[0], kj * dl[1] : kj * dl[1] + ow * st[1] : st[1]].add(
+                    v[:, :, ki, kj]
+                )
+        return out[:, :, pd[0] : ph - pd[2], pd[1] : pw - pd[3]]
+
+    return primitive("fold", fn, [x])

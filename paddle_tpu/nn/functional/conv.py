@@ -1,0 +1,132 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py over phi
+conv kernels/cuDNN) — rebuilt on lax.conv_general_dilated, which XLA maps
+onto the MXU natively. Weight layout follows paddle: [out_c, in_c/groups, *k].
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import primitive
+from ...core.tensor import unwrap
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 2 * n:  # paddle allows [before, after] pairs flattened
+            return tuple(v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _resolve_padding(padding, n, stride, dilation, ksize, in_spatial):
+    """Return lax-style [(lo, hi)] * n."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "VALID":
+            return [(0, 0)] * n
+        if p == "SAME":
+            pads = []
+            for i in range(n):
+                out = -(-in_spatial[i] // stride[i])
+                eff_k = (ksize[i] - 1) * dilation[i] + 1
+                total = max(0, (out - 1) * stride[i] + eff_k - in_spatial[i])
+                pads.append((total // 2, total - total // 2))
+            return pads
+        raise ValueError(f"bad padding {padding}")
+    if isinstance(padding, (list, tuple)) and len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    pads = _tuplize(padding, n)
+    return [(p, p) for p in pads]
+
+
+def _conv(name, x, weight, bias, stride, padding, dilation, groups, n, data_format, transpose=False, output_padding=0):
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    channel_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
+    spatial = "DHW"[3 - n :]
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = lax.conv_dimension_numbers(
+        unwrap(x).shape, unwrap(weight).shape, (lhs_spec, rhs_spec, out_spec)
+    )
+    wv = unwrap(weight)
+    ksize = wv.shape[2:]
+    in_spatial = [unwrap(x).shape[i] for i, ch in enumerate(lhs_spec) if ch in spatial]
+    pads = _resolve_padding(padding, n, stride, dilation, ksize, in_spatial)
+
+    if not transpose:
+        def fn(v, w, *b):
+            out = lax.conv_general_dilated(
+                v, w, window_strides=stride, padding=pads, rhs_dilation=dilation,
+                dimension_numbers=dn, feature_group_count=groups,
+            )
+            if b:
+                shape = [1] * out.ndim
+                shape[out_spec.index("C")] = b[0].shape[0]
+                out = out + b[0].reshape(shape)
+            return out
+    else:
+        opad = _tuplize(output_padding, n)
+
+        def fn(v, w, *b):
+            # paddle conv_transpose weight: [in_c, out_c/groups, *k]
+            # grad-of-conv formulation: lhs_dilation = stride
+            k_t = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+            k_t = jnp.swapaxes(k_t, 0, 1)  # -> [out_c/groups, in_c, *k]
+            if groups > 1:
+                # regroup: [in_c, out_c/groups, *k] with feature groups
+                ic = w.shape[0]
+                ocg = w.shape[1]
+                k_g = w.reshape((groups, ic // groups) + w.shape[1:])
+                k_g = jnp.flip(k_g, axis=tuple(range(3, 3 + n)))
+                k_g = jnp.swapaxes(k_g, 1, 2)  # [groups, out_c/groups, in_c/groups, *k]
+                k_t = k_g.reshape((groups * ocg, ic // groups) + w.shape[2:])
+            tpads = []
+            for i in range(n):
+                eff_k = (ksize[i] - 1) * dilation[i] + 1
+                lo = eff_k - 1 - pads[i][0]
+                hi = eff_k - 1 - pads[i][1] + opad[i]
+                tpads.append((lo, hi))
+            out = lax.conv_general_dilated(
+                v, k_t, window_strides=(1,) * n, padding=tpads, lhs_dilation=stride,
+                rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+            )
+            if b:
+                shape = [1] * out.ndim
+                shape[out_spec.index("C")] = b[0].shape[0]
+                out = out + b[0].reshape(shape)
+            return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return primitive(name, fn, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv("conv1d", x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv("conv2d", x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv("conv3d", x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv("conv1d_transpose", x, weight, bias, stride, padding, dilation, groups, 1, data_format, transpose=True, output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv("conv2d_transpose", x, weight, bias, stride, padding, dilation, groups, 2, data_format, transpose=True, output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv("conv3d_transpose", x, weight, bias, stride, padding, dilation, groups, 3, data_format, transpose=True, output_padding=output_padding)

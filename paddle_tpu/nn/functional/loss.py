@@ -1,0 +1,283 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor, unwrap
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return primitive("mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction), [input, label])
+
+
+def square_error_cost(input, label):
+    return primitive("square_error_cost", lambda a, b: jnp.square(a - b), [input, label])
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return primitive("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), [input, label])
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        out = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        # paddle multiplies by delta
+        return _reduce(out * delta, reduction)
+
+    return primitive("smooth_l1_loss", fn, [input, label])
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return primitive("log_loss", fn, [input, label])
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, y, *w):
+        out = -(y * jnp.log(jnp.clip(p, 1e-12)) + (1 - y) * jnp.log(jnp.clip(1 - p, 1e-12)))
+        if w:
+            out = out * w[0]
+        return _reduce(out, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return primitive("binary_cross_entropy", fn, args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    def fn(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]
+            i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight on the y term
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            out = (1 - y) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0.0))
+        else:
+            out = jnp.maximum(z, 0.0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        if w is not None:
+            out = out * w
+        return _reduce(out, reduction)
+
+    args = [logit, label] + [t for t in (weight, pos_weight) if t is not None]
+    return primitive("bce_with_logits", fn, args)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def fn(logp, *w):
+        y = unwrap(label)
+        C = logp.shape[1]
+        if logp.ndim > 2:
+            # [N, C, d1...] -> flatten spatial
+            perm = (0,) + tuple(range(2, logp.ndim)) + (1,)
+            lp = jnp.transpose(logp, perm).reshape(-1, C)
+            yy = y.reshape(-1)
+        else:
+            lp, yy = logp, y.reshape(-1)
+        picked = jnp.take_along_axis(lp, yy[:, None], axis=1)[:, 0]
+        wvec = w[0][yy] if w else jnp.ones_like(picked)
+        valid = (yy != ignore_index).astype(lp.dtype)
+        out = -picked * wvec * valid
+        if reduction == "mean":
+            return jnp.sum(out) / jnp.maximum(jnp.sum(wvec * valid), 1e-12)
+        if reduction == "sum":
+            return jnp.sum(out)
+        return out.reshape(y.shape)
+
+    args = [input] + ([weight] if weight is not None else [])
+    return primitive("nll_loss", fn, args)
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    """Softmax cross entropy (reference phi cross_entropy_with_softmax kernel).
+
+    Hard labels index the class axis; soft labels are full distributions.
+    """
+
+    def fn(z, *extra):
+        y = unwrap(label)
+        logp = jax.nn.log_softmax(z, axis=axis) if use_softmax else jnp.log(jnp.clip(z, 1e-12))
+        if soft_label or (y.ndim == z.ndim and y.shape == z.shape and jnp.issubdtype(y.dtype, jnp.floating)):
+            yy = y
+            if label_smoothing > 0:
+                k = z.shape[axis]
+                yy = yy * (1 - label_smoothing) + label_smoothing / k
+            out = -jnp.sum(yy * logp, axis=axis, keepdims=True)
+            out = jnp.squeeze(out, axis)
+            return _reduce(out, reduction)
+        yy = y
+        if yy.ndim == z.ndim and yy.shape[axis] == 1:
+            yy = jnp.squeeze(yy, axis)
+        ax = axis % z.ndim
+        if label_smoothing > 0:
+            k = z.shape[ax]
+            onehot = jax.nn.one_hot(yy, k, axis=ax, dtype=logp.dtype)
+            sm = onehot * (1 - label_smoothing) + label_smoothing / k
+            out = -jnp.sum(sm * logp, axis=ax)
+        else:
+            picked = jnp.take_along_axis(logp, jnp.expand_dims(yy, ax), axis=ax)
+            out = -jnp.squeeze(picked, ax)
+        valid = (yy != ignore_index)
+        out = jnp.where(valid, out, 0.0)
+        if extra:  # class weights
+            wvec = extra[0][yy] * valid.astype(logp.dtype)
+            if reduction == "mean":
+                return jnp.sum(out * extra[0][yy]) / jnp.maximum(jnp.sum(wvec), 1e-12)
+            if reduction == "sum":
+                return jnp.sum(out * extra[0][yy])
+            return out * extra[0][yy]
+        if reduction == "mean":
+            return jnp.sum(out) / jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1e-12)
+        if reduction == "sum":
+            return jnp.sum(out)
+        return out
+
+    args = [input] + ([weight] if weight is not None else [])
+    return primitive("cross_entropy", fn, args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    from ...ops.activation import softmax as _softmax
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(lp, y):
+        if log_target:
+            out = jnp.exp(y) * (y - lp)
+        else:
+            out = y * (jnp.log(jnp.clip(y, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(out) / lp.shape[0]
+        return _reduce(out, reduction)
+
+    return primitive("kl_div", fn, [input, label])
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+
+    return primitive("margin_ranking_loss", fn, [input, other, label])
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, y):
+        out = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(out, reduction)
+
+    return primitive("hinge_embedding_loss", fn, [input, label])
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return primitive("triplet_margin_loss", fn, [input, positive, negative])
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def fn(z, y, *norm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0.0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * ((1 - p_t) ** gamma) * ce
+        if norm:
+            out = out / norm[0]
+        return _reduce(out, reduction)
+
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return primitive("sigmoid_focal_loss", fn, args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC forward-backward in log space via lax.scan (reference warpctc)."""
+
+    def fn(lp):
+        # lp: [T, B, C] log-probs (paddle convention)
+        y = unwrap(labels)  # [B, S]
+        in_len = unwrap(input_lengths)
+        lab_len = unwrap(label_lengths)
+        T, B, C = lp.shape
+        S = y.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=y.dtype)
+        ext = ext.at[:, 1::2].set(y)
+        L = 2 * lab_len + 1
+        NEG = -1e30
+
+        def get(lp_t, idx):
+            return jnp.take_along_axis(lp_t, idx, axis=1)
+
+        alpha0 = jnp.full((B, 2 * S + 1), NEG)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.where(lab_len > 0, get(lp[0], ext[:, 1:2])[:, 0], NEG)
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        same_as_2back = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, lp_t):
+            a1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+            a2 = jnp.where(same_as_2back, NEG, a2)
+            new = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2) + get(lp_t, ext)
+            return new, new
+
+        alphas_last, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, 2S+1]
+        # pick alpha at t = in_len-1, positions L-1 and L-2
+        t_idx = jnp.clip(in_len - 1, 0, T - 1)
+        at_T = jnp.take_along_axis(all_alphas, t_idx[None, :, None], axis=0)[0]  # [B, 2S+1]
+        pos1 = jnp.take_along_axis(at_T, jnp.clip(L - 1, 0, 2 * S)[:, None], axis=1)[:, 0]
+        pos2 = jnp.take_along_axis(at_T, jnp.clip(L - 2, 0, 2 * S)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(pos1, jnp.where(lab_len > 0, pos2, NEG))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return primitive("ctc_loss", fn, [log_probs])

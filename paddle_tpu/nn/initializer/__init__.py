@@ -1,0 +1,19 @@
+"""Parameter initializers (reference: python/paddle/nn/initializer/*)."""
+from .initializers import (  # noqa: F401
+    Assign,
+    Bilinear,
+    Constant,
+    Dirac,
+    Initializer,
+    KaimingNormal,
+    KaimingUniform,
+    Normal,
+    Orthogonal,
+    TruncatedNormal,
+    Uniform,
+    XavierNormal,
+    XavierUniform,
+    calculate_gain,
+    set_global_initializer,
+)
+from .attr_helpers import ParamAttr, resolve_param_attr  # noqa: F401

@@ -1,0 +1,38 @@
+"""ParamAttr (reference: python/paddle/base/param_attr.py)."""
+from __future__ import annotations
+
+
+class ParamAttr:
+    def __init__(
+        self,
+        name=None,
+        initializer=None,
+        learning_rate=1.0,
+        regularizer=None,
+        trainable=True,
+        do_model_average=True,
+        need_clip=True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+def resolve_param_attr(attr):
+    """Normalize the `weight_attr`/`bias_attr` argument convention:
+    None -> default; False -> no parameter; str -> named; Initializer -> wraps;
+    ParamAttr -> as-is."""
+    if attr is None:
+        return ParamAttr()
+    if attr is False:
+        return None
+    if isinstance(attr, str):
+        return ParamAttr(name=attr)
+    if isinstance(attr, ParamAttr):
+        return attr
+    # an Initializer instance
+    return ParamAttr(initializer=attr)

@@ -1,0 +1,209 @@
+"""Initializer implementations (reference: python/paddle/nn/initializer/
+{constant,normal,uniform,xavier,kaiming,orthogonal,dirac,assign}.py).
+
+Each initializer is a callable writing into a Parameter in place via the
+global RNG (so paddle.seed reproduces the reference contract).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...base import global_state
+from ...core.tensor import Tensor
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init, _global_bias_init = weight_init, bias_init
+
+
+def global_initializer(is_bias):
+    return _global_bias_init if is_bias else _global_weight_init
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    return gains[nonlinearity]
+
+
+def _fan_in_out(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out_c?, in_c?, *k] — paddle stores conv weight as
+    # [out_c, in_c/groups, *k]; linear as [in, out].
+    receptive = int(np.prod(shape[2:]))
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, param: Tensor, block=None):
+        raise NotImplementedError
+
+    def _set(self, param: Tensor, value):
+        param._replace_value(jnp.asarray(value, param._value.dtype))
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        self._set(param, jnp.full(param._value.shape, self.value))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param, block=None):
+        key = global_state.default_generator.split()
+        self._set(param, self.mean + self.std * jax.random.normal(key, param._value.shape))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, param, block=None):
+        key = global_state.default_generator.split()
+        z = jax.random.truncated_normal(key, (self.a - self.mean) / self.std, (self.b - self.mean) / self.std, param._value.shape)
+        self._set(param, self.mean + self.std * z)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, param, block=None):
+        key = global_state.default_generator.split()
+        self._set(param, jax.random.uniform(key, param._value.shape, minval=self.low, maxval=self.high))
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fan_in_out(tuple(param._value.shape))
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        key = global_state.default_generator.split()
+        self._set(param, std * jax.random.normal(key, param._value.shape))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fan_in_out(tuple(param._value.shape))
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        key = global_state.default_generator.split()
+        self._set(param, jax.random.uniform(key, param._value.shape, minval=-limit, maxval=limit))
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, param, block=None):
+        fi, _ = _fan_in_out(tuple(param._value.shape))
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        key = global_state.default_generator.split()
+        self._set(param, std * jax.random.normal(key, param._value.shape))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, param, block=None):
+        fi, _ = _fan_in_out(tuple(param._value.shape))
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        key = global_state.default_generator.split()
+        self._set(param, jax.random.uniform(key, param._value.shape, minval=-limit, maxval=limit))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, param, block=None):
+        shape = tuple(param._value.shape)
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        key = global_state.default_generator.split()
+        flat = jax.random.normal(key, (max(rows, cols), min(rows, cols)))
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        self._set(param, self.gain * q[:rows, :cols].reshape(shape))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, param, block=None):
+        shape = tuple(param._value.shape)
+        out = np.zeros(shape, np.float32)
+        out_per_group = shape[0] // self.groups
+        mid = tuple(s // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for i in range(min(out_per_group, shape[1])):
+                out[(g * out_per_group + i, i) + mid] = 1.0
+        self._set(param, out)
+
+
+class Bilinear(Initializer):
+    def __call__(self, param, block=None):
+        shape = tuple(param._value.shape)
+        k = shape[-1]
+        factor = (k + 1) // 2
+        center = factor - 1 if k % 2 == 1 else factor - 0.5
+        og = np.ogrid[:k, :k]
+        filt = (1 - abs(og[0] - center) / factor) * (1 - abs(og[1] - center) / factor)
+        out = np.zeros(shape, np.float32)
+        out[range(shape[0]), range(shape[1]) if shape[1] == shape[0] else 0, :, :] = filt
+        self._set(param, out)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        v = self.value.numpy() if isinstance(self.value, Tensor) else np.asarray(self.value)
+        self._set(param, v.reshape(param._value.shape))

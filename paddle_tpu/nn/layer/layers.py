@@ -1,0 +1,348 @@
+"""nn.Layer: the module base class.
+
+Rebuild of the reference's Layer (python/paddle/nn/layer/layers.py:354):
+sublayer/parameter trees, forward pre/post hooks, state_dict/set_state_dict,
+train/eval mode, buffers, apply, to(). TPU-native additions: parameters are
+jax-backed Tensors; ``sharding_spec`` annotations on parameters drive
+GSPMD placement in the jit path (paddle_tpu/jit, paddle_tpu/distributed).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ...base import dtype as dtype_mod
+from ...base import global_state
+from ...base.enforce import enforce
+from ...core.tensor import Parameter, Tensor
+
+_HOOK_ID = [0]
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hid):
+        self._hooks, self._hid = hooks, hid
+
+    def remove(self):
+        self._hooks.pop(self._hid, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters = OrderedDict()
+        self._sub_layers = OrderedDict()
+        self._buffers = OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._casted_by_pure_fp16 = False
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ------------------------------------------------ attribute plumbing
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            enforce(params is not None, "call super().__init__() before assigning parameters")
+            params[name] = value
+            layers.pop(name, None) if layers else None
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            enforce(layers is not None, "call super().__init__() before assigning sublayers")
+            layers[name] = value
+            params.pop(name, None) if params else None
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params[name] = None
+                    return
+                if isinstance(value, Tensor):
+                    # keep the registry authoritative: re-wrap as Parameter so
+                    # parameters()/state_dict() keep seeing what forward uses
+                    params[name] = Parameter(value._value)
+                    return
+                raise TypeError(
+                    f"cannot assign {type(value).__name__!r} to parameter '{name}' "
+                    "(expected Parameter, Tensor, or None)"
+                )
+            if layers is not None and name in layers:
+                if value is None:
+                    layers[name] = None
+                    return
+            if buffers is not None and name in buffers:
+                if value is None or isinstance(value, Tensor):
+                    buffers[name] = value
+                    return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + list(self._sub_layers) + list(self._buffers)
+
+    # ------------------------------------------------ registration
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            parameter = Parameter(parameter._value if isinstance(parameter, Tensor) else parameter)
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        enforce(isinstance(sublayer, Layer) or sublayer is None, "sublayer must be a Layer")
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+        return tensor
+
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        """Reference Layer.create_parameter: build + initialize a Parameter."""
+        from ..initializer import Constant, XavierNormal
+        from ..initializer.attr_helpers import resolve_param_attr
+        from ..initializer.initializers import global_initializer
+
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype or global_state.default_dtype
+        attr = resolve_param_attr(attr)
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        elif default_initializer is not None:
+            init = default_initializer
+        elif global_initializer(is_bias) is not None:
+            init = global_initializer(is_bias)
+        else:
+            init = Constant(0.0) if is_bias else XavierNormal()
+        p = Parameter(np.zeros([int(s) for s in shape], dtype_mod.np_dtype(dtype)))
+        init(p)
+        if attr is not None:
+            if attr.name:
+                p.name = attr.name
+            p.trainable = attr.trainable
+            p.stop_gradient = not attr.trainable
+            p.optimize_attr["learning_rate"] = attr.learning_rate
+            p.regularizer = attr.regularizer
+        p.init_fn = init
+        return p
+
+    # ------------------------------------------------ traversal
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator[Tuple[str, Parameter]]:
+        memo = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in memo:
+                    continue
+                memo.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+            if not include_sublayers:
+                break
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix, include_self=True, layers_set=layers_set)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return [l for l in self._sub_layers.values() if l is not None]
+
+    def named_children(self):
+        return [(n, l) for n, l in self._sub_layers.items() if l is not None]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        memo = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in memo:
+                    continue
+                memo.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # ------------------------------------------------ mode
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # ------------------------------------------------ hooks
+    def register_forward_pre_hook(self, hook):
+        _HOOK_ID[0] += 1
+        self._forward_pre_hooks[_HOOK_ID[0]] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, _HOOK_ID[0])
+
+    def register_forward_post_hook(self, hook):
+        _HOOK_ID[0] += 1
+        self._forward_post_hooks[_HOOK_ID[0]] = hook
+        return HookRemoveHelper(self._forward_post_hooks, _HOOK_ID[0])
+
+    # ------------------------------------------------ call
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            res = hook(self, inputs)
+            if res is not None:
+                inputs = res if isinstance(res, tuple) else (res,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    # ------------------------------------------------ state dict
+    def state_dict(self, destination=None, include_sublayers=True, structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip(".")):
+            dest[name] = p
+        for name, layer in self.named_sublayers(prefix=structured_name_prefix.rstrip("."), include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names_set:
+                    continue
+                dest[f"{name}.{bname}" if name else bname] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+                enforce(
+                    list(arr.shape) == target.shape,
+                    f"shape mismatch for '{name}': checkpoint {list(arr.shape)} vs model {target.shape}",
+                )
+                target.set_value(arr)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------ dtype/device movement
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._convert_dtype(dtype)
+        if device is not None:
+            import jax
+
+            from ...device import _resolve_device
+
+            dev = _resolve_device(device)
+            for t in list(self.state_dict().values()):
+                t._replace_value(jax.device_put(t._value, dev))
+        return self
+
+    def _convert_dtype(self, dtype):
+        npd = dtype_mod.np_dtype(dtype)
+        import jax.numpy as jnp
+
+        for t in self.state_dict().values():
+            if jnp.issubdtype(t._value.dtype, jnp.inexact):
+                t._replace_value(t._value.astype(npd))
+        self._dtype = dtype_mod.convert_dtype(dtype).name
+        for layer in self.sublayers(include_self=True):
+            layer._dtype = self._dtype
+        return self
+
+    def astype(self, dtype):
+        return self._convert_dtype(dtype)
+
+    def float(self):
+        return self._convert_dtype("float32")
+
+    def bfloat16(self):
+        return self._convert_dtype("bfloat16")
+
+    def float16(self):
+        return self._convert_dtype("float16")
+
+    # ------------------------------------------------ misc
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            mod_str = repr(sub)
+            mod_str = "\n".join("  " + l for l in mod_str.split("\n"))
+            lines.append(f"  ({name}): {mod_str.strip()}")
+        main = self.__class__.__name__ + "("
+        if extra and not lines:
+            return main + extra + ")"
+        if lines:
+            return main + (extra + "\n" if extra else "\n") + "\n".join(lines) + "\n)"
+        return main + ")"

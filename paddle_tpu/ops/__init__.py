@@ -1,0 +1,12 @@
+from . import (  # noqa: F401
+    activation,
+    creation,
+    einsum_ops,
+    linalg,
+    logic,
+    manipulation,
+    math,
+    random,
+    search,
+    stat,
+)

@@ -1,0 +1,162 @@
+"""Activation functional ops (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import unwrap
+
+
+def _unop(name, fn):
+    def op(x, name=None):
+        return primitive(name, fn, [x])
+
+    op.__name__ = name
+    return op
+
+
+relu = _unop("relu", jax.nn.relu)
+relu6 = _unop("relu6", jax.nn.relu6)
+sigmoid = _unop("sigmoid", jax.nn.sigmoid)
+tanh = _unop("tanh", jnp.tanh)
+silu = _unop("silu", jax.nn.silu)
+swish = silu
+mish = _unop("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+hardswish = _unop("hardswish", jax.nn.hard_swish)
+hardsigmoid = _unop("hardsigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+softsign = _unop("softsign", jax.nn.soft_sign)
+tanhshrink = _unop("tanhshrink", lambda x: x - jnp.tanh(x))
+log_sigmoid = _unop("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    return primitive("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), [x])
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return primitive("leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope), [x])
+
+
+def elu(x, alpha=1.0, name=None):
+    return primitive("elu", lambda v: jax.nn.elu(v, alpha), [x])
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return primitive("selu", lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), [x])
+
+
+def celu(x, alpha=1.0, name=None):
+    return primitive("celu", lambda v: jax.nn.celu(v, alpha), [x])
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+
+    return primitive("prelu", fn, [x, weight])
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    from ..base import global_state
+
+    if training:
+        def fn(v):
+            a = jax.random.uniform(global_state.default_generator.split(), v.shape, v.dtype, lower, upper)
+            return jnp.where(v >= 0, v, a * v)
+    else:
+        mid = (lower + upper) / 2.0
+
+        def fn(v):
+            return jnp.where(v >= 0, v, mid * v)
+
+    return primitive("rrelu", fn, [x])
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return primitive("hardtanh", lambda v: jnp.clip(v, min, max), [x])
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return primitive("hardshrink", lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), [x])
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return primitive(
+        "softshrink",
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)),
+        [x],
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return primitive(
+        "softplus",
+        lambda v: jnp.where(beta * v > threshold, v, jax.nn.softplus(beta * v) / beta),
+        [x],
+    )
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1 :]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return primitive("maxout", fn, [x])
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ..base import dtype as dtype_mod
+
+    def fn(v):
+        if dtype is not None:
+            v = v.astype(dtype_mod.np_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+
+    return primitive("softmax", fn, [x])
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ..base import dtype as dtype_mod
+
+    def fn(v):
+        if dtype is not None:
+            v = v.astype(dtype_mod.np_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return primitive("log_softmax", fn, [x])
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..base import global_state
+
+    def fn(v):
+        g = jax.random.gumbel(global_state.default_generator.split(), v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            hard_y = jnp.zeros_like(y)
+            hard_y = jnp.put_along_axis(hard_y, idx, 1.0, axis=axis, inplace=False)
+            y = hard_y + y - jax.lax.stop_gradient(y)
+        return y
+
+    return primitive("gumbel_softmax", fn, [x])
+
+
+def glu(x, axis=-1, name=None):
+    def fn(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+
+    return primitive("glu", fn, [x])
+
+
+def temperature_scaled_softmax(x, temperature=1.0, axis=-1, name=None):
+    return primitive("temperature_scaled_softmax", lambda v: jax.nn.softmax(v / temperature, axis=axis), [x])

@@ -1,0 +1,192 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py over phi
+full/arange/... kernels — here jnp compositions; XLA materializes on device)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..base import dtype as dtype_mod
+from ..base import global_state
+from ..core.tensor import Tensor, unwrap
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        dtype = default or global_state.default_dtype
+    return dtype_mod.np_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if not isinstance(s, (int, np.integer)) else int(s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        out = Tensor(data._value, dtype=dtype, stop_gradient=stop_gradient)
+        return out
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def tensor(data, dtype=None, place=None, stop_gradient=True):
+    return to_tensor(data, dtype, place, stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill = unwrap(fill_value)
+    if dtype is None and hasattr(fill, "dtype"):
+        return Tensor(jnp.full(_shape(shape), fill))
+    return Tensor(jnp.full(_shape(shape), fill, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(unwrap(x), dtype=dtype_mod.np_dtype(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(unwrap(x), dtype=dtype_mod.np_dtype(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(
+        jnp.full_like(unwrap(x), unwrap(fill_value), dtype=dtype_mod.np_dtype(dtype) if dtype else None)
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) or (hasattr(v, "dtype") and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)) for v in (start, end, step)):
+            dtype = global_state.default_dtype
+        else:
+            dtype = "int64"
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)), base=unwrap(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    v = unwrap(x)
+    if v.ndim == 1 and padding_value != 0:
+        n = v.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, v.dtype)
+        out = base + jnp.diag(v, offset) - jnp.diag(jnp.full_like(v, padding_value), offset)
+        return Tensor(out)
+    return Tensor(jnp.diag(v, offset))
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(unwrap(x), offset))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    v = unwrap(x)
+    n = v.shape[-1] + abs(offset)
+    out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+    idx = jnp.arange(v.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = out.at[..., r, c].set(v)
+    if (dim1, dim2) not in ((-2, -1),):
+        nd = out.ndim
+        dim1, dim2 = dim1 % nd, dim2 % nd
+        perm = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
+        # place the two new axes at dim1/dim2
+        order = list(range(nd - 2))
+        order.insert(min(dim1, dim2), nd - 2)
+        order.insert(max(dim1, dim2), nd - 1)
+        out = jnp.transpose(out, order)
+    return Tensor(out)
+
+
+def tril(x, diagonal=0, name=None):
+    from ..core.dispatch import primitive
+
+    return primitive("tril", lambda v: jnp.tril(v, diagonal), [x])
+
+
+def triu(x, diagonal=0, name=None):
+    from ..core.dispatch import primitive
+
+    return primitive("triu", lambda v: jnp.triu(v, diagonal), [x])
+
+
+def meshgrid(*args, **kwargs):
+    arrays = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    from ..core.dispatch import primitive
+
+    v = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    out = primitive("assign", lambda a: a + 0 if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact) else jnp.asarray(a), [v])
+    if output is not None:
+        output._replace_value(out._value)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def complex(real, imag, name=None):
+    from ..core.dispatch import primitive
+
+    return primitive("complex", lambda r, i: jax_complex(r, i), [real, imag])
+
+
+def jax_complex(r, i):
+    return r + 1j * i
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def one_hot(x, num_classes, name=None):
+    import jax.nn as jnn
+
+    return Tensor(jnn.one_hot(unwrap(x), num_classes, dtype=_dt(None)))

@@ -1,0 +1,14 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) — jnp.einsum maps
+straight onto the MXU via dot_general."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+
+
+def einsum(equation, *operands):
+    ops = list(operands)
+    if len(ops) == 1 and isinstance(ops[0], (list, tuple)):
+        ops = list(ops[0])
+    return primitive("einsum", lambda *vs: jnp.einsum(equation, *vs), ops)

@@ -1,0 +1,240 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py over phi
+lapack/cublas kernels — here jnp.linalg, which XLA lowers natively)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import passthrough, primitive
+from ..core.tensor import Tensor, unwrap
+
+
+def t(input, name=None):
+    def fn(v):
+        if v.ndim < 2:
+            return v
+        return v.T
+
+    return primitive("t", fn, [input])
+
+
+def t_nd(input):
+    """Tensor.T property: full transpose (paddle reverses all dims)."""
+    return primitive("T", lambda v: jnp.transpose(v), [input])
+
+
+def transpose(x, perm, name=None):
+    from .manipulation import transpose as _tr
+
+    return _tr(x, perm)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(v)))
+            return jnp.linalg.norm(v, ord=None, axis=axis if not isinstance(axis, list) else tuple(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=tuple(axis), keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            a = None if axis is None else (axis if not isinstance(axis, list) else tuple(axis))
+            if a is None:
+                return jnp.max(jnp.abs(v))
+            return jnp.linalg.norm(v, ord=jnp.inf, axis=a, keepdims=keepdim)
+        if p == float("-inf") or p == "-inf":
+            a = None if axis is None else (axis if not isinstance(axis, list) else tuple(axis))
+            if a is None:
+                return jnp.min(jnp.abs(v))
+            return jnp.linalg.norm(v, ord=-jnp.inf, axis=a, keepdims=keepdim)
+        a = axis
+        if a is None:
+            return jnp.sum(jnp.abs(v) ** p) ** (1.0 / p)
+        if isinstance(a, list):
+            a = tuple(a)
+        return jnp.linalg.norm(v, ord=p, axis=a, keepdims=keepdim)
+
+    return primitive("norm", fn, [x])
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return primitive("vector_norm", lambda v: jnp.linalg.vector_norm(v, ord=p, axis=ax, keepdims=keepdim), [x])
+
+
+def matrix_norm(x, p="fro", axis=[-2, -1], keepdim=False, name=None):
+    return primitive("matrix_norm", lambda v: jnp.linalg.matrix_norm(v, ord=p, keepdims=keepdim), [x])
+
+
+def dist(x, y, p=2, name=None):
+    return primitive("dist", lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), [x, y])
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def fn(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    return primitive("cdist", fn, [x, y])
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return primitive("cholesky", fn, [x])
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return primitive("cholesky_solve", fn, [x, y])
+
+
+def qr(x, mode="reduced", name=None):
+    out = primitive("qr", lambda v: jnp.linalg.qr(v, mode=mode), [x])
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    return primitive("svd", lambda v: jnp.linalg.svd(v, full_matrices=full_matrices), [x])
+
+
+def svdvals(x, name=None):
+    return primitive("svdvals", lambda v: jnp.linalg.svd(v, compute_uv=False), [x])
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    v = unwrap(x)
+    qq = q or min(6, v.shape[-2], v.shape[-1])
+    if center:
+        v = v - v.mean(axis=-2, keepdims=True)
+    U, S, Vh = jnp.linalg.svd(v, full_matrices=False)
+    return Tensor(U[..., :qq]), Tensor(S[..., :qq]), Tensor(jnp.swapaxes(Vh, -1, -2)[..., :qq])
+
+
+def eig(x, name=None):
+    import numpy as np
+
+    w, v = np.linalg.eig(np.asarray(unwrap(x)))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return primitive("eigh", lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), [x])
+
+
+def eigvals(x, name=None):
+    import numpy as np
+
+    w = np.linalg.eigvals(np.asarray(unwrap(x)))
+    return Tensor(jnp.asarray(w))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return primitive("eigvalsh", lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), [x])
+
+
+def inv(x, name=None):
+    return primitive("inv", jnp.linalg.inv, [x])
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return primitive("pinv", lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), [x])
+
+
+def solve(x, y, name=None):
+    return primitive("solve", lambda a, b: jnp.linalg.solve(a, b), [x, y])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return primitive("triangular_solve", fn, [x, y])
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol = primitive("lstsq", lambda a, b: jnp.linalg.lstsq(a, b, rcond=rcond)[0], [x, y])
+    v, w = unwrap(x), unwrap(y)
+    res = jnp.sum(jnp.square(w - v @ unwrap(sol)), axis=-2)
+    rank = jnp.linalg.matrix_rank(v)
+    s = jnp.linalg.svd(v, compute_uv=False)
+    return sol, Tensor(res), Tensor(rank), Tensor(s)
+
+
+def det(x, name=None):
+    return primitive("det", jnp.linalg.det, [x])
+
+
+def slogdet(x, name=None):
+    out = primitive("slogdet", lambda v: tuple(jnp.linalg.slogdet(v)), [x])
+    return out
+
+
+def matrix_power(x, n, name=None):
+    return primitive("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), [x])
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return passthrough("matrix_rank", lambda v: jnp.linalg.matrix_rank(v, tol=tol), [x])
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+    lu_t, piv = primitive("lu", fn, [x])
+    piv.stop_gradient = True
+    if get_infos:
+        info = Tensor(jnp.zeros(unwrap(x).shape[:-2], jnp.int32))
+        return lu_t, piv, info
+    return lu_t, piv
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return primitive("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), [x])
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = unwrap(fweights) if fweights is not None else None
+    aw = unwrap(aweights) if aweights is not None else None
+    return primitive(
+        "cov", lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw), [x]
+    )
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    from .math import matmul as _mm
+
+    return _mm(x, y, transpose_x, transpose_y)
+
+
+def multi_dot(x, name=None):
+    return primitive("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs), list(x))
+
+
+def householder_product(x, tau, name=None):
+    def fn(v, tv):
+        m, n = v.shape[-2], v.shape[-1]
+        Q = jnp.eye(m, dtype=v.dtype)
+        Q = jnp.broadcast_to(Q, v.shape[:-2] + (m, m)).copy() if v.ndim > 2 else Q
+
+        def body(i, Q):
+            w = jnp.where(jnp.arange(m) < i, 0.0, v[..., :, i])
+            w = w.at[..., i].set(1.0)
+            H = jnp.eye(m, dtype=v.dtype) - tv[..., i][..., None, None] * (w[..., :, None] * w[..., None, :])
+            return Q @ H
+
+        for i in range(n):
+            Q = body(i, Q)
+        return Q[..., :, :n]
+
+    return primitive("householder_product", fn, [x, tau])

@@ -1,0 +1,70 @@
+"""Comparison/logical/bitwise ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import passthrough
+from ..core.tensor import Tensor, unwrap
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return passthrough(name, fn, [x, y])
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", lambda a, b: jnp.equal(a, b))
+not_equal = _cmp("not_equal", lambda a, b: jnp.not_equal(a, b))
+greater_than = _cmp("greater_than", lambda a, b: jnp.greater(a, b))
+greater_equal = _cmp("greater_equal", lambda a, b: jnp.greater_equal(a, b))
+less_than = _cmp("less_than", lambda a, b: jnp.less(a, b))
+less_equal = _cmp("less_equal", lambda a, b: jnp.less_equal(a, b))
+logical_and = _cmp("logical_and", lambda a, b: jnp.logical_and(a, b))
+logical_or = _cmp("logical_or", lambda a, b: jnp.logical_or(a, b))
+logical_xor = _cmp("logical_xor", lambda a, b: jnp.logical_xor(a, b))
+bitwise_and = _cmp("bitwise_and", lambda a, b: jnp.bitwise_and(a, b))
+bitwise_or = _cmp("bitwise_or", lambda a, b: jnp.bitwise_or(a, b))
+bitwise_xor = _cmp("bitwise_xor", lambda a, b: jnp.bitwise_xor(a, b))
+bitwise_left_shift = _cmp("bitwise_left_shift", lambda a, b: jnp.left_shift(a, b))
+bitwise_right_shift = _cmp("bitwise_right_shift", lambda a, b: jnp.right_shift(a, b))
+
+
+def logical_not(x, name=None):
+    return passthrough("logical_not", jnp.logical_not, [x])
+
+
+def bitwise_not(x, name=None):
+    return passthrough("bitwise_not", jnp.bitwise_not, [x])
+
+
+def equal_all(x, y, name=None):
+    return passthrough("equal_all", lambda a, b: jnp.array_equal(a, b), [x, y])
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return passthrough(
+        "allclose", lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), [x, y]
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return passthrough(
+        "isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), [x, y]
+    )
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(unwrap(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def in1d(x, test_x, assume_unique=False, invert=False, name=None):
+    return passthrough("isin", lambda a, b: jnp.isin(a, b, invert=invert), [x, test_x])
+
+
+isin = in1d

@@ -1,0 +1,541 @@
+"""Shape/layout/indexing ops (reference: python/paddle/tensor/manipulation.py
+and variable_index.py — rebuilt on jnp; views are functional under XLA, and
+"inplace" setitem swaps the payload with a scatter update)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..base import dtype as dtype_mod
+from ..base.enforce import enforce
+from ..core.dispatch import passthrough, primitive
+from ..core.tensor import Tensor, unwrap
+
+_builtin_slice = slice  # `slice` is shadowed below by the paddle.slice op
+
+
+def cast(x, dtype):
+    npd = dtype_mod.np_dtype(dtype)
+    src = unwrap(x)
+    if src.dtype == npd:
+        return x if isinstance(x, Tensor) else Tensor(src)
+    was_float = jnp.issubdtype(src.dtype, jnp.inexact)
+    to_float = jnp.issubdtype(jnp.empty((), npd).dtype, jnp.inexact)
+    if was_float and to_float:
+        return primitive("cast", lambda v: v.astype(npd), [x])
+    return passthrough("cast", lambda v: v.astype(npd), [x])
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = tuple(int(s) for s in shape)
+    return primitive("reshape", lambda v: jnp.reshape(v, shape), [x])
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._replace_value(out._value)
+    x._grad_node = out._grad_node
+    x._output_index = out._output_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return primitive("transpose", lambda v: jnp.transpose(v, perm), [x])
+
+
+def moveaxis(x, source, destination, name=None):
+    return primitive("moveaxis", lambda v: jnp.moveaxis(v, source, destination), [x])
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return primitive("swapaxes", lambda v: jnp.swapaxes(v, axis0, axis1), [x])
+
+
+swapdims = swapaxes
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    tensors = list(x)
+    return primitive("concat", lambda *vs: jnp.concatenate(vs, axis=axis), tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return primitive("stack", lambda *vs: jnp.stack(vs, axis=axis), tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    dim = unwrap(x).shape[axis]
+    if isinstance(num_or_sections, int):
+        enforce(dim % num_or_sections == 0 or num_or_sections in (-1,), f"cannot split dim {dim} into {num_or_sections}")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(unwrap(s)) for s in num_or_sections]
+        n_unknown = builtins_sum(1 for s in sections if s < 0)
+        enforce(n_unknown <= 1, "at most one section may be -1")
+        if n_unknown:
+            known = builtins_sum(s for s in sections if s >= 0)
+            sections = [s if s >= 0 else dim - known for s in sections]
+    offsets = np.cumsum([0] + sections)
+    out = primitive(
+        "split",
+        lambda v: tuple(jnp.take(v, jnp.arange(offsets[i], offsets[i + 1]), axis=axis) for i in range(len(sections))),
+        [x],
+    )
+    return list(out)
+
+
+def builtins_sum(it):
+    tot = 0
+    for v in it:
+        tot += v
+    return tot
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(input, axis=0, name=None):
+    n = unwrap(input).shape[axis]
+    out = primitive(
+        "unbind",
+        lambda v: tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis)),
+        [input],
+    )
+    return list(out)
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return primitive("squeeze", fn, [x])
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(unwrap(a)) if isinstance(a, Tensor) else int(a) for a in axes]
+
+    def fn(v):
+        out = v
+        for a in sorted([a if a >= 0 else a + v.ndim + len(axes) for a in axes]):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return primitive("unsqueeze", fn, [x])
+
+
+squeeze_ = squeeze
+unsqueeze_ = unsqueeze
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(v):
+        nd = v.ndim
+        if nd == 0:
+            return v.reshape(1)
+        s = start_axis % nd
+        e = stop_axis % nd
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1 :]
+        return v.reshape(new_shape)
+
+    return primitive("flatten", fn, [x])
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in shape]
+
+    def fn(v):
+        tgt = list(shape)
+        off = len(tgt) - v.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - off]
+        return jnp.broadcast_to(v, tgt)
+
+    return primitive("expand", fn, [x])
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return expand(x, list(unwrap(y).shape))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(input, name=None):
+    shapes = [tuple(unwrap(t).shape) for t in input]
+    tgt = np.broadcast_shapes(*shapes)
+    return [expand(t, list(tgt)) for t in input]
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    reps = tuple(int(unwrap(r)) if isinstance(r, Tensor) else int(r) for r in repeat_times)
+    return primitive("tile", lambda v: jnp.tile(v, reps), [x])
+
+
+def roll(x, shifts, axis=None, name=None):
+    return primitive("roll", lambda v: jnp.roll(v, shifts, axis=axis), [x])
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return primitive("flip", lambda v: jnp.flip(v, axis=tuple(axes)), [x])
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return primitive("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), [x])
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(unwrap(axis)) if not isinstance(axis, int) else axis
+
+    def fn(v, idx):
+        return jnp.take(v, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis)
+
+    return primitive("gather", fn, [x, index])
+
+
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        # idx [..., k] indexes first k dims of v
+        k = idx.shape[-1]
+        out = v[tuple(jnp.moveaxis(idx, -1, 0))] if k == v.ndim else v[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return primitive("gather_nd", fn, [x, index])
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, idx, upd):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return v.at[idx].set(upd)
+        # paddle overwrite=False: zero target rows then add
+        zeroed = v.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+
+    return primitive("scatter", fn, [x, index, updates])
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._replace_value(out._value)
+    x._grad_node = out._grad_node
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, idx, upd):
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return primitive("scatter_nd_add", fn, [x, index, updates])
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    z = zeros(shape, dtype=unwrap(updates).dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return primitive("index_select", lambda v, i: jnp.take(v, i, axis=axis), [x, index])
+
+
+def index_sample(x, index):
+    def fn(v, idx):
+        return jnp.take_along_axis(v, idx, axis=1)
+
+    return primitive("index_sample", fn, [x, index])
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(v, idx, val):
+        sl = [_builtin_slice(None)] * v.ndim
+        vm = jnp.moveaxis(v, axis, 0)
+        out = vm.at[idx].add(jnp.moveaxis(val, axis, 0))
+        return jnp.moveaxis(out, 0, axis)
+
+    return primitive("index_add", fn, [x, index, value])
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idxs = tuple(unwrap(i) for i in indices)
+
+    def fn(v, val):
+        return v.at[idxs].add(val) if accumulate else v.at[idxs].set(val)
+
+    return primitive("index_put", fn, [x, value])
+
+
+def index_fill(x, index, axis, fill_value, name=None):
+    def fn(v, idx):
+        vm = jnp.moveaxis(v, axis, 0)
+        out = vm.at[idx].set(jnp.asarray(fill_value, v.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return primitive("index_fill", fn, [x, index])
+
+
+def masked_select(x, mask, name=None):
+    v, m = unwrap(x), unwrap(mask)
+    return Tensor(v[m])  # dynamic shape: eager-only
+
+
+def masked_fill(x, mask, value, name=None):
+    def fn(v, m, val):
+        return jnp.where(m, jnp.asarray(val, v.dtype), v)
+
+    return primitive("masked_fill", fn, [x, mask, value])
+
+
+def masked_scatter(x, mask, value, name=None):
+    v, m, val = unwrap(x), unwrap(mask), unwrap(value)
+    flat_val = val.reshape(-1)[: int(m.sum())]
+    out = np.asarray(v).copy()
+    out[np.asarray(m)] = np.asarray(flat_val)
+    return Tensor(jnp.asarray(out))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+
+        return nonzero(condition, as_tuple=True)
+    return primitive("where", lambda c, a, b: jnp.where(c, a, b), [condition, x, y])
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def fn(v, idx):
+        return jnp.take_along_axis(v, idx, axis=axis)
+
+    return primitive("take_along_axis", fn, [arr, indices])
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):
+    def _scatter_along_axis(v, idx, val, ax, op):
+        """Row-flattened scatter along one axis supporting set/add/mul/min/max."""
+        vm = jnp.moveaxis(v, ax, -1)
+        im = jnp.moveaxis(idx, ax, -1)
+        valm = jnp.moveaxis(jnp.broadcast_to(val, idx.shape), ax, -1)
+        flat_v = vm.reshape(-1, vm.shape[-1])
+        flat_im = im.reshape(-1, im.shape[-1])
+        flat_val = valm.reshape(-1, valm.shape[-1]).astype(v.dtype)
+        rows = jnp.arange(flat_v.shape[0])[:, None]
+        ref = flat_v.at[rows, flat_im]
+        out = getattr(ref, op)(flat_val)
+        return jnp.moveaxis(out.reshape(vm.shape), -1, ax)
+
+    opname = {"assign": "set", "add": "add", "multiply": "multiply", "mul": "multiply", "amin": "min", "amax": "max"}[reduce]
+
+    def fn(v, idx, val):
+        if not hasattr(val, "ndim") or getattr(val, "ndim", 0) == 0:
+            val = jnp.broadcast_to(jnp.asarray(val, v.dtype), idx.shape)
+        return _scatter_along_axis(v, idx, val, axis, opname)
+
+    return primitive("put_along_axis", fn, [arr, indices, values])
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = unwrap(repeats)
+        total = int(reps.sum())
+        return primitive(
+            "repeat_interleave",
+            lambda v, r: jnp.repeat(v, r, axis=axis, total_repeat_length=total),
+            [x, repeats],
+        )
+    return primitive("repeat_interleave", lambda v: jnp.repeat(v, repeats, axis=axis), [x])
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    v = unwrap(x)  # dynamic shape: eager-only
+    res = jnp.unique(v, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(res)
+    outs = [Tensor(r) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    v = np.asarray(unwrap(x))
+    if axis is None:
+        v = v.reshape(-1)
+        keep = np.concatenate([[True], v[1:] != v[:-1]])
+        out = v[keep]
+        results = [Tensor(jnp.asarray(out))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            results.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, v.size))
+            results.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+        return results[0] if len(results) == 1 else tuple(results)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True, name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    v = unwrap(x)
+    nd = v.ndim
+    if len(pad) == 2 * nd:
+        # full-rank paddle.nn.functional.pad flat list: [d0_lo, d0_hi, d1_lo, ...]
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        if not pad_from_left_axis:
+            pairs = pairs[::-1]
+    else:
+        # partial spec applies to trailing spatial dims (torch-style, used by F.pad)
+        k = len(pad) // 2
+        pairs = [(0, 0)] * (nd - k) + [(pad[2 * i], pad[2 * i + 1]) for i in range(k)][::-1]
+        if data_format in ("NHWC", "NLC", "NDHWC") and nd >= 3:
+            # channel-last: spatial dims are 1..nd-2
+            sp = [(pad[2 * i], pad[2 * i + 1]) for i in range(k)][::-1]
+            pairs = [(0, 0)] + sp + [(0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+
+    def fn(v):
+        if jmode == "constant":
+            return jnp.pad(v, pairs, mode="constant", constant_values=value)
+        return jnp.pad(v, pairs, mode=jmode)
+
+    return primitive("pad", fn, [x])
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(v):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        in_shard = (v >= lo) & (v < lo + shard_size)
+        return jnp.where(in_shard, v - lo, ignore_value)
+
+    return passthrough("shard_index", fn, [input])
+
+
+def numel(x, name=None):
+    return passthrough("numel", lambda v: jnp.asarray(v.size, jnp.int32), [x])
+
+
+def as_complex(x, name=None):
+    return primitive("as_complex", lambda v: jax_lax_complex(v), [x])
+
+
+def jax_lax_complex(v):
+    return v[..., 0] + 1j * v[..., 1]
+
+
+def as_real(x, name=None):
+    return primitive("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), [x])
+
+
+def tensordot(x, y, axes=2, name=None):
+    return primitive("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), [x, y])
+
+
+def tolist(x):
+    return unwrap(x).tolist()
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    v = unwrap(x)
+    if shape is None:
+        return x
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [v.shape[i] if s == -1 else int(s) for i, s in enumerate(shape)]
+    if offsets is None:
+        offsets = [0] * v.ndim
+    elif isinstance(offsets, Tensor):
+        offsets = offsets.tolist()
+    sl = tuple(_builtin_slice(int(o), int(o) + int(s)) for o, s in zip(offsets, shape))
+    return primitive("crop", lambda v: v[sl], [x])
+
+
+# ---------------------------------------------------------------- indexing
+def _normalize_index(idx):
+    if isinstance(idx, Tensor):
+        return unwrap(idx)
+    if isinstance(idx, (list,)) and any(isinstance(e, Tensor) for e in idx):
+        return jnp.asarray([unwrap(e) for e in idx])
+    if isinstance(idx, tuple):
+        return tuple(_normalize_index(e) for e in idx)
+    if isinstance(idx, _builtin_slice):
+        def c(v):
+            if isinstance(v, Tensor):
+                return int(v.item())
+            return v
+        return _builtin_slice(c(idx.start), c(idx.stop), c(idx.step))
+    return idx
+
+
+def getitem(x, idx):
+    jidx = _normalize_index(idx)
+
+    def fn(v):
+        return v[jidx]
+
+    return primitive("getitem", fn, [x])
+
+
+def setitem_(x, idx, value):
+    jidx = _normalize_index(idx)
+
+    def fn(v, val):
+        return v.at[jidx].set(val.astype(v.dtype) if hasattr(val, "astype") else val)
+
+    out = primitive("setitem", fn, [x, value if isinstance(value, Tensor) else Tensor(value)])
+    x._replace_value(out._value)
+    x._grad_node = out._grad_node
+    x._output_index = out._output_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def slice(input, axes, starts, ends):  # noqa: A001 — paddle.slice API name
+    v = unwrap(input)
+    idx = [_builtin_slice(None)] * v.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(unwrap(s)) if isinstance(s, Tensor) else int(s)
+        e = int(unwrap(e)) if isinstance(e, Tensor) else int(e)
+        idx[int(ax)] = _builtin_slice(s, e)
+    t = tuple(idx)
+    return primitive("slice", lambda v: v[t], [input])
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    v = unwrap(x)
+    idx = [_builtin_slice(None)] * v.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[int(ax)] = _builtin_slice(int(unwrap(s)), int(unwrap(e)), int(unwrap(st)))
+    t = tuple(idx)
+    return primitive("strided_slice", lambda v: v[t], [x])

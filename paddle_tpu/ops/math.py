@@ -1,0 +1,450 @@
+"""Math ops (reference: python/paddle/tensor/math.py over phi kernels —
+rebuilt as jnp/lax compositions dispatched through the autograd tape).
+
+Paddle broadcasting/type-promotion semantics ride on jnp. Every op funnels
+through core.dispatch.primitive so AMP, NaN-checking, and the tape apply.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import passthrough, primitive
+from ..core.tensor import Tensor, unwrap
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------------------------------------------------------- binary
+def _binop(name, fn):
+    def op(x, y, name=None):
+        return primitive(name, fn, [x, y])
+
+    op.__name__ = name
+    return op
+
+
+add = _binop("add", lambda x, y: jnp.add(x, y))
+subtract = _binop("subtract", lambda x, y: jnp.subtract(x, y))
+multiply = _binop("multiply", lambda x, y: jnp.multiply(x, y))
+divide = _binop("divide", lambda x, y: jnp.true_divide(x, y))
+floor_divide = _binop("floor_divide", lambda x, y: jnp.floor_divide(x, y))
+mod = _binop("mod", lambda x, y: jnp.mod(x, y))
+remainder = mod
+floor_mod = mod
+pow = _binop("pow", lambda x, y: jnp.power(x, y))
+maximum = _binop("maximum", lambda x, y: jnp.maximum(x, y))
+minimum = _binop("minimum", lambda x, y: jnp.minimum(x, y))
+fmax = _binop("fmax", lambda x, y: jnp.fmax(x, y))
+fmin = _binop("fmin", lambda x, y: jnp.fmin(x, y))
+atan2 = _binop("atan2", lambda x, y: jnp.arctan2(x, y))
+hypot = _binop("hypot", lambda x, y: jnp.hypot(x, y))
+logaddexp = _binop("logaddexp", lambda x, y: jnp.logaddexp(x, y))
+heaviside = _binop("heaviside", lambda x, y: jnp.heaviside(x, y))
+copysign = _binop("copysign", lambda x, y: jnp.copysign(x, y))
+nextafter = _binop("nextafter", lambda x, y: jnp.nextafter(x, y))
+gcd = _binop("gcd", lambda x, y: jnp.gcd(x, y))
+lcm = _binop("lcm", lambda x, y: jnp.lcm(x, y))
+inner = _binop("inner", lambda x, y: jnp.inner(x, y))
+outer = _binop("outer", lambda x, y: jnp.outer(x, y))
+kron = _binop("kron", lambda x, y: jnp.kron(x, y))
+
+
+def divide_no_nan(x, y, name=None):
+    return primitive("divide_no_nan", lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)), [x, y])
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return primitive("matmul", fn, [x, y])
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return primitive("dot", lambda a, b: jnp.sum(a * b, axis=-1), [x, y])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return primitive(
+        "addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), [input, x, y]
+    )
+
+
+def lerp(x, y, weight, name=None):
+    return primitive("lerp", lambda a, b, w: a + w * (b - a), [x, y, weight])
+
+
+def multiplex(inputs, index, name=None):
+    def fn(idx, *ins):
+        stacked = jnp.stack(ins, axis=0)  # [n, batch, ...]
+        return jnp.take_along_axis(stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0)[0]
+
+    return primitive("multiplex", lambda idx, *ins: fn(idx, *ins), [index] + list(inputs))
+
+
+# ---------------------------------------------------------------- unary
+def _unop(name, fn):
+    def op(x, name=None):
+        return primitive(name, fn, [x])
+
+    op.__name__ = name
+    return op
+
+
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", lambda x: jax.lax.rsqrt(x))
+abs = _unop("abs", jnp.abs)
+neg = _unop("neg", jnp.negative)
+sign = _unop("sign", jnp.sign)
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+round = _unop("round", jnp.round)
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda x: x - jnp.trunc(x))
+reciprocal = _unop("reciprocal", lambda x: 1.0 / x)
+square = _unop("square", jnp.square)
+erf = _unop("erf", lambda x: jax.scipy.special.erf(x))
+erfinv = _unop("erfinv", lambda x: jax.scipy.special.erfinv(x))
+lgamma = _unop("lgamma", lambda x: jax.scipy.special.gammaln(x))
+digamma = _unop("digamma", lambda x: jax.scipy.special.digamma(x))
+i0 = _unop("i0", lambda x: jax.scipy.special.i0(x))
+i0e = _unop("i0e", lambda x: jax.scipy.special.i0e(x))
+i1 = _unop("i1", lambda x: jax.scipy.special.i1(x))
+i1e = _unop("i1e", lambda x: jax.scipy.special.i1e(x))
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+sigmoid = _unop("sigmoid", lambda x: jax.nn.sigmoid(x))
+logit = _unop("logit", lambda x: jnp.log(x / (1 - x)))
+exponential_ = None  # defined in random ops
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = unwrap(min) if min is not None else None
+    hi = unwrap(max) if max is not None else None
+    return primitive("clip", lambda v: jnp.clip(v, lo, hi), [x])
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return primitive("nan_to_num", lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), [x])
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return primitive("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), [x])
+
+
+def rint(x, name=None):
+    return primitive("rint", jnp.rint, [x])
+
+
+# ---------------------------------------------------------------- reductions
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..base.dtype import np_dtype
+
+    ax = _axis(axis)
+    dt = np_dtype(dtype) if dtype else None
+    return primitive("sum", lambda v: jnp.sum(v, axis=ax, dtype=dt, keepdims=keepdim), [x])
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return primitive("mean", lambda v: jnp.mean(v, axis=ax, keepdims=keepdim), [x])
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return primitive("max", lambda v: jnp.max(v, axis=ax, keepdims=keepdim), [x])
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return primitive("min", lambda v: jnp.min(v, axis=ax, keepdims=keepdim), [x])
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..base.dtype import np_dtype
+
+    ax = _axis(axis)
+    dt = np_dtype(dtype) if dtype else None
+    return primitive("prod", lambda v: jnp.prod(v, axis=ax, dtype=dt, keepdims=keepdim), [x])
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return primitive("logsumexp", lambda v: jax.scipy.special.logsumexp(v, axis=ax, keepdims=keepdim), [x])
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return primitive("nansum", lambda v: jnp.nansum(v, axis=ax, keepdims=keepdim), [x])
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return primitive("nanmean", lambda v: jnp.nanmean(v, axis=ax, keepdims=keepdim), [x])
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return passthrough("count_nonzero", lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim).astype(jnp.int32), [x])
+
+
+# ---------------------------------------------------------------- scans
+def cumsum(x, axis=None, dtype=None, name=None):
+    from ..base.dtype import np_dtype
+
+    dt = np_dtype(dtype) if dtype else None
+
+    def fn(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=dt)
+        return jnp.cumsum(v, axis=int(axis), dtype=dt)
+
+    return primitive("cumsum", fn, [x])
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    from ..base.dtype import np_dtype
+
+    dt = np_dtype(dtype) if dtype else None
+
+    def fn(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=dt)
+        return jnp.cumprod(v, axis=int(dim), dtype=dt)
+
+    return primitive("cumprod", fn, [x])
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=a)
+        return vals
+
+    vals = primitive("cummax", fn, [x])
+    # indices: argmax of running max == position where value changed
+    v = unwrap(x)
+    a = 0 if axis is None else int(axis)
+    vv = v.reshape(-1) if axis is None else v
+    vals_arr = unwrap(vals)
+    idx = jnp.arange(vv.shape[a]).reshape([-1 if i == a else 1 for i in range(vv.ndim)])
+    eq = vv == vals_arr
+    inds = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, idx, -1), axis=a)
+    from ..base.dtype import np_dtype
+
+    return vals, Tensor(inds.astype(np_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    neg = multiply(x, -1) if isinstance(x, Tensor) else Tensor(-unwrap(x))
+    vals, inds = cummax(neg, axis=axis, dtype=dtype)
+    return multiply(vals, -1), inds
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=a)
+
+    return primitive("logcumsumexp", fn, [x])
+
+
+# ---------------------------------------------------------------- predicates
+def isnan(x, name=None):
+    return passthrough("isnan", jnp.isnan, [x])
+
+
+def isinf(x, name=None):
+    return passthrough("isinf", jnp.isinf, [x])
+
+
+def isfinite(x, name=None):
+    return passthrough("isfinite", jnp.isfinite, [x])
+
+
+def isneginf(x, name=None):
+    return passthrough("isneginf", jnp.isneginf, [x])
+
+
+def isposinf(x, name=None):
+    return passthrough("isposinf", jnp.isposinf, [x])
+
+
+def isreal(x, name=None):
+    return passthrough("isreal", jnp.isreal, [x])
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return passthrough("all", lambda v: jnp.all(v, axis=ax, keepdims=keepdim), [x])
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return passthrough("any", lambda v: jnp.any(v, axis=ax, keepdims=keepdim), [x])
+
+
+# ---------------------------------------------------------------- misc
+def assign(x, output=None):
+    from .creation import assign as _assign
+
+    return _assign(x, output)
+
+
+def increment(x, value=1.0, name=None):
+    x._replace_value(unwrap(x) + value)
+    return x
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+
+    def fn(v):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+
+    out = primitive("scale", fn, [x])
+    if act is not None:
+        from . import activation as act_ops
+
+        out = getattr(act_ops, act)(out)
+    return out
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return primitive("trace", lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), [x])
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return primitive("diagonal", lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), [x])
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = unwrap(prepend) if prepend is not None else None
+    app = unwrap(append) if append is not None else None
+    return primitive("diff", lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app), [x])
+
+
+def cross(x, y, axis=None, name=None):
+    ax = -1 if axis is None else axis
+    return primitive("cross", lambda a, b: jnp.cross(a, b, axis=ax), [x, y])
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    v = unwrap(input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (float(jnp.min(v)), float(jnp.max(v)))
+    h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+    return Tensor(h.astype(jnp.int32))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    v = unwrap(x)
+    w = unwrap(weights) if weights is not None else None
+    n = int(jnp.max(v)) + 1 if v.size else 0
+    length = builtins_max(n, minlength)
+    return Tensor(jnp.bincount(v, w, length=length))
+
+
+def builtins_max(a, b):
+    return a if a > b else b
+
+
+def take(x, index, mode="raise", name=None):
+    return primitive("take", lambda v, i: jnp.take(v.reshape(-1), i, mode="clip" if mode != "wrap" else "wrap"), [x, index])
+
+
+def clip_by_norm(x, max_norm, name=None):
+    def fn(v):
+        norm = jnp.sqrt(jnp.sum(v * v))
+        return jnp.where(norm > max_norm, v * (max_norm / norm), v)
+
+    return primitive("clip_by_norm", fn, [x])
+
+
+def rsqrt_(x):
+    x._replace_value(jax.lax.rsqrt(unwrap(x)))
+    return x
+
+
+# inplace variants (reference: *_ ops) — functional swap of the payload
+def _make_inplace(op):
+    def inplace(x, *args, **kwargs):
+        out = op(x, *args, **kwargs)
+        x._replace_value(out._value)
+        x._grad_node = out._grad_node
+        x._output_index = out._output_index
+        x.stop_gradient = out.stop_gradient
+        return x
+
+    return inplace
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+multiply_ = _make_inplace(multiply)
+divide_ = _make_inplace(divide)
+clip_ = _make_inplace(clip)
+scale_ = _make_inplace(scale)
+exp_ = _make_inplace(exp)
+sqrt_ = _make_inplace(sqrt)
+tanh_ = _make_inplace(tanh)
+floor_ = _make_inplace(floor)
+ceil_ = _make_inplace(ceil)
+round_ = _make_inplace(round)
+neg_ = _make_inplace(neg)
+abs_ = _make_inplace(abs)
+sin_ = _make_inplace(sin)
+cos_ = _make_inplace(cos)

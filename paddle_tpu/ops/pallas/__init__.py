@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for the fusion tier (reference analog:
+paddle/phi/kernels/fusion/*.cu). Each module exposes ``available()`` plus the
+op; callers fall back to XLA compositions when unavailable (CPU tests)."""
+from . import flash_attention, rms_norm  # noqa: F401

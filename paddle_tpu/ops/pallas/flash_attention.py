@@ -1,0 +1,146 @@
+"""Flash attention Pallas TPU kernel.
+
+TPU-native replacement for the reference's FlashAttention-2 integration
+(third_party/flashattn + paddle/phi/kernels/gpu/flash_attn_kernel.cu): an
+online-softmax tiled kernel. Forward runs in Pallas (MXU matmuls on
+[block_q, d] x [d, block_k] tiles, f32 accumulators in VMEM); backward uses
+recompute + the XLA composition's VJP (a Pallas backward lands in a later
+round — XLA's fused backward is already bandwidth-bound-competitive).
+
+Layout: [batch, seq, heads, head_dim] (paddle convention), internally
+[batch*heads, seq, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...base.flags import get_flag
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def available() -> bool:
+    return get_flag("use_pallas_kernels") and _on_tpu()
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k, seq_k):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)  # q-block index
+    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+    d = q.shape[-1]
+    nk = seq_k // block_k
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    q_pos = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        s = s * scale
+        if causal:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only k-blocks with k_start <= q_block_end contribute
+        nk_eff = jnp.minimum(nk, ((j + 1) * block_q + block_k - 1) // block_k)
+    else:
+        nk_eff = nk
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret"))
+def _flash_fwd(q, k, v, causal, scale, block_q=256, block_k=512, interpret=False):
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qt = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
+    kt = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
+    vt = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
+
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    while sq % block_q:
+        block_q //= 2
+    while sk % block_k:
+        block_k //= 2
+    block_q = max(block_q, 1)
+    block_k = max(block_k, 1)
+
+    grid = (b * h, sq // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_k=sk
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.moveaxis(out.reshape(b, h, sq, d), 1, 2)
+
+
+def _xla_reference(q, k, v, causal, scale):
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool), t - s)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_value(q, k, v, causal=False, scale=1.0, interpret=False):
+    return _flash_fwd(q, k, v, causal, scale, interpret=interpret)
+
+
+def _fa_fwd(q, k, v, causal, scale, interpret):
+    return _flash_fwd(q, k, v, causal, scale, interpret=interpret), (q, k, v)
+
+
+def _fa_bwd(causal, scale, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _xla_reference(q, k, v, causal, scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention_value.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention_interpret_test(q, k, v, causal):
+    """Test hook: run the pallas kernel in interpret mode on CPU."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_fwd(q, k, v, causal, scale, interpret=True)

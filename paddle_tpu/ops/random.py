@@ -1,0 +1,154 @@
+"""Random ops (reference: python/paddle/tensor/random.py over phi RNG kernels).
+
+TPU-native design: all draws split the global Generator's PRNG key
+(paddle_tpu/base/global_state.py), which the jit functionalizer treats as
+mutable state so compiled steps advance the stream correctly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype as dtype_mod
+from ..base import global_state
+from ..core.tensor import Tensor, unwrap
+
+
+def _dt(dtype, default=None):
+    return dtype_mod.np_dtype(dtype or default or global_state.default_dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _key():
+    return global_state.default_generator.split()
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_key(), _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype, name)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = unwrap(mean) if isinstance(mean, Tensor) else mean
+        s = unwrap(std) if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(_key(), shp) * s + m)
+    return Tensor(jax.random.normal(_key(), _shape(shape or [1])) * std + mean)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._replace_value(
+        jax.random.uniform(_key(), tuple(unwrap(x).shape), unwrap(x).dtype, minval=min, maxval=max)
+    )
+    return x
+
+
+def randint(low=0, high=None, shape=[1], dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(), _shape(shape), low, high, _dt(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    v = unwrap(x)
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(), v.shape, low, high, _dt(dtype, str(v.dtype))))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_key(), n).astype(_dt(dtype)))
+
+
+def shuffle(x, axis=0):
+    return Tensor(jax.random.permutation(_key(), unwrap(x), axis=axis, independent=False))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = unwrap(x)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(_key(), logits, axis=-1, shape=(num_samples,) + v.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(_key(), v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int32))
+
+
+def bernoulli(x, name=None):
+    v = unwrap(x)
+    return Tensor(jax.random.bernoulli(_key(), v).astype(v.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    v = unwrap(x)
+    x._replace_value(jax.random.bernoulli(_key(), p, v.shape).astype(v.dtype))
+    return x
+
+
+def poisson(x, name=None):
+    v = unwrap(x)
+    return Tensor(jax.random.poisson(_key(), v).astype(v.dtype))
+
+
+def binomial(count, prob, name=None):
+    c, p = unwrap(count), unwrap(prob)
+    return Tensor(jax.random.binomial(_key(), c.astype(jnp.float32), p).astype(jnp.int32))
+
+
+def exponential_(x, lam=1.0, name=None):
+    v = unwrap(x)
+    x._replace_value(jax.random.exponential(_key(), v.shape, v.dtype) / lam)
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    v = unwrap(x)
+    x._replace_value(loc + scale * jax.random.cauchy(_key(), v.shape, v.dtype))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    v = unwrap(x)
+    u = jax.random.uniform(_key(), v.shape, v.dtype, minval=1e-7)
+    x._replace_value(jnp.ceil(jnp.log(u) / jnp.log1p(-probs)))
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    v = unwrap(x)
+    x._replace_value(jnp.exp(mean + std * jax.random.normal(_key(), v.shape, v.dtype)))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    v = unwrap(x)
+    x._replace_value(mean + std * jax.random.normal(_key(), v.shape, v.dtype))
+    return x

@@ -1,0 +1,152 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype as dtype_mod
+from ..core.dispatch import passthrough, primitive
+from ..core.tensor import Tensor, unwrap
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    npd = dtype_mod.np_dtype(dtype)
+
+    def fn(v):
+        if axis is None:
+            out = jnp.argmax(v.reshape(-1))
+            return out.reshape((1,) * v.ndim).astype(npd) if keepdim else out.astype(npd)
+        out = jnp.argmax(v, axis=int(axis), keepdims=keepdim)
+        return out.astype(npd)
+
+    return passthrough("argmax", fn, [x])
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    npd = dtype_mod.np_dtype(dtype)
+
+    def fn(v):
+        if axis is None:
+            out = jnp.argmin(v.reshape(-1))
+            return out.reshape((1,) * v.ndim).astype(npd) if keepdim else out.astype(npd)
+        return jnp.argmin(v, axis=int(axis), keepdims=keepdim).astype(npd)
+
+    return passthrough("argmin", fn, [x])
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int32)
+
+    return passthrough("argsort", fn, [x])
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        out = jnp.sort(v, axis=axis, stable=stable, descending=descending)
+        return out
+
+    return primitive("sort", fn, [x])
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(unwrap(k)) if isinstance(k, Tensor) else int(k)
+    ax = -1 if axis is None else int(axis)
+
+    def fn(v):
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            vals, idx = jax.lax.top_k(-vm, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int32), -1, ax)
+
+    vals, idx = primitive("topk", fn, [x])
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        vm = jnp.sort(v, axis=axis)
+        im = jnp.argsort(v, axis=axis)
+        vals = jnp.take(vm, k - 1, axis=axis)
+        idx = jnp.take(im, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(jnp.int32)
+
+    vals, idx = primitive("kthvalue", fn, [x])
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import numpy as np
+
+    v = np.asarray(unwrap(x))
+    vm = np.moveaxis(v, axis, -1)
+    flat = vm.reshape(-1, vm.shape[-1])
+    vals, idxs = [], []
+    for row in flat:
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals.append(best)
+        idxs.append(np.where(row == best)[0][-1])
+    vals = np.asarray(vals).reshape(vm.shape[:-1])
+    idxs = np.asarray(idxs, dtype=np.int64).reshape(vm.shape[:-1])
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def nonzero(x, as_tuple=False):
+    v = unwrap(x)  # dynamic shape: eager-only
+    res = jnp.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(r.astype(jnp.int32)) for r in res)
+    return Tensor(jnp.stack(res, axis=1).astype(jnp.int32))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def fn(seq, val):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, val, side=side)
+        else:
+            import jax
+
+            out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+                seq.reshape(-1, seq.shape[-1]), val.reshape(-1, val.shape[-1])
+            ).reshape(val.shape)
+        # int64 narrows to int32 on device by design (base/dtype.py), so both
+        # branches are int32 on TPU; keep the declared-width distinction anyway
+        return out.astype(jnp.int32 if out_int32 else dtype_mod.np_dtype("int64"))
+
+    return passthrough("searchsorted", fn, [sorted_sequence, values])
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+
+    return _is(x, index)
+
+
+def masked_fill(x, mask, value, name=None):
+    from .manipulation import masked_fill as _mf
+
+    return _mf(x, mask, value)
+
+
+def where(condition, x=None, y=None, name=None):
+    from .manipulation import where as _where
+
+    return _where(condition, x, y)

@@ -1,0 +1,72 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor, unwrap
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    from .math import mean as _mean
+
+    return _mean(x, axis, keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    ddof = 1 if unbiased else 0
+    return primitive("std", lambda v: jnp.std(v, axis=ax, ddof=ddof, keepdims=keepdim), [x])
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    ddof = 1 if unbiased else 0
+    return primitive("var", lambda v: jnp.var(v, axis=ax, ddof=ddof, keepdims=keepdim), [x])
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+
+    def fn(v):
+        if mode == "avg":
+            return jnp.median(v, axis=ax, keepdims=keepdim)
+        # mode="min": lower of the two middles
+        vv = jnp.sort(v if ax is not None else v.reshape(-1), axis=ax if ax is not None else 0)
+        n = vv.shape[ax if ax is not None else 0]
+        mid = (n - 1) // 2
+        out = jnp.take(vv, mid, axis=ax if ax is not None else 0)
+        if keepdim and ax is not None:
+            out = jnp.expand_dims(out, ax)
+        return out
+
+    return primitive("median", fn, [x])
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+    return primitive("nanmedian", lambda v: jnp.nanmedian(v, axis=ax, keepdims=keepdim), [x])
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axis(axis)
+    qv = unwrap(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    return primitive(
+        "quantile", lambda v: jnp.quantile(v, qv, axis=ax, keepdims=keepdim, method=interpolation), [x]
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axis(axis)
+    qv = unwrap(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    return primitive(
+        "nanquantile", lambda v: jnp.nanquantile(v, qv, axis=ax, keepdims=keepdim, method=interpolation), [x]
+    )

@@ -1,0 +1,294 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,adagrad,rmsprop,lamb,adadelta,adamax}.py over fused phi kernels — here
+pure jnp update rules; XLA fuses each parameter's update into one kernel, and
+under the jit TrainStep the whole optimizer becomes part of the step program).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _apply_one(self, p, g, lr, weight_decay):
+        gv = self._decayed_grad(p, g, weight_decay)
+        p._replace_value((p._value - lr * gv).astype(p._value.dtype))
+
+
+class Momentum(Optimizer):
+    _accum_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _apply_one(self, p, g, lr, weight_decay):
+        gv = self._decayed_grad(p, g, weight_decay)
+        vel = self._get_accumulator("velocity", p)
+        v_new = self._momentum * vel._value + gv
+        vel._replace_value(v_new)
+        if self._nesterov:
+            update = gv + self._momentum * v_new
+        else:
+            update = v_new
+        p._replace_value((p._value - lr * update).astype(p._value.dtype))
+
+
+class Adam(Optimizer):
+    _accum_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=True,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+        self._multi_precision = multi_precision
+        if amsgrad:
+            self._accum_names = self._accum_names + ["moment2_max"]
+
+    def _apply_one(self, p, g, lr, weight_decay):
+        gv = self._decayed_grad(p, g, weight_decay).astype(jnp.float32)
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        t = self._step_count
+        m_new = self._beta1 * m._value + (1 - self._beta1) * gv
+        v_new = self._beta2 * v._value + (1 - self._beta2) * gv * gv
+        m._replace_value(m_new)
+        v._replace_value(v_new)
+        mhat = m_new / (1 - self._beta1**t)
+        if self._amsgrad:
+            vmax = self._get_accumulator("moment2_max", p)
+            vmax_new = jnp.maximum(vmax._value, v_new)
+            vmax._replace_value(vmax_new)
+            vhat = vmax_new / (1 - self._beta2**t)
+        else:
+            vhat = v_new / (1 - self._beta2**t)
+        # master-weight update in fp32, store back in param dtype (reference
+        # multi_precision adam)
+        p32 = p._value.astype(jnp.float32)
+        p._replace_value((p32 - lr * mhat / (jnp.sqrt(vhat) + self._eps)).astype(p._value.dtype))
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, amsgrad=amsgrad, name=name)
+        self._coeff = float(weight_decay) if not hasattr(weight_decay, "coeff") else weight_decay.coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _apply_one(self, p, g, lr, weight_decay):
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        decay = self._coeff
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            decay = 0.0
+        if decay:
+            # decoupled decay (AdamW): shrink before the adam update
+            p._replace_value((p._value.astype(jnp.float32) * (1 - lr * decay)).astype(p._value.dtype))
+        super()._apply_one(p, g, lr, None)
+
+
+class Adagrad(Optimizer):
+    _accum_names = ["moment"]
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _apply_one(self, p, g, lr, weight_decay):
+        gv = self._decayed_grad(p, g, weight_decay)
+        acc = self._get_accumulator("moment", p, fill=self._init_acc)
+        acc_new = acc._value + gv * gv
+        acc._replace_value(acc_new)
+        p._replace_value((p._value - lr * gv / (jnp.sqrt(acc_new) + self._eps)).astype(p._value.dtype))
+
+
+class RMSProp(Optimizer):
+    _accum_names = ["mean_square", "mean_grad", "momentum"]
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _apply_one(self, p, g, lr, weight_decay):
+        gv = self._decayed_grad(p, g, weight_decay)
+        ms = self._get_accumulator("mean_square", p)
+        ms_new = self._rho * ms._value + (1 - self._rho) * gv * gv
+        ms._replace_value(ms_new)
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", p)
+            mg_new = self._rho * mg._value + (1 - self._rho) * gv
+            mg._replace_value(mg_new)
+            denom = jnp.sqrt(ms_new - mg_new * mg_new + self._eps)
+        else:
+            denom = jnp.sqrt(ms_new + self._eps)
+        mom = self._get_accumulator("momentum", p)
+        mom_new = self._momentum * mom._value + lr * gv / denom
+        mom._replace_value(mom_new)
+        p._replace_value((p._value - mom_new).astype(p._value.dtype))
+
+
+class Adadelta(Optimizer):
+    _accum_names = ["avg_squared_grad", "avg_squared_update"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+
+    def _apply_one(self, p, g, lr, weight_decay):
+        gv = self._decayed_grad(p, g, weight_decay)
+        ag = self._get_accumulator("avg_squared_grad", p)
+        au = self._get_accumulator("avg_squared_update", p)
+        ag_new = self._rho * ag._value + (1 - self._rho) * gv * gv
+        update = -jnp.sqrt((au._value + self._eps) / (ag_new + self._eps)) * gv
+        au_new = self._rho * au._value + (1 - self._rho) * update * update
+        ag._replace_value(ag_new)
+        au._replace_value(au_new)
+        p._replace_value((p._value + lr * update).astype(p._value.dtype))
+
+
+class Adamax(Optimizer):
+    _accum_names = ["moment", "inf_norm"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _apply_one(self, p, g, lr, weight_decay):
+        gv = self._decayed_grad(p, g, weight_decay)
+        m = self._get_accumulator("moment", p)
+        u = self._get_accumulator("inf_norm", p)
+        t = self._step_count
+        m_new = self._beta1 * m._value + (1 - self._beta1) * gv
+        u_new = jnp.maximum(self._beta2 * u._value, jnp.abs(gv))
+        m._replace_value(m_new)
+        u._replace_value(u_new)
+        p._replace_value(
+            (p._value - lr / (1 - self._beta1**t) * m_new / (u_new + self._eps)).astype(p._value.dtype)
+        )
+
+
+class Lamb(Optimizer):
+    _accum_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply_one(self, p, g, lr, weight_decay):
+        gv = g._value.astype(jnp.float32)
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        t = self._step_count
+        m_new = self._beta1 * m._value + (1 - self._beta1) * gv
+        v_new = self._beta2 * v._value + (1 - self._beta2) * gv * gv
+        m._replace_value(m_new)
+        v._replace_value(v_new)
+        mhat = m_new / (1 - self._beta1**t)
+        vhat = v_new / (1 - self._beta2**t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        decay = self._lamb_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            decay = 0.0
+        p32 = p._value.astype(jnp.float32)
+        update = r + decay * p32
+        w_norm = jnp.linalg.norm(p32)
+        u_norm = jnp.linalg.norm(update)
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        p._replace_value((p32 - lr * trust * update).astype(p._value.dtype))
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference python/paddle/optimizer/lbfgs.py).
+    Works through a closure that re-evaluates the loss."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._history_size = history_size
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = []  # list of (s, y, rho) flat vectors
+
+    def _flat_params(self):
+        return jnp.concatenate([p._value.reshape(-1).astype(jnp.float32) for p in self._parameter_list])
+
+    def _flat_grads(self):
+        return jnp.concatenate(
+            [
+                (p._grad._value if p._grad is not None else jnp.zeros_like(p._value)).reshape(-1).astype(jnp.float32)
+                for p in self._parameter_list
+            ]
+        )
+
+    def _assign_flat(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = p.size
+            p._replace_value(flat[off : off + n].reshape(p._value.shape).astype(p._value.dtype))
+            off += n
+
+    def step(self, closure):
+        lr = self.get_lr()
+        loss = closure()
+        g = self._flat_grads()
+        x = self._flat_params()
+        for _ in range(self._max_iter):
+            if float(jnp.max(jnp.abs(g))) < self._tol_grad:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y, rho in reversed(self._history):
+                a = rho * jnp.dot(s, q)
+                alphas.append(a)
+                q = q - a * y
+            if self._history:
+                s, y, _ = self._history[-1]
+                gamma = jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-10)
+            else:
+                gamma = 1.0
+            z = gamma * q
+            for (s, y, rho), a in zip(self._history, reversed(alphas)):
+                b = rho * jnp.dot(y, z)
+                z = z + s * (a - b)
+            d = -z
+            x_new = x + lr * d
+            self._assign_flat(x_new)
+            self.clear_grad()
+            loss = closure()
+            g_new = self._flat_grads()
+            s_vec = x_new - x
+            y_vec = g_new - g
+            sy = jnp.dot(s_vec, y_vec)
+            if float(sy) > 1e-10:
+                self._history.append((s_vec, y_vec, 1.0 / sy))
+                if len(self._history) > self._history_size:
+                    self._history.pop(0)
+            if float(jnp.max(jnp.abs(x_new - x))) < self._tol_change:
+                x = x_new
+                g = g_new
+                break
+            x, g = x_new, g_new
+        return loss

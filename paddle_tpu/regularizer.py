@@ -1,0 +1,14 @@
+"""Regularizers (reference: python/paddle/regularizer.py) — consumed by
+Optimizer._decayed_grad at optimize time."""
+from __future__ import annotations
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self.is_l1 = True

@@ -1,0 +1,30 @@
+"""Test harness config: force an 8-device virtual CPU platform BEFORE jax
+imports, so distributed/sharding tests run without TPU hardware (the rebuild's
+analog of the reference's multi-process localhost harness,
+test/legacy_test/test_dist_base.py)."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Drop the axon TPU-tunnel plugin from the import path: tests are CPU-only and
+# the plugin initializes (and dials its relay) even under JAX_PLATFORMS=cpu.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
+)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    import paddle_tpu as paddle
+
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield

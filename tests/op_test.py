@@ -1,0 +1,65 @@
+"""OpTest-style harness (rebuild of reference test/legacy_test/op_test.py):
+check_output compares the framework op against a numpy reference; check_grad
+compares analytic gradients against central-difference numeric gradients.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(fw_out, np_ref, rtol=1e-5, atol=1e-6, msg=""):
+    if isinstance(fw_out, (list, tuple)):
+        for i, (a, b) in enumerate(zip(fw_out, np_ref)):
+            check_output(a, b, rtol, atol, f"{msg}[{i}]")
+        return
+    a = fw_out.numpy() if isinstance(fw_out, Tensor) else np.asarray(fw_out)
+    np.testing.assert_allclose(a, np_ref, rtol=rtol, atol=atol, err_msg=msg)
+
+
+def numeric_grad(fn, inputs, wrt_index, out_cotangent=None, eps=1e-3):
+    """Central-difference dL/dx where L = sum(fn(*inputs) * cotangent)."""
+    base_inputs = [np.asarray(v, dtype=np.float64) for v in inputs]
+
+    def loss(args):
+        out = fn(*[paddle.to_tensor(a.astype(np.float32)) for a in args])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        tot = 0.0
+        for i, o in enumerate(outs):
+            o_np = o.numpy().astype(np.float64)
+            cot = 1.0 if out_cotangent is None else out_cotangent[i]
+            tot += float(np.sum(o_np * cot))
+        return tot
+
+    x = base_inputs[wrt_index]
+    g = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        f1 = loss(base_inputs)
+        flat[i] = orig - eps
+        f2 = loss(base_inputs)
+        flat[i] = orig
+        gflat[i] = (f1 - f2) / (2 * eps)
+    return g
+
+
+def check_grad(fn, np_inputs, wrt=None, rtol=2e-2, atol=2e-3, eps=1e-3):
+    """Compare analytic (tape) gradient vs numeric for each requested input."""
+    tensors = [paddle.to_tensor(np.asarray(v, dtype=np.float32), stop_gradient=False) for v in np_inputs]
+    out = fn(*tensors)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    loss = None
+    for o in outs:
+        s = paddle.sum(o)
+        loss = s if loss is None else loss + s
+    loss.backward()
+    wrt = range(len(np_inputs)) if wrt is None else wrt
+    for i in wrt:
+        analytic = tensors[i].grad.numpy().astype(np.float64)
+        numeric = numeric_grad(fn, np_inputs, i, eps=eps)
+        np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol, err_msg=f"grad wrt input {i}")

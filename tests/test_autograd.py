@@ -1,0 +1,190 @@
+"""Autograd engine tests (model: reference test/legacy_test/test_imperative_*
+and test/autograd/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+        y = paddle.sum(x * x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+    def test_shared_subexpression(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        a = x * x  # used twice
+        y = a + a
+        y.backward()
+        assert x.grad.item() == pytest.approx(8.0)
+
+    def test_stop_gradient_pruning(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = paddle.to_tensor(3.0, stop_gradient=True)
+        z = x * y
+        z.backward()
+        assert x.grad.item() == pytest.approx(3.0)
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = (x * x).detach()
+        z = y * x
+        z.backward()
+        assert x.grad.item() == pytest.approx(4.0)  # only through z = y*x
+
+    def test_grad_accumulation_and_clear(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        assert x.grad.item() == pytest.approx(5.0)
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_non_scalar_root_with_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * x
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 40.0])
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(np.random.randn(4, 6).astype(np.float32), stop_gradient=False)
+        vals, idx = paddle.topk(x, 2, axis=1)
+        paddle.sum(vals).backward()
+        g = x.grad.numpy()
+        assert g.sum() == pytest.approx(8.0)  # 2 ones per row
+        assert ((g == 0) | (g == 1)).all()
+
+    def test_released_graph_raises(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.backward()
+        with pytest.raises(Exception):
+            y.backward()
+
+
+class TestPaddleGrad:
+    def test_basic(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = x * x
+        (g,) = paddle.grad(y, x)
+        assert g.item() == pytest.approx(6.0)
+        assert x.grad is None  # paddle.grad does not write .grad
+
+    def test_double_grad(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = x * x * x
+        (g,) = paddle.grad(y, x, create_graph=True)
+        assert g.item() == pytest.approx(27.0)
+        (g2,) = paddle.grad(g, x)
+        assert g2.item() == pytest.approx(18.0)
+
+    def test_unused_input(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        z = paddle.to_tensor(1.0, stop_gradient=False)
+        with pytest.raises(ValueError):
+            paddle.grad(x * 2, [x, z])
+        gx, gz = paddle.grad(x * 2, [x, z], allow_unused=True)
+        assert gx.item() == pytest.approx(2.0)
+        assert gz is None
+
+    def test_interior_input(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        m = x * x
+        y = m * m
+        (gm,) = paddle.grad(y, m)
+        assert gm.item() == pytest.approx(8.0)  # dy/dm = 2m
+
+
+class TestInplaceAndHooks:
+    def test_inplace_grad_routing(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 3
+        y[0] = 0.0
+        paddle.sum(y).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 3.0])
+
+    def test_hook_modifies_grad(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        handle = x.register_hook(lambda g: g * 2)
+        (x * 5).backward()
+        assert x.grad.item() == pytest.approx(10.0)
+        handle.remove()
+        x.clear_grad()
+        (x * 5).backward()
+        assert x.grad.item() == pytest.approx(5.0)
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+
+class TestPyLayer:
+    def test_forward_backward(self):
+        class Exp(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle.exp(x)
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, gy):
+                (y,) = ctx.saved_tensor()
+                return gy * y
+
+        x = paddle.to_tensor(1.5, stop_gradient=False)
+        y = Exp.apply(x)
+        y.backward()
+        assert x.grad.item() == pytest.approx(float(np.exp(1.5)), rel=1e-5)
+
+    def test_multiple_inputs(self):
+        class MulAdd(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b + a
+
+            @staticmethod
+            def backward(ctx, g):
+                a, b = ctx.saved_tensor()
+                return g * (b + 1), g * a
+
+        a = paddle.to_tensor(2.0, stop_gradient=False)
+        b = paddle.to_tensor(3.0, stop_gradient=False)
+        out = MulAdd.apply(a, b)
+        out.backward()
+        assert a.grad.item() == pytest.approx(4.0)
+        assert b.grad.item() == pytest.approx(2.0)
+
+
+class TestTensorBasics:
+    def test_meta(self):
+        t = paddle.ones([2, 3], dtype="float32")
+        assert t.shape == [2, 3]
+        assert t.ndim == 2
+        assert t.size == 6
+        assert t.dtype == paddle.float32
+
+    def test_numpy_item(self):
+        t = paddle.to_tensor([[5.0]])
+        assert t.item() == 5.0
+        assert t.numpy().shape == (1, 1)
+
+    def test_astype_to(self):
+        t = paddle.ones([2])
+        assert t.astype("int32").dtype == paddle.int32
+        assert t.to("float32").dtype == paddle.float32
+
+    def test_random_reproducibility(self):
+        paddle.seed(42)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_array_equal(a, b)

@@ -1,0 +1,234 @@
+"""Layer tests (model: reference test/legacy_test layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLinearEmbedding:
+    def test_linear(self):
+        lin = nn.Linear(4, 3)
+        x = paddle.randn([5, 4])
+        y = lin(x)
+        assert y.shape == [5, 3]
+        ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_linear_no_bias(self):
+        lin = nn.Linear(4, 3, bias_attr=False)
+        assert lin.bias is None
+        assert len(lin.parameters()) == 1
+
+    def test_embedding_padding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor(np.array([0, 3]))
+        out = emb(idx)
+        np.testing.assert_allclose(out.numpy()[0], np.zeros(4), atol=1e-7)
+        # grads must not flow into the padding row
+        loss = paddle.sum(emb(idx))
+        loss.backward()
+        np.testing.assert_allclose(emb.weight.grad.numpy()[0], np.zeros(4), atol=1e-7)
+        assert abs(emb.weight.grad.numpy()[3]).sum() > 0
+
+
+class TestConvPool:
+    def test_conv2d_shapes(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = paddle.randn([2, 3, 16, 16])
+        assert conv(x).shape == [2, 8, 8, 8]
+
+    def test_conv2d_matches_numpy(self):
+        conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+        x = np.random.randn(1, 1, 4, 4).astype(np.float32)
+        w = conv.weight.numpy()
+        out = conv(paddle.to_tensor(x)).numpy()
+        ref = np.zeros((1, 1, 3, 3), np.float32)
+        for i in range(3):
+            for j in range(3):
+                ref[0, 0, i, j] = (x[0, 0, i : i + 2, j : j + 2] * w[0, 0]).sum()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv_groups_dilation(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, dilation=2, padding=2)
+        x = paddle.randn([1, 4, 10, 10])
+        assert conv(x).shape == [1, 8, 10, 10]
+
+    def test_conv_transpose(self):
+        convt = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+        x = paddle.randn([1, 4, 5, 5])
+        assert convt(x).shape == [1, 2, 9, 9]
+
+    def test_pools(self):
+        x = paddle.randn([2, 3, 8, 8])
+        assert nn.MaxPool2D(2)(x).shape == [2, 3, 4, 4]
+        assert nn.AvgPool2D(2)(x).shape == [2, 3, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+        a = np.random.randn(1, 1, 4, 4).astype(np.float32)
+        got = F.avg_pool2d(paddle.to_tensor(a), 2).numpy()
+        ref = a.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+class TestNorms:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.randn([4, 3, 5, 5]) * 3 + 1
+        y = bn(x)
+        # train mode: output is normalized with batch stats
+        yn = y.numpy()
+        assert abs(yn.mean()) < 1e-2
+        assert abs(yn.std() - 1) < 5e-2
+        assert abs(bn._mean.numpy()).sum() > 0
+        bn.eval()
+        y2 = bn(x)
+        assert y2.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(6)
+        x = paddle.randn([2, 4, 6]) * 5
+        y = ln(x).numpy()
+        np.testing.assert_allclose(y.mean(-1), np.zeros((2, 4)), atol=1e-5)
+        np.testing.assert_allclose(y.std(-1), np.ones((2, 4)), atol=2e-2)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = paddle.randn([3, 8])
+        y = rn(x).numpy()
+        xn = x.numpy()
+        ref = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = paddle.randn([2, 4, 3, 3])
+        assert gn(x).shape == [2, 4, 3, 3]
+
+
+class TestContainers:
+    def test_sequential_layerlist(self):
+        net = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 1))
+        assert len(net) == 3
+        assert len(net.parameters()) == 4
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        assert len(list(ll)) == 4
+
+    def test_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        lin(paddle.randn([1, 2]))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.randn([1, 2]))
+        assert calls == [1]
+
+    def test_apply_and_mode(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert all(not l.training for l in net.sublayers(include_self=True))
+        net.train()
+        assert net[1].training
+
+    def test_assign_tensor_to_param_keeps_registry(self):
+        lin = nn.Linear(2, 2)
+        new_w = paddle.ones([2, 2])
+        lin.weight = new_w
+        # registry stays authoritative
+        assert any(p is lin.weight for p in lin.parameters())
+        np.testing.assert_allclose(lin.weight.numpy(), np.ones((2, 2)))
+        with pytest.raises(TypeError):
+            lin.weight = "nope"
+
+
+class TestInitializers:
+    def test_constant_uniform(self):
+        from paddle_tpu.nn.initializer import Constant, KaimingNormal, Uniform, XavierNormal
+
+        lin = nn.Linear(10, 10, weight_attr=nn.ParamAttr(initializer=Constant(2.0)))
+        np.testing.assert_allclose(lin.weight.numpy(), np.full((10, 10), 2.0))
+        lin2 = nn.Linear(100, 100, weight_attr=nn.ParamAttr(initializer=Uniform(-0.5, 0.5)))
+        w = lin2.weight.numpy()
+        assert w.min() >= -0.5 and w.max() <= 0.5
+        lin3 = nn.Linear(1000, 50, weight_attr=nn.ParamAttr(initializer=XavierNormal()))
+        std = lin3.weight.numpy().std()
+        assert abs(std - np.sqrt(2.0 / 1050)) < 0.01
+
+    def test_orthogonal(self):
+        from paddle_tpu.nn.initializer import Orthogonal
+
+        lin = nn.Linear(16, 16, weight_attr=nn.ParamAttr(initializer=Orthogonal()))
+        w = lin.weight.numpy()
+        np.testing.assert_allclose(w @ w.T, np.eye(16), atol=1e-4)
+
+
+class TestLossesAndAttention:
+    def test_cross_entropy_matches_manual(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 1, 4])
+        out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        p = np.exp(logits - logits.max(1, keepdims=True))
+        p = p / p.sum(1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(out.numpy()), ref, rtol=1e-5)
+
+    def test_cross_entropy_soft_and_smoothing(self):
+        logits = np.random.randn(3, 4).astype(np.float32)
+        soft = np.random.rand(3, 4).astype(np.float32)
+        soft = soft / soft.sum(1, keepdims=True)
+        out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft), soft_label=True)
+        assert out.shape == []
+        out2 = F.cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(np.array([0, 1, 2])), label_smoothing=0.1
+        )
+        assert float(out2.numpy()) > 0
+
+    def test_bce_kl(self):
+        z = np.random.randn(6).astype(np.float32)
+        y = (np.random.rand(6) > 0.5).astype(np.float32)
+        out = F.binary_cross_entropy_with_logits(paddle.to_tensor(z), paddle.to_tensor(y))
+        p = 1 / (1 + np.exp(-z))
+        ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(float(out.numpy()), ref, rtol=1e-4)
+
+    def test_attention_causal(self):
+        q = paddle.randn([2, 8, 2, 4])
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        assert out.shape == [2, 8, 2, 4]
+        # first position attends only to itself -> equals v[0]
+        np.testing.assert_allclose(out.numpy()[:, 0], q.numpy()[:, 0], rtol=1e-4, atol=1e-5)
+
+    def test_attention_grad(self):
+        q = paddle.randn([1, 4, 1, 8])
+        q.stop_gradient = False
+        out, _ = F.flash_attention(q, q, q, causal=False)
+        paddle.sum(out).backward()
+        assert q.grad is not None and abs(q.grad.numpy()).sum() > 0
+
+    def test_pallas_flash_interpret_matches_xla(self):
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _xla_reference,
+            flash_attention_interpret_test,
+        )
+        import jax.numpy as jnp
+
+        q = jnp.asarray(np.random.randn(1, 16, 2, 8).astype(np.float32))
+        k = jnp.asarray(np.random.randn(1, 16, 2, 8).astype(np.float32))
+        v = jnp.asarray(np.random.randn(1, 16, 2, 8).astype(np.float32))
+        got = flash_attention_interpret_test(q, k, v, causal=True)
+        ref = _xla_reference(q, k, v, causal=True, scale=1.0 / np.sqrt(8))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    def test_pallas_rmsnorm_interpret(self):
+        from paddle_tpu.ops.pallas.rms_norm import rms_norm_value
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.random.randn(4, 16).astype(np.float32))
+        w = jnp.asarray(np.random.rand(16).astype(np.float32))
+        got = np.asarray(rms_norm_value(x, w, 1e-6, interpret=True))
+        xn = np.asarray(x)
+        ref = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-6) * np.asarray(w)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,211 @@
+"""Op unit tests: manipulation/indexing/search/linalg."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+from op_test import check_grad, check_output
+
+
+class TestShapeOps:
+    def test_reshape_transpose(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(paddle.reshape(t, [6, 4]), a.reshape(6, 4))
+        check_output(paddle.reshape(t, [-1, 2]), a.reshape(-1, 2))
+        check_output(paddle.transpose(t, [2, 0, 1]), a.transpose(2, 0, 1))
+        check_grad(lambda x: paddle.transpose(x, [1, 0, 2]), [a])
+
+    def test_concat_split_stack(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(2, 3).astype(np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        check_output(paddle.concat([ta, tb], axis=1), np.concatenate([a, b], 1))
+        check_output(paddle.stack([ta, tb], axis=0), np.stack([a, b], 0))
+        parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+        assert len(parts) == 3
+        check_output(parts[1], a[:, 1:2])
+        parts = paddle.split(paddle.to_tensor(a), [1, -1], axis=1)
+        check_output(parts[1], a[:, 1:])
+        check_grad(lambda x, y: paddle.concat([x, y], axis=0), [a, b])
+
+    def test_squeeze_unsqueeze_flatten(self):
+        a = np.random.randn(2, 1, 3).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(paddle.squeeze(t, 1), a.squeeze(1))
+        check_output(paddle.unsqueeze(t, 0), a[None])
+        check_output(paddle.flatten(t), a.reshape(-1))
+        check_output(paddle.flatten(t, 1, 2), a.reshape(2, 3))
+
+    def test_expand_tile_pad(self):
+        a = np.random.randn(1, 3).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(paddle.expand(t, [4, 3]), np.broadcast_to(a, (4, 3)))
+        check_output(paddle.tile(t, [2, 2]), np.tile(a, (2, 2)))
+        b = np.random.randn(2, 2).astype(np.float32)
+        check_output(
+            paddle.pad(paddle.to_tensor(b), [1, 1, 2, 2], value=5.0),
+            np.pad(b, ((1, 1), (2, 2)), constant_values=5.0),
+        )
+        check_grad(lambda x: paddle.expand(x, [4, 3]), [a])
+
+    def test_roll_flip(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        t = paddle.to_tensor(a)
+        check_output(paddle.roll(t, 1, axis=1), np.roll(a, 1, 1))
+        check_output(paddle.flip(t, axis=0), np.flip(a, 0))
+
+
+class TestIndexing:
+    def test_gather_scatter(self):
+        a = np.random.randn(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        t = paddle.to_tensor(a)
+        check_output(paddle.gather(t, paddle.to_tensor(idx)), a[idx])
+        upd = np.random.randn(2, 3).astype(np.float32)
+        out = paddle.scatter(t, paddle.to_tensor(np.array([1, 3])), paddle.to_tensor(upd))
+        ref = a.copy()
+        ref[[1, 3]] = upd
+        check_output(out, ref)
+
+    def test_gather_nd(self):
+        a = np.random.randn(3, 4, 5).astype(np.float32)
+        idx = np.array([[0, 1], [2, 3]])
+        out = paddle.gather_nd(paddle.to_tensor(a), paddle.to_tensor(idx))
+        check_output(out, a[[0, 2], [1, 3]])
+
+    def test_index_select_take_along(self):
+        a = np.random.randn(4, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(paddle.index_select(t, paddle.to_tensor(np.array([1, 3])), axis=1), a[:, [1, 3]])
+        idx = np.array([[0, 1, 2, 3, 4]] * 4)
+        check_output(
+            paddle.take_along_axis(t, paddle.to_tensor(idx), axis=1),
+            np.take_along_axis(a, idx, 1),
+        )
+
+    def test_put_along_axis(self):
+        a = np.zeros((3, 4), np.float32)
+        idx = np.array([[1], [2], [0]])
+        val = np.ones((3, 1), np.float32)
+        out = paddle.put_along_axis(paddle.to_tensor(a), paddle.to_tensor(idx), paddle.to_tensor(val), axis=1)
+        ref = a.copy()
+        np.put_along_axis(ref, idx, val, 1)
+        check_output(out, ref)
+        out2 = paddle.put_along_axis(
+            paddle.to_tensor(ref), paddle.to_tensor(idx), paddle.to_tensor(val), axis=1, reduce="add"
+        )
+        ref2 = ref.copy()
+        ref2[[0, 1, 2], [1, 2, 0]] += 1
+        check_output(out2, ref2)
+
+    def test_getitem_setitem(self):
+        a = np.random.randn(4, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(t[1], a[1])
+        check_output(t[1:3, ::2], a[1:3, ::2])
+        check_output(t[:, -1], a[:, -1])
+        check_output(t[np.array([0, 2])], a[[0, 2]])
+        mask = a > 0
+        check_output(paddle.masked_select(t, paddle.to_tensor(mask)), a[mask])
+        t2 = paddle.to_tensor(a.copy())
+        t2[0] = 0.0
+        ref = a.copy()
+        ref[0] = 0
+        check_output(t2, ref)
+
+    def test_where_masked_fill(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(
+            paddle.where(t > 0, t, paddle.zeros_like(t)), np.where(a > 0, a, 0)
+        )
+        check_output(paddle.masked_fill(t, t > 0, -1.0), np.where(a > 0, -1.0, a))
+
+
+class TestSearchSort:
+    def test_argmax_sort_topk(self):
+        a = np.random.randn(4, 6).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(paddle.argmax(t, axis=1), a.argmax(1))
+        check_output(paddle.argmin(t), a.argmin())
+        check_output(paddle.sort(t, axis=1), np.sort(a, 1))
+        check_output(paddle.argsort(t, axis=1), np.argsort(a, 1))
+        vals, idx = paddle.topk(t, 3, axis=1)
+        ref = np.sort(a, 1)[:, ::-1][:, :3]
+        check_output(vals, ref)
+
+    def test_nonzero_searchsorted(self):
+        a = np.array([[1.0, 0.0], [0.0, 2.0]], np.float32)
+        out = paddle.nonzero(paddle.to_tensor(a))
+        check_output(out, np.stack(np.nonzero(a), 1))
+        seq = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+        vals = np.array([2.0, 6.0], np.float32)
+        check_output(
+            paddle.searchsorted(paddle.to_tensor(seq), paddle.to_tensor(vals)),
+            np.searchsorted(seq, vals),
+        )
+
+    def test_unique(self):
+        a = np.array([3, 1, 2, 1, 3])
+        out = paddle.unique(paddle.to_tensor(a))
+        check_output(out, np.unique(a))
+
+
+class TestLinalg:
+    def test_norms(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(paddle.norm(t), np.linalg.norm(a), rtol=1e-4)
+        check_output(paddle.norm(t, p=1, axis=1), np.abs(a).sum(1), rtol=1e-4)
+        check_grad(lambda x: paddle.norm(x), [a], rtol=3e-2)
+
+    def test_solve_inv_det(self):
+        a = np.random.randn(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.randn(3, 2).astype(np.float32)
+        check_output(paddle.solve(paddle.to_tensor(a), paddle.to_tensor(b)), np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+        check_output(paddle.inv(paddle.to_tensor(a)), np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+        check_output(paddle.det(paddle.to_tensor(a)), np.linalg.det(a), rtol=1e-3)
+
+    def test_cholesky_eigh_svd(self):
+        m = np.random.randn(4, 4).astype(np.float32)
+        spd = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+        L = paddle.cholesky(paddle.to_tensor(spd))
+        check_output(paddle.matmul(L, L, transpose_y=True), spd, rtol=1e-3, atol=1e-3)
+        w, v = paddle.eigh(paddle.to_tensor(spd))
+        check_output(w, np.linalg.eigh(spd)[0], rtol=1e-3, atol=1e-3)
+        u, s, vh = paddle.svd(paddle.to_tensor(m))
+        check_output(s, np.linalg.svd(m, compute_uv=False), rtol=1e-3, atol=1e-3)
+
+    def test_einsum(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        check_output(paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b)), a @ b, rtol=1e-4)
+        check_grad(lambda x, y: paddle.einsum("ij,jk->ik", x, y), [a, b], rtol=3e-2)
+
+
+class TestLogic:
+    def test_compare(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        check_output(paddle.equal(ta, tb), a == b)
+        check_output(paddle.greater_than(ta, tb), a > b)
+        check_output(ta <= tb, a <= b)
+        assert bool(paddle.allclose(ta, ta))
+        assert not bool(paddle.equal_all(ta, tb))
+
+    def test_logical(self):
+        a = np.array([True, False])
+        b = np.array([True, True])
+        check_output(paddle.logical_and(paddle.to_tensor(a), paddle.to_tensor(b)), a & b)
+        check_output(paddle.logical_not(paddle.to_tensor(a)), ~a)
+
+
+class TestCast:
+    def test_cast_dtypes(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert paddle.cast(t, "int32").dtype == paddle.int32
+        assert paddle.cast(t, paddle.bfloat16).dtype == paddle.bfloat16
+        assert t.astype("bool").dtype == paddle.bool
+        check_grad(lambda x: paddle.cast(x, "float32") * 2, [a])

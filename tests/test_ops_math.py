@@ -1,0 +1,179 @@
+"""Op unit tests: math/creation/reduction (model: reference test/legacy_test
+test_*_op.py via the OpTest harness)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from op_test import check_grad, check_output
+
+
+class TestBinaryOps:
+    @pytest.mark.parametrize(
+        "op,npop",
+        [
+            ("add", np.add),
+            ("subtract", np.subtract),
+            ("multiply", np.multiply),
+            ("divide", np.true_divide),
+            ("maximum", np.maximum),
+            ("minimum", np.minimum),
+            ("atan2", np.arctan2),
+        ],
+    )
+    def test_value_and_grad(self, op, npop):
+        a = np.random.randn(3, 4).astype(np.float32) + 2.0
+        b = np.random.randn(3, 4).astype(np.float32) + 2.0
+        fn = getattr(paddle, op)
+        check_output(fn(paddle.to_tensor(a), paddle.to_tensor(b)), npop(a, b), rtol=1e-4)
+        if op not in ("maximum", "minimum"):
+            check_grad(fn, [a, b])
+
+    def test_broadcast(self):
+        a = np.random.randn(3, 1, 4).astype(np.float32)
+        b = np.random.randn(1, 5, 4).astype(np.float32)
+        check_output(paddle.add(paddle.to_tensor(a), paddle.to_tensor(b)), a + b)
+        check_grad(paddle.add, [a, b])
+
+    def test_scalar_operand(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(t + 2.5, a + 2.5)
+        check_output(2.5 - t, 2.5 - a)
+        check_output(t / 2.0, a / 2.0)
+        check_output(t**2, a**2)
+
+    def test_matmul(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        check_output(paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)), a @ b, rtol=1e-4)
+        check_grad(paddle.matmul, [a, b], rtol=3e-2)
+
+    def test_matmul_transpose(self):
+        a = np.random.randn(4, 3).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b), transpose_x=True)
+        check_output(out, a.T @ b, rtol=1e-4)
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize(
+        "op,npop,pos",
+        [
+            ("exp", np.exp, False),
+            ("log", np.log, True),
+            ("sqrt", np.sqrt, True),
+            ("tanh", np.tanh, False),
+            ("sin", np.sin, False),
+            ("cos", np.cos, False),
+            ("abs", np.abs, False),
+            ("floor", np.floor, False),
+            ("square", np.square, False),
+            ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), False),
+        ],
+    )
+    def test_value(self, op, npop, pos):
+        a = np.random.rand(3, 4).astype(np.float32) + (1.0 if pos else -0.5)
+        fn = getattr(paddle, op) if hasattr(paddle, op) else getattr(paddle.ops.math, op)
+        check_output(fn(paddle.to_tensor(a)), npop(a), rtol=1e-4, atol=1e-5)
+        if op not in ("floor", "abs"):
+            check_grad(fn, [a])
+
+
+class TestReductions:
+    def test_sum_axes(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(paddle.sum(t), a.sum(), rtol=1e-4)
+        check_output(paddle.sum(t, axis=1), a.sum(1), rtol=1e-4)
+        check_output(paddle.sum(t, axis=[0, 2], keepdim=True), a.sum((0, 2), keepdims=True), rtol=1e-4)
+        check_grad(lambda x: paddle.sum(x, axis=1), [a])
+
+    def test_mean_max_min_prod(self):
+        a = np.random.randn(3, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(paddle.mean(t, axis=0), a.mean(0), rtol=1e-4)
+        check_output(paddle.max(t, axis=1), a.max(1))
+        check_output(paddle.min(t), a.min())
+        check_output(paddle.prod(t, axis=1), a.prod(1), rtol=1e-4)
+        check_grad(lambda x: paddle.mean(x, axis=0), [a])
+
+    def test_logsumexp_std_var(self):
+        a = np.random.randn(4, 6).astype(np.float32)
+        t = paddle.to_tensor(a)
+        ref = np.log(np.exp(a).sum(1))
+        check_output(paddle.logsumexp(t, axis=1), ref, rtol=1e-4)
+        check_output(paddle.std(t, axis=1), a.std(1, ddof=1), rtol=1e-3)
+        check_output(paddle.var(t), a.var(ddof=1), rtol=1e-3)
+
+    def test_cumsum_cumprod(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        check_output(paddle.cumsum(t, axis=1), np.cumsum(a, 1), rtol=1e-4)
+        check_output(paddle.cumprod(t, dim=0), np.cumprod(a, 0), rtol=1e-4)
+        check_grad(lambda x: paddle.cumsum(x, axis=1), [a])
+
+    def test_all_any(self):
+        a = np.array([[True, False], [True, True]])
+        t = paddle.to_tensor(a)
+        check_output(paddle.all(t, axis=0), a.all(0))
+        check_output(paddle.any(t), a.any())
+
+
+class TestCreation:
+    def test_basics(self):
+        check_output(paddle.zeros([2, 3]), np.zeros((2, 3), np.float32))
+        check_output(paddle.ones([4]), np.ones(4, np.float32))
+        check_output(paddle.full([2, 2], 7.0), np.full((2, 2), 7.0, np.float32))
+        check_output(paddle.arange(10), np.arange(10))
+        check_output(paddle.arange(1, 7, 2), np.arange(1, 7, 2))
+        check_output(paddle.linspace(0, 1, 5), np.linspace(0, 1, 5).astype(np.float32), rtol=1e-6)
+        check_output(paddle.eye(3), np.eye(3, dtype=np.float32))
+
+    def test_like(self):
+        a = paddle.ones([2, 3])
+        check_output(paddle.zeros_like(a), np.zeros((2, 3), np.float32))
+        check_output(paddle.full_like(a, 3.0), np.full((2, 3), 3.0, np.float32))
+
+    def test_tril_triu(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        check_output(paddle.tril(paddle.to_tensor(a)), np.tril(a))
+        check_output(paddle.triu(paddle.to_tensor(a), 1), np.triu(a, 1))
+
+    def test_one_hot(self):
+        idx = np.array([0, 2, 1])
+        out = paddle.one_hot(paddle.to_tensor(idx), 3)
+        check_output(out, np.eye(3, dtype=np.float32)[idx])
+
+
+class TestClipEtc:
+    def test_clip(self):
+        a = np.random.randn(3, 4).astype(np.float32) * 3
+        check_output(paddle.clip(paddle.to_tensor(a), -1.0, 1.0), np.clip(a, -1, 1))
+        check_grad(lambda x: paddle.clip(x, -1.0, 1.0), [a])
+
+    def test_lerp_addmm(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        w = np.float32(0.3)
+        check_output(
+            paddle.lerp(paddle.to_tensor(a), paddle.to_tensor(b), paddle.to_tensor(w)),
+            a + 0.3 * (b - a),
+            rtol=1e-5,
+        )
+        i = np.random.randn(2, 5).astype(np.float32)
+        x = np.random.randn(2, 3).astype(np.float32)
+        y = np.random.randn(3, 5).astype(np.float32)
+        check_output(
+            paddle.addmm(paddle.to_tensor(i), paddle.to_tensor(x), paddle.to_tensor(y), beta=0.5, alpha=2.0),
+            0.5 * i + 2.0 * (x @ y),
+            rtol=1e-4,
+        )
+
+    def test_isnan_isinf(self):
+        a = np.array([1.0, np.nan, np.inf, -np.inf], np.float32)
+        t = paddle.to_tensor(a)
+        check_output(paddle.isnan(t), np.isnan(a))
+        check_output(paddle.isinf(t), np.isinf(a))
+        check_output(paddle.isfinite(t), np.isfinite(a))
+        check_output(paddle.nan_to_num(t), np.nan_to_num(a))
