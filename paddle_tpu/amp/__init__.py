@@ -1,0 +1,14 @@
+"""paddle.amp surface (reference: python/paddle/amp/__init__.py)."""
+from . import amp_lists  # noqa: F401
+from .auto_cast import amp_guard, auto_cast, decorate, get_amp_dtype, is_auto_cast_enabled  # noqa: F401
+from .grad_scaler import GradScaler  # noqa: F401
+
+AmpScaler = GradScaler
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True

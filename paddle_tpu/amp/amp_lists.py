@@ -1,0 +1,31 @@
+"""AMP op lists (reference: python/paddle/amp/amp_lists.py).
+
+White list: ops numerically safe and fast in low precision (MXU ops).
+Black list: ops that must stay fp32 (reductions prone to overflow/underflow).
+"""
+
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "linear", "einsum", "addmm",
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "flash_attention", "flash_attention_xla", "sdpa_flash", "sdpa_xla",
+    "pallas_rms_norm",
+}
+
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "cumsum",
+    "softmax", "log_softmax", "cross_entropy", "bce_with_logits",
+    "binary_cross_entropy", "nll_loss", "kl_div", "logsumexp",
+    "layer_norm", "rms_norm", "batch_norm", "batch_norm_infer", "group_norm",
+    "instance_norm", "norm", "cosine_similarity", "softmax_with_cross_entropy",
+    "prod", "std", "var", "logcumsumexp", "erfinv", "pow", "ctc_loss",
+}
+
+# everything else: gray — runs in whatever dtype its inputs already have
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)

@@ -1,0 +1,96 @@
+"""AMP autocasting (reference: python/paddle/amp/auto_cast.py:1029 amp_guard
+:462 — O1 white/black-list casting, O2 pure-low-precision with master
+weights; the reference's cast insertion lives in generated ad_funcs, here it
+lives in the dispatcher (core/dispatch.py consults the active AmpState)).
+
+TPU note: bfloat16 is the native MXU dtype and shares fp32's exponent range,
+so loss scaling is unnecessary for bf16 (GradScaler becomes a no-op identity
+unless float16 is forced)."""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..base import dtype as dtype_mod
+from ..base import global_state
+from ..core.tensor import Tensor
+from . import amp_lists
+
+
+class AmpState:
+    def __init__(self, level="O1", dtype="bfloat16", custom_white_list=None, custom_black_list=None):
+        self.level = level
+        self.dtype = dtype_mod.np_dtype(dtype)
+        self.white = amp_lists.white_list()
+        self.black = amp_lists.black_list()
+        if custom_white_list:
+            self.white |= set(custom_white_list)
+            self.black -= set(custom_white_list)
+        if custom_black_list:
+            self.black |= set(custom_black_list)
+            self.white -= set(custom_black_list)
+
+    _EXEMPT = {"cast", "assign", "dropout", "getitem", "setitem"}
+
+    def cast_inputs(self, op_name, tensor_args):
+        if op_name in self._EXEMPT:
+            return tensor_args
+        if self.level == "O2":
+            # pure low-precision except black list
+            target = jnp.float32 if op_name in self.black else self.dtype
+        elif op_name in self.white:
+            target = self.dtype
+        elif op_name in self.black:
+            target = jnp.float32
+        else:
+            return tensor_args
+        out = []
+        for a in tensor_args:
+            if isinstance(a, Tensor) and jnp.issubdtype(a._value.dtype, jnp.floating) and a._value.dtype != target:
+                from ..ops.manipulation import cast
+
+                out.append(cast(a, target))
+            else:
+                out.append(a)
+        return out
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16", use_promote=True):
+    if not enable:
+        yield
+        return
+    state = AmpState(level, dtype, custom_white_list, custom_black_list)
+    prev = global_state.set_amp_state(state)
+    try:
+        yield
+    finally:
+        global_state.set_amp_state(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the AMP dtype (reference
+    paddle.amp.decorate). Optimizers already keep fp32 master math
+    (multi_precision update rules)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m._convert_dtype(dtype)
+            m._casted_by_pure_fp16 = True
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+def is_auto_cast_enabled():
+    return global_state.amp_state() is not None
+
+
+def get_amp_dtype():
+    st = global_state.amp_state()
+    return str(st.dtype) if st else "float32"

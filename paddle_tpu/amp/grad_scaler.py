@@ -1,0 +1,132 @@
+"""Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py:657).
+
+State (scale, growth tracker) lives in Tensor cells so scaled training
+compiles into the jit TrainStep. On TPU with bfloat16 scaling is unneeded;
+``enable=False`` (or bf16 default) makes scale()/step() pass-throughs while
+keeping API parity for code ported from the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops import math as math_ops
+
+
+class GradScaler:
+    def __init__(
+        self,
+        enable=True,
+        init_loss_scaling=65536.0,
+        incr_ratio=2.0,
+        decr_ratio=0.5,
+        incr_every_n_steps=2000,
+        decr_every_n_nan_or_inf=1,
+        use_dynamic_loss_scaling=True,
+    ):
+        self._enable = enable
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._scale = Tensor(jnp.asarray(init_loss_scaling, jnp.float32), name="loss_scale")
+        self._good_steps = Tensor(jnp.asarray(0, jnp.int32), name="good_steps")
+        self._bad_steps = Tensor(jnp.asarray(0, jnp.int32), name="bad_steps")
+        self._found_inf = Tensor(jnp.asarray(False), name="found_inf")
+        self._already_unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(self._scale._value)
+
+    def set_init_loss_scaling(self, v):
+        self._scale._replace_value(jnp.asarray(v, jnp.float32))
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return math_ops.multiply(loss, Tensor(self._scale._value))
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._already_unscaled:
+            return
+        inv = 1.0 / self._scale._value
+        found = jnp.asarray(False)
+        for p in optimizer._parameter_list:
+            if p._grad is None:
+                continue
+            g = p._grad._value * inv
+            found = found | ~jnp.all(jnp.isfinite(g))
+            p._grad._replace_value(g)
+        self._found_inf._replace_value(found)
+        self._already_unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        found = self._found_inf._value
+        # True skip on overflow without python branching (traceable): snapshot
+        # every optimizer-owned cell, run the step, then select old values back
+        # where inf was found. Momentum/weight-decay/step-count all revert.
+        if hasattr(optimizer, "_prime_accumulators"):
+            optimizer._prime_accumulators()
+        cells = [p for p in optimizer._parameter_list if not p.stop_gradient]
+        cells += optimizer._state_cells()
+        cells.append(optimizer._step_tensor)
+        old = [c._value for c in cells]
+        optimizer.step()
+        for c, o in zip(cells, old):
+            c._replace_value(jnp.where(found, o, c._value))
+        self._already_unscaled = False
+        if self._use_dynamic:
+            self._update_scale(found)
+
+    def _update_scale(self, found):
+        good = jnp.where(found, 0, self._good_steps._value + 1)
+        bad = jnp.where(found, self._bad_steps._value + 1, 0)
+        grow = good >= self._incr_every
+        shrink = bad >= self._decr_every
+        new_scale = jnp.where(
+            shrink,
+            jnp.maximum(self._scale._value * self._decr_ratio, 1.0),
+            jnp.where(grow, self._scale._value * self._incr_ratio, self._scale._value),
+        )
+        self._good_steps._replace_value(jnp.where(grow, 0, good))
+        self._bad_steps._replace_value(jnp.where(shrink, 0, bad))
+        self._scale._replace_value(new_scale)
+
+    def update(self):
+        if self._enable and self._use_dynamic:
+            self._update_scale(self._found_inf._value)
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every,
+            "decr_every_n_nan_or_inf": self._decr_every,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, state):
+        import numpy as np
+
+        for key, cell in (("scale", self._scale), ("good_steps", self._good_steps), ("bad_steps", self._bad_steps)):
+            if key in state:
+                v = state[key]
+                cell.set_value(v.numpy() if isinstance(v, Tensor) else np.asarray(v))

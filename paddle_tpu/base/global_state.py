@@ -66,39 +66,46 @@ def set_amp_state(st):
 class Generator:
     """Global RNG: a mutable cell holding a jax PRNG key.
 
-    ``split()`` returns a fresh subkey and advances the cell. The cell is
-    registered with the jit functionalizer so RNG advances correctly inside
-    compiled train steps.
+    The key lives inside a Tensor so the jit functionalizer's write
+    interception (Tensor._replace_value) captures RNG advancement — compiled
+    train steps thread the key through as donated state and the stream
+    continues correctly across eager/compiled boundaries.
     """
 
     def __init__(self, seed: int = 0):
-        import jax
-
         self._seed = seed
-        self._key = jax.random.PRNGKey(seed)
+        self._cell = None  # created lazily: core.tensor imports this module
+
+    @property
+    def _key_cell(self):
+        if self._cell is None:
+            import jax
+
+            from ..core.tensor import Tensor
+
+            self._cell = Tensor(jax.random.PRNGKey(self._seed), name="global_rng_key")
+        return self._cell
 
     def manual_seed(self, seed: int):
         import jax
 
         self._seed = seed
-        self._key = jax.random.PRNGKey(seed)
+        self._key_cell._replace_value(jax.random.PRNGKey(seed))
         return self
 
     def initial_seed(self) -> int:
         return self._seed
 
+    @property
+    def _key(self):
+        return self._key_cell._value
+
     def split(self):
         import jax
 
-        self._key, sub = jax.random.split(self._key)
+        new, sub = jax.random.split(self._key_cell._value)
+        self._key_cell._replace_value(new)
         return sub
-
-    # state-cell protocol for the jit functionalizer
-    def _cell_get(self):
-        return self._key
-
-    def _cell_set(self, v):
-        self._key = v
 
 
 default_generator = Generator(0)

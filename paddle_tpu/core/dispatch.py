@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from ..base import global_state
 from ..base.flags import get_flag
+from . import hooks
 from .tensor import Tensor, unwrap
 
 
@@ -51,10 +52,6 @@ def _check_nan_inf(name, values):
                 raise PreconditionNotMetError(f"op '{name}' produced NaN/Inf output")
 
 
-class _TapeNodeBuilder:
-    pass
-
-
 def primitive(
     name: str,
     fn: Callable,
@@ -72,6 +69,9 @@ def primitive(
     amp = global_state.amp_state()
     if amp is not None:
         tensor_args = amp.cast_inputs(name, tensor_args)
+
+    if hooks.discovery is not None:
+        hooks.discovery.record_reads(tensor_args)
 
     values = [unwrap(a) for a in tensor_args]
     grad_on = global_state.grad_enabled()
@@ -129,6 +129,8 @@ def _wrap_outputs(name, out, stop_gradient):
 def passthrough(name: str, fn: Callable, tensor_args: Sequence[Any], attrs: dict | None = None):
     """Non-differentiable op (integer/bool outputs, comparisons, argmax...)."""
     attrs = attrs or {}
+    if hooks.discovery is not None:
+        hooks.discovery.record_reads(tensor_args)
     values = [unwrap(a) for a in tensor_args]
     out = fn(*values, **attrs)
     outs = _wrap_outputs(name, out, stop_gradient=True)

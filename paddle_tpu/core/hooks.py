@@ -1,0 +1,9 @@
+"""Cross-cutting interception points for the jit functionalizer.
+
+``discovery`` is set by paddle_tpu/jit/functionalize.py during a discovery
+run; the dispatcher reports Tensor reads, Tensor._replace_value reports
+writes. Kept in its own module to avoid import cycles.
+"""
+from __future__ import annotations
+
+discovery = None  # Optional[DiscoveryContext]

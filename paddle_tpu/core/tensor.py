@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..base import dtype as dtype_mod
 from ..base import global_state
 from ..base.enforce import InvalidArgumentError, enforce
+from . import hooks
 
 
 def _to_jax(value, dtype=None):
@@ -64,6 +65,8 @@ class Tensor:
     )
 
     def __init__(self, value, dtype=None, stop_gradient=True, name=None, persistable=False):
+        if hooks.discovery is not None:
+            hooks.discovery.record_create(self)
         self._value = _to_jax(value, dtype)
         self.stop_gradient = bool(stop_gradient)
         self._grad = None
@@ -219,6 +222,8 @@ class Tensor:
     # -------------------------------------------------- mutation
     def _replace_value(self, new_value):
         """Swap the payload (functional mutation). Bumps the inplace version."""
+        if hooks.discovery is not None:
+            hooks.discovery.record_write(self)
         self._value = new_value
         self._version += 1
 

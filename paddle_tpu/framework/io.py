@@ -1,0 +1,54 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:773,
+1020 — pickled state_dicts with tensor payloads).
+
+Format: a pickle where Tensors are serialized as ("__tensor__", numpy array,
+declared dtype name). Compatible with nested dicts/lists of tensors (layer +
+optimizer state dicts)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return ("__tensor__", obj.numpy(), obj.dtype.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, tuple) and len(obj) == 3 and obj[0] == "__tensor__":
+        arr = obj[1]
+        if return_numpy:
+            return arr
+        return Tensor(arr)
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

@@ -1,0 +1,5 @@
+"""paddle.jit surface (reference: python/paddle/jit/api.py to_static :196,
+paddle.jit.save/load)."""
+from .api import TrainStep, ignore_module, not_to_static, to_static  # noqa: F401
+from .functionalize import CompiledFunction, functionalize  # noqa: F401
+from .serialization import load, save  # noqa: F401

@@ -1,0 +1,103 @@
+"""paddle.jit.to_static + TrainStep.
+
+to_static (reference python/paddle/jit/api.py:196) compiles a function or a
+Layer's forward into one XLA program via the discovery functionalizer —
+the TPU-native replacement for SOT bytecode capture + PIR programs: jax
+tracing IS the program capture, XLA IS the executor (SURVEY.md §7).
+
+TrainStep is the blessed whole-step compile: forward + backward + optimizer
+in one donated XLA program. hapi.Model and bench.py train through it.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+from ..core.tensor import Tensor
+from .functionalize import CompiledFunction, functionalize
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, full_graph=True, **kwargs):
+    """Decorator/wrapper: compile a function or Layer for whole-graph execution."""
+    from ..nn.layer.layers import Layer
+
+    if function is None:
+        return functools.partial(to_static, input_spec=input_spec, build_strategy=build_strategy, backend=backend, full_graph=full_graph)
+
+    if isinstance(function, Layer):
+        layer = function
+        orig_forward = layer.forward  # bound method, before the override below
+        compiled = CompiledFunction(
+            lambda *a, **k: orig_forward(*a, **k),
+            static_key_fn=lambda: ("train" if layer.training else "eval"),
+            name=type(layer).__name__,
+        )
+        layer._compiled_forward = compiled
+        # Layer.__call__ already runs forward pre/post hooks around
+        # self.forward, so the override is just the compiled function
+        layer.forward_origin = orig_forward
+        object.__setattr__(layer, "forward", compiled)
+        return layer
+
+    return CompiledFunction(function, name=getattr(function, "__name__", "fn"))
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+class TrainStep:
+    """Compile (forward + loss + backward + optimizer.step) into one XLA
+    program with donated parameter/optimizer-state buffers.
+
+    loss_fn(*batch) must build the loss from the model; or pass model and a
+    criterion: step = TrainStep(model=m, optimizer=opt, loss_fn=lambda x, y:
+    criterion(m(x), y)).
+
+    The scheduler LR enters the program as a traced input (not a baked
+    constant), so LR schedules do not retrace.
+    """
+
+    def __init__(self, model=None, optimizer=None, loss_fn: Optional[Callable] = None, grad_accum_steps: int = 1):
+        import jax.numpy as jnp
+
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self._lr_cell = Tensor(jnp.asarray(0.0, jnp.float32), name="lr_cell")
+
+        def step_fn(*batch):
+            loss = self.loss_fn(*batch)
+            loss.backward()
+            # read the LR through the dispatcher so the functionalizer records
+            # the cell (traced input, not baked constant)
+            lr_traced = (self._lr_cell + 0.0)._value
+            prev = getattr(self.optimizer, "_lr_override", None)
+            self.optimizer._lr_override = lr_traced
+            try:
+                self.optimizer.step()
+            finally:
+                self.optimizer._lr_override = prev
+            self.optimizer.clear_grad()
+            return loss
+
+        static_key = None
+        if model is not None:
+            static_key = lambda: ("train" if model.training else "eval")  # noqa: E731
+        self._compiled = CompiledFunction(step_fn, static_key_fn=static_key, name="train_step")
+
+    def __call__(self, *batch):
+        import jax.numpy as jnp
+
+        # refresh the LR cell from the schedule before entering the program
+        self._lr_cell._replace_value(jnp.asarray(self.optimizer.get_lr(), jnp.float32))
+        return self._compiled(*batch)
+
+    @property
+    def fallback_reason(self):
+        return self._compiled.fallback_reason

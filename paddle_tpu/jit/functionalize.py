@@ -1,0 +1,199 @@
+"""The compile path: eager code -> one XLA program.
+
+TPU-native replacement for the reference's whole to_static stack (SOT bytecode
+capture paddle/fluid/pybind/sot/eval_frame.c + dy2static AST transforms +
+PIR program + StandaloneExecutor, see SURVEY.md §3.3). The rebuild exploits
+that this framework's eager layer is jax-traceable end to end:
+
+1. **Discovery run** — execute the python function once eagerly while
+   intercepting every Tensor the dispatcher reads and every payload write
+   (core/hooks.py). That yields the *state cells*: parameters, buffers
+   (BatchNorm running stats), optimizer accumulators, the global RNG key —
+   exactly the variables the reference's program would hold. Writes are
+   rolled back afterwards, so discovery is side-effect-free.
+2. **Functionalization** — build ``pure(cell_values, args) -> (out,
+   new_cell_values)`` by installing traced values into the cells and re-running
+   the same python; jax.jit compiles it with the cell inputs donated (in-place
+   buffer reuse on TPU, the analog of the reference's inplace pass).
+3. **Execution** — subsequent calls run the compiled program and write the new
+   cell values back into the live objects.
+
+Python control flow on tensor *values* (``if float(loss) ...``) cannot be
+staged — like the reference's SOT graph-break fallback, the function then runs
+eagerly (recorded in ``fallback_reason``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base.log import get_logger
+from ..core import hooks
+from ..core.tensor import Tensor, unwrap
+
+
+class DiscoveryContext:
+    def __init__(self):
+        self.cells: Dict[int, Tensor] = {}
+        self.old_values: Dict[int, Any] = {}
+        self.arg_ids = set()
+        self.internal_ids = set()  # tensors created during discovery (intermediates)
+
+    def record_create(self, t: Tensor):
+        self.internal_ids.add(id(t))
+
+    def record_reads(self, tensor_args):
+        for a in tensor_args:
+            if (
+                isinstance(a, Tensor)
+                and id(a) not in self.arg_ids
+                and id(a) not in self.internal_ids
+                and id(a) not in self.cells
+            ):
+                self.cells[id(a)] = a
+
+    def record_write(self, t: Tensor):
+        if id(t) in self.arg_ids:
+            return
+        if id(t) not in self.old_values:
+            self.old_values[id(t)] = t._value
+        if id(t) not in self.cells:
+            self.cells[id(t)] = t
+
+    def rollback(self):
+        for tid, old in self.old_values.items():
+            self.cells[tid]._value = old  # raw restore, no re-interception
+
+
+def _tree_key(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    sig = tuple(
+        (tuple(l.shape), str(l.dtype)) if hasattr(l, "shape") else (type(l).__name__, l if isinstance(l, (int, float, bool, str, type(None))) else None)
+        for l in leaves
+    )
+    return treedef, sig
+
+
+def _clear_trace_residue(tensors):
+    """Drop autograd residue that closes over tracers after a trace."""
+    for t in tensors:
+        t._grad_node = None
+        if t._grad is not None and isinstance(t._grad._value, jax.core.Tracer):
+            t._grad = None
+
+
+class CompiledFunction:
+    """One to_static-compiled callable with a per-signature program cache."""
+
+    def __init__(self, fn: Callable, static_key_fn: Optional[Callable] = None, donate_cells=True, name=None):
+        self.fn = fn
+        self.static_key_fn = static_key_fn
+        self.donate_cells = donate_cells
+        self.name = name or getattr(fn, "__name__", "fn")
+        self._cache: Dict[Any, dict] = {}
+        self.fallback_reason: Optional[str] = None
+        self.last_entry: Optional[dict] = None
+
+    def _cache_key(self, args, kwargs):
+        treedef, sig = _tree_key((args, kwargs))
+        extra = self.static_key_fn() if self.static_key_fn else None
+        return (str(treedef), sig, extra)
+
+    def __call__(self, *args, **kwargs):
+        key = self._cache_key(args, kwargs)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(key, args, kwargs)
+        self.last_entry = entry
+        if entry.get("eager"):
+            return self.fn(*args, **kwargs)
+        return self._run(entry, args, kwargs)
+
+    # ------------------------------------------------------------------ build
+    def _build(self, key, args, kwargs):
+        ctx = DiscoveryContext()
+        arg_leaves = [
+            l
+            for l in jax.tree_util.tree_leaves(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
+            )
+            if isinstance(l, Tensor)
+        ]
+        ctx.arg_ids = {id(l) for l in arg_leaves}
+        prev = hooks.discovery
+        hooks.discovery = ctx
+        try:
+            self.fn(*args, **kwargs)
+        finally:
+            hooks.discovery = prev
+            ctx.rollback()
+
+        cells: List[Tensor] = list(ctx.cells.values())
+        fn = self.fn
+
+        def pure(cell_vals, a, kw):
+            saved = [c._value for c in cells]
+            for c, v in zip(cells, cell_vals):
+                c._value = v
+            try:
+                out = fn(*a, **kw)
+                new_vals = [c._value for c in cells]
+            finally:
+                for c, v in zip(cells, saved):
+                    c._value = v
+                _clear_trace_residue(cells)
+            # Tensors are pytree nodes: jit flattens/reconstructs the output
+            # structure itself (fresh Tensor wrappers around result arrays)
+            return out, new_vals
+
+        jitted = jax.jit(pure, donate_argnums=(0,) if self.donate_cells else ())
+        entry = {"cells": cells, "jitted": jitted, "eager": False, "compiled_once": False}
+        self._cache[key] = entry
+        return entry
+
+    # ------------------------------------------------------------------ run
+    def _run(self, entry, args, kwargs):
+        cells = entry["cells"]
+        cell_vals = [c._value for c in cells]
+        if self.donate_cells:
+            # donated buffers must be unique and must not alias non-donated
+            # args (jax caches small constants, so fresh zeros can share one
+            # buffer); copy aliased values
+            arg_ids = {
+                id(l._value)
+                for l in jax.tree_util.tree_leaves(
+                    (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
+                )
+                if isinstance(l, Tensor)
+            }
+            seen = set(arg_ids)
+            for i, v in enumerate(cell_vals):
+                if id(v) in seen:
+                    cell_vals[i] = jnp.array(v)
+                else:
+                    seen.add(id(v))
+        try:
+            out_vals, new_vals = entry["jitted"](cell_vals, args, kwargs)
+        except (
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerBoolConversionError,
+        ) as e:  # data-dependent python control flow: graph break -> eager
+            entry["eager"] = True
+            self.fallback_reason = str(e).split("\n")[0]
+            get_logger().warning("to_static fallback to eager for %s: %s", self.name, self.fallback_reason)
+            return self.fn(*args, **kwargs)
+        entry["compiled_once"] = True
+        for c, v in zip(cells, new_vals):
+            c._value = v
+            c._version += 1
+        return out_vals
+
+
+def functionalize(fn=None, *, static_key_fn=None, donate_cells=True, name=None):
+    if fn is None:
+        return functools.partial(functionalize, static_key_fn=static_key_fn, donate_cells=donate_cells, name=name)
+    return CompiledFunction(fn, static_key_fn=static_key_fn, donate_cells=donate_cells, name=name)

@@ -40,7 +40,10 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._accumulators: Dict[str, Dict[int, Tensor]] = defaultdict(dict)
         self._aux_state: Dict[str, Tensor] = {}
-        self._step_count = 0
+        # step counter lives in a Tensor cell so Adam-style bias correction is
+        # traced state, not a python constant baked into compiled programs
+        self._step_tensor = Tensor(jnp.asarray(0, jnp.int32), name="opt_step")
+        self._lr_override = None  # traced LR injected by jit.TrainStep
 
     # ------------------------------------------------ lr
     def get_lr(self) -> float:
@@ -89,8 +92,8 @@ class Optimizer:
         else:
             clipped = pg_for_clip
         clip_map = {id(p): g for p, g in clipped}
-        self._step_count += 1
-        lr = self.get_lr()
+        self._step_tensor._replace_value(self._step_tensor._value + 1)
+        lr = self._lr_override if self._lr_override is not None else self.get_lr()
         for p, _, group in pgs:
             g = clip_map.get(id(p))
             if g is None:
@@ -99,8 +102,26 @@ class Optimizer:
             wd = group.get("weight_decay", self._weight_decay)
             self._apply_one(p, g, group_lr, wd)
 
-    def _apply_one(self, p: Tensor, g: Tensor, lr: float, weight_decay):
+    def _apply_one(self, p: Tensor, g: Tensor, lr, weight_decay):
         raise NotImplementedError
+
+    def _step_value(self):
+        """Current step as a (possibly traced) array for update-rule math."""
+        return self._step_tensor._value.astype(jnp.float32)
+
+    @property
+    def _step_count(self):
+        import numpy as np
+
+        v = self._step_tensor._value
+        try:
+            return int(np.asarray(v))
+        except Exception:
+            return v
+
+    @_step_count.setter
+    def _step_count(self, v):
+        self._step_tensor._replace_value(jnp.asarray(int(v), jnp.int32))
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         loss.backward()
@@ -139,11 +160,18 @@ class Optimizer:
         out["@step"] = self._step_count
         return out
 
+    def _prime_accumulators(self):
+        """Eagerly create every accumulator (GradScaler snapshots and the jit
+        functionalizer need the full cell set before the first step)."""
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            for name in self._accum_names:
+                self._get_accumulator(name, p)
+
     def set_state_dict(self, state):
         import numpy as np
 
-        for name, store in list(self._accumulators.items()):
-            pass
         for p in self._parameter_list:
             for name in self._accum_names:
                 key = f"{p.name}_{name}"

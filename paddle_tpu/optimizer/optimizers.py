@@ -58,7 +58,7 @@ class Adam(Optimizer):
         gv = self._decayed_grad(p, g, weight_decay).astype(jnp.float32)
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
-        t = self._step_count
+        t = self._step_value()
         m_new = self._beta1 * m._value + (1 - self._beta1) * gv
         v_new = self._beta2 * v._value + (1 - self._beta2) * gv * gv
         m._replace_value(m_new)
@@ -107,6 +107,11 @@ class Adagrad(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._eps = epsilon
         self._init_acc = initial_accumulator_value
+
+    def _prime_accumulators(self):
+        for p in self._parameter_list:
+            if not p.stop_gradient:
+                self._get_accumulator("moment", p, fill=self._init_acc)
 
     def _apply_one(self, p, g, lr, weight_decay):
         gv = self._decayed_grad(p, g, weight_decay)
@@ -174,7 +179,7 @@ class Adamax(Optimizer):
         gv = self._decayed_grad(p, g, weight_decay)
         m = self._get_accumulator("moment", p)
         u = self._get_accumulator("inf_norm", p)
-        t = self._step_count
+        t = self._step_value()
         m_new = self._beta1 * m._value + (1 - self._beta1) * gv
         u_new = jnp.maximum(self._beta2 * u._value, jnp.abs(gv))
         m._replace_value(m_new)
@@ -198,7 +203,7 @@ class Lamb(Optimizer):
         gv = g._value.astype(jnp.float32)
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
-        t = self._step_count
+        t = self._step_value()
         m_new = self._beta1 * m._value + (1 - self._beta1) * gv
         v_new = self._beta2 * v._value + (1 - self._beta2) * gv * gv
         m._replace_value(m_new)

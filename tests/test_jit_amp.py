@@ -1,0 +1,203 @@
+"""jit/to_static/TrainStep + AMP tests (model: reference test/dygraph_to_static
+and test/amp)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.amp as amp
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.jit import TrainStep, to_static
+
+
+class TestToStatic:
+    def test_function_matches_eager(self):
+        def f(x, y):
+            return paddle.tanh(paddle.matmul(x, y)) + 1.0
+
+        cf = to_static(f)
+        x, y = paddle.randn([3, 4]), paddle.randn([4, 5])
+        np.testing.assert_allclose(cf(x, y).numpy(), f(x, y).numpy(), rtol=1e-5)
+        # second call: compiled path
+        np.testing.assert_allclose(cf(x, y).numpy(), f(x, y).numpy(), rtol=1e-5)
+        assert cf.last_entry["compiled_once"]
+
+    def test_layer_with_state(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.bn = nn.BatchNorm1D(4)
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc(self.bn(x))
+
+        m = to_static(M())
+        x = paddle.randn([8, 4])
+        m(x)
+        mean1 = m.bn._mean.numpy().copy()
+        m(x)
+        assert not np.allclose(mean1, m.bn._mean.numpy())  # stats advance in jit
+
+    def test_rng_advances_under_jit(self):
+        do = to_static(nn.Dropout(0.5))
+        x = paddle.ones([64])
+        a, b = do(x).numpy(), do(x).numpy()
+        assert not np.allclose(a, b)
+
+    def test_shape_polymorphism_via_cache(self):
+        cf = to_static(lambda x: paddle.sum(x * 2))
+        assert float(cf(paddle.ones([3])).numpy()) == pytest.approx(6.0)
+        assert float(cf(paddle.ones([5])).numpy()) == pytest.approx(10.0)
+        assert len(cf._cache) == 2
+
+    def test_graph_break_falls_back(self):
+        @to_static
+        def f(x):
+            if float(paddle.sum(x).numpy()) > 0:
+                return x * 2
+            return x * 3
+
+        out = f(paddle.ones([2]))
+        assert f.fallback_reason is not None
+        np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+        out2 = f(paddle.full([2], -1.0))
+        np.testing.assert_allclose(out2.numpy(), [-3.0, -3.0])
+
+
+class TestTrainStep:
+    def test_matches_eager_training(self):
+        def build():
+            paddle.seed(11)
+            net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+            return net, opt.Adam(0.02, parameters=net.parameters())
+
+        X = paddle.to_tensor(np.random.randn(32, 4).astype(np.float32))
+        Y = paddle.to_tensor(np.random.randn(32, 1).astype(np.float32))
+        crit = nn.MSELoss()
+
+        net1, opt1 = build()
+        step = TrainStep(model=net1, optimizer=opt1, loss_fn=lambda x, y: crit(net1(x), y))
+        for _ in range(5):
+            jl = step(X, Y)
+        assert step.fallback_reason is None
+
+        net2, opt2 = build()
+        for _ in range(5):
+            el = crit(net2(X), Y)
+            el.backward()
+            opt2.step()
+            opt2.clear_grad()
+        np.testing.assert_allclose(jl.numpy(), el.numpy(), rtol=1e-4, atol=1e-6)
+        for p1, p2 in zip(net1.parameters(), net2.parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_lr_schedule_no_retrace(self):
+        paddle.seed(0)
+        net = nn.Linear(2, 1)
+        sched = opt.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+        optim = opt.SGD(sched, parameters=net.parameters())
+        crit = nn.MSELoss()
+        step = TrainStep(model=net, optimizer=optim, loss_fn=lambda x, y: crit(net(x), y))
+        X, Y = paddle.ones([4, 2]), paddle.zeros([4, 1])
+        step(X, Y)
+        sched.step()
+        step(X, Y)
+        # one cache entry only — LR is a traced input, not a constant
+        assert len(step._compiled._cache) == 1
+
+
+class TestAmp:
+    def test_o1_white_black(self):
+        with amp.auto_cast(level="O1"):
+            a, b = paddle.randn([4, 8]), paddle.randn([8, 4])
+            c = paddle.matmul(a, b)
+            assert c.dtype == paddle.bfloat16
+            s = paddle.ops.activation.softmax(c)
+            assert s.dtype == paddle.float32  # black list op runs fp32
+
+    def test_o2(self):
+        with amp.auto_cast(level="O2"):
+            c = paddle.add(paddle.randn([4]), paddle.randn([4]))
+            assert c.dtype == paddle.bfloat16
+
+    def test_custom_lists(self):
+        with amp.auto_cast(custom_black_list={"matmul"}):
+            c = paddle.matmul(paddle.randn([2, 2]), paddle.randn([2, 2]))
+            assert c.dtype == paddle.float32
+
+    def test_amp_training_converges(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+        optim = opt.SGD(0.1, parameters=net.parameters())
+        X = paddle.to_tensor(np.random.randn(32, 4).astype(np.float32))
+        Y = paddle.to_tensor((X.numpy() @ np.ones((4, 1))).astype(np.float32))
+        crit = nn.MSELoss()
+        first = None
+        for _ in range(30):
+            with amp.auto_cast(level="O1"):
+                loss = crit(net(X), Y)
+            loss.backward()
+            optim.step()
+            optim.clear_grad()
+            if first is None:
+                first = float(loss.numpy())
+        assert float(loss.numpy()) < first * 0.5
+
+    def test_grad_scaler_skips_inf_step(self):
+        p = paddle.Parameter(np.ones(2, np.float32))
+        o = opt.SGD(0.1, parameters=[p])
+        scaler = amp.GradScaler(init_loss_scaling=4.0, decr_every_n_nan_or_inf=1)
+        p._grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+        scaler.step(o)
+        np.testing.assert_allclose(p.numpy(), [1.0, 1.0])  # step skipped
+        assert float(scaler._scale.numpy()) == pytest.approx(2.0)  # scale shrank
+
+    def test_decorate_o2(self):
+        net = nn.Linear(4, 4)
+        net2 = amp.decorate(net, level="O2", dtype="bfloat16")
+        assert net2.weight.dtype == paddle.bfloat16
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self):
+        d = tempfile.mkdtemp()
+        net = nn.Sequential(nn.Linear(3, 5), nn.ReLU(), nn.Linear(5, 2))
+        x = paddle.randn([4, 3])
+        paddle.save(net.state_dict(), os.path.join(d, "m.pdparams"))
+        net2 = nn.Sequential(nn.Linear(3, 5), nn.ReLU(), nn.Linear(5, 2))
+        net2.set_state_dict(paddle.load(os.path.join(d, "m.pdparams")))
+        np.testing.assert_allclose(net2(x).numpy(), net(x).numpy(), rtol=1e-6)
+
+    def test_optimizer_state_roundtrip(self):
+        d = tempfile.mkdtemp()
+        net = nn.Linear(2, 2)
+        o = opt.Adam(0.1, parameters=net.parameters())
+        loss = paddle.sum(net(paddle.ones([1, 2])))
+        loss.backward()
+        o.step()
+        paddle.save(o.state_dict(), os.path.join(d, "o.pdopt"))
+        o2 = opt.Adam(0.1, parameters=net.parameters())
+        o2.set_state_dict(paddle.load(os.path.join(d, "o.pdopt")))
+        assert o2._step_count == 1
+
+    def test_jit_export(self):
+        d = tempfile.mkdtemp()
+        from paddle_tpu.jit import load as jload, save as jsave
+
+        lin = nn.Linear(4, 2)
+        x = paddle.randn([3, 4])
+        jsave(lin, os.path.join(d, "exp"), input_spec=[paddle.zeros([3, 4])])
+        tl = jload(os.path.join(d, "exp"))
+        np.testing.assert_allclose(tl(x).numpy(), lin(x).numpy(), rtol=1e-5)
+
+    def test_nested_structures(self):
+        d = tempfile.mkdtemp()
+        obj = {"a": paddle.ones([2]), "nested": [paddle.zeros([3]), {"x": 5}], "s": "text"}
+        paddle.save(obj, os.path.join(d, "obj.pd"))
+        back = paddle.load(os.path.join(d, "obj.pd"))
+        np.testing.assert_allclose(back["a"].numpy(), np.ones(2))
+        assert back["nested"][1]["x"] == 5 and back["s"] == "text"
