@@ -212,6 +212,9 @@ from .ops.einsum_ops import einsum  # noqa: F401
 # cross / histogram live in linalg/math in paddle; re-exported above via linalg
 from .ops.math import cross, histogram, bincount  # noqa: F401,F811
 
+# method surface: every functional op becomes a Tensor method
+from .core import tensor_methods as _tensor_methods  # noqa: F401,E402
+
 # ---- grad / framework state -----------------------------------------------
 from .core import autograd as _autograd_mod
 

@@ -86,8 +86,7 @@ class GradScaler:
         for c, o in zip(cells, old):
             c._replace_value(jnp.where(found, o, c._value))
         self._already_unscaled = False
-        if self._use_dynamic:
-            self._update_scale(found)
+        self._pending_update = True
 
     def _update_scale(self, found):
         good = jnp.where(found, 0, self._good_steps._value + 1)
@@ -104,12 +103,18 @@ class GradScaler:
         self._scale._replace_value(new_scale)
 
     def update(self):
-        if self._enable and self._use_dynamic:
+        """Advance the dynamic scale once per step (reference grad_scaler.py:
+        the canonical sequence is step() then update(); minimize() does both).
+        Idempotent between steps so step()+update() applies exactly one scale
+        transition."""
+        if self._enable and self._use_dynamic and getattr(self, "_pending_update", False):
             self._update_scale(self._found_inf._value)
+            self._pending_update = False
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
         optimizer.clear_grad()
 
     def state_dict(self):

@@ -111,6 +111,20 @@ class Generator:
 default_generator = Generator(0)
 
 
+def swap_rng_cell(new_cell):
+    """Swap the generator's key *cell object*, returning the previous cell.
+
+    Object-level swap (not a value write) keeps named RNG streams
+    (mp RNGStatesTracker) trace-safe: under jit the stream's cell is simply a
+    different state cell for the functionalizer to capture — no concrete key
+    is baked into the program and no tracer leaks into host state.
+    """
+    _ = default_generator._key_cell  # force lazy creation
+    prev = default_generator._cell
+    default_generator._cell = new_cell
+    return prev
+
+
 def seed(s: int):
     """paddle.seed analog: reseed the global generator."""
     default_generator.manual_seed(int(s))

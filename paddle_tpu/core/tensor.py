@@ -233,7 +233,12 @@ class Tensor:
             tuple(v.shape) == tuple(self._value.shape),
             f"set_value shape mismatch: {v.shape} vs {self._value.shape}",
         )
-        self._replace_value(v.astype(self._value.dtype))
+        v = v.astype(self._value.dtype)
+        # keep an explicit mesh layout (TP/auto-parallel placement) sticky
+        old_sharding = getattr(self._value, "sharding", None)
+        if old_sharding is not None and getattr(old_sharding, "mesh", None) is not None and not isinstance(v, jax.core.Tracer):
+            v = jax.device_put(v, old_sharding)
+        self._replace_value(v)
 
     def copy_(self, other, blocking=True):
         self.set_value(other)

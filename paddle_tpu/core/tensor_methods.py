@@ -1,0 +1,81 @@
+"""Tensor method surface.
+
+Rebuild of the reference's method patching (python/paddle/tensor/__init__.py
+registers every functional op as a Tensor method; C++ side
+paddle/fluid/pybind/eager_method.cc). Every public function in the ops
+modules whose first parameter takes a Tensor becomes a bound method, so
+`x.sum(axis=1)`, `x.reshape([...])`, `x.matmul(y)` work exactly like
+`paddle.sum(x, axis=1)` etc.
+"""
+from __future__ import annotations
+
+import inspect
+
+from ..ops import (
+    activation,
+    creation,
+    einsum_ops,
+    linalg,
+    logic,
+    manipulation,
+    math,
+    random as random_ops,
+    search,
+    stat,
+)
+from .tensor import Tensor
+
+# names that must not be shadowed on the Tensor class
+_SKIP = {
+    "to_tensor", "arange", "linspace", "logspace", "eye", "meshgrid", "rand",
+    "randn", "randint", "randperm", "uniform", "normal", "standard_normal",
+    "empty", "full", "ones", "zeros", "tril_indices", "triu_indices",
+    "assign", "broadcast_shape",
+}
+
+_FIRST_PARAM_OK = {"x", "input", "tensor", "a", "t"}
+
+
+def _patchable(name, fn):
+    if name.startswith("_") or name in _SKIP:
+        return False
+    if not callable(fn) or inspect.isclass(fn):
+        return False
+    try:
+        params = list(inspect.signature(fn).parameters)
+    except (TypeError, ValueError):
+        return False
+    return bool(params) and params[0] in _FIRST_PARAM_OK
+
+
+def _install(module):
+    for name in dir(module):
+        fn = getattr(module, name)
+        if not _patchable(name, fn):
+            continue
+        if name in Tensor.__dict__:
+            continue
+        setattr(Tensor, name, fn)
+
+
+for _m in (math, manipulation, logic, search, stat, linalg, activation, einsum_ops, creation, random_ops):
+    _install(_m)
+
+
+# ---- specials whose functional signature differs from the method form ------
+def _not_shadow(name):
+    return name not in Tensor.__dict__
+
+
+if _not_shadow("matmul"):
+    Tensor.matmul = lambda self, y, transpose_x=False, transpose_y=False: math.matmul(
+        self, y, transpose_x, transpose_y
+    )
+
+Tensor.dim = lambda self: self.ndim
+Tensor.rank = lambda self: self.ndim
+Tensor.element_size = lambda self: self._value.dtype.itemsize
+Tensor.dot = lambda self, y: math.dot(self, y)
+Tensor.is_floating_point = lambda self: "float" in self.dtype.name or "bfloat" in self.dtype.name
+Tensor.is_complex = lambda self: "complex" in self.dtype.name
+Tensor.is_integer = lambda self: "int" in self.dtype.name and "uint" not in self.dtype.name or self.dtype.name == "uint8"

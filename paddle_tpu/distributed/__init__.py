@@ -1,0 +1,75 @@
+"""paddle_tpu.distributed — the distributed stack, TPU-native.
+
+Public surface mirrors python/paddle/distributed/__init__.py: bootstrap
+(init_parallel_env/get_rank/...), functional collectives, DataParallel, fleet
+(hybrid parallelism), auto_parallel (shard_tensor/reshard/...), sharding,
+checkpoint, launch. Implementation: ONE device mesh + XLA collectives
+(SURVEY.md §2.14 "comm backend inventory" TPU-native column).
+"""
+from __future__ import annotations
+
+from .env import (  # noqa: F401
+    HYBRID_AXES,
+    ParallelEnv as _Env,
+    barrier,
+    get_mesh,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+    set_mesh,
+)
+from .communication import (  # noqa: F401
+    Group,
+    P2POp,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    all_to_all_single,
+    alltoall,
+    batch_isend_irecv,
+    broadcast,
+    gather,
+    get_backend,
+    get_group,
+    irecv,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    shift,
+    wait,
+)
+from .parallel import DataParallel, ParallelEnv, shard_batch  # noqa: F401
+from .spmd import spmd, spmd_region, in_spmd_region  # noqa: F401
+
+from . import fleet  # noqa: F401
+from .auto_parallel.process_mesh import ProcessMesh  # noqa: F401
+from .auto_parallel.placement_type import Partial, Placement, Replicate, Shard  # noqa: F401
+from .auto_parallel.api import (  # noqa: F401
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)
+from . import checkpoint  # noqa: F401
+from . import sharding  # noqa: F401
+from .utils import moe_utils  # noqa: F401
+from .spawn import spawn  # noqa: F401
+
+
+def get_world_process_group():
+    from .communication import get_group
+
+    return get_group(0)
+
+
+def is_available() -> bool:
+    return True

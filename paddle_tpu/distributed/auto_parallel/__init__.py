@@ -1,0 +1,11 @@
+from .process_mesh import ProcessMesh  # noqa: F401
+from .placement_type import Partial, Placement, Replicate, Shard  # noqa: F401
+from .api import (  # noqa: F401
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    to_static,
+    unshard_dtensor,
+)

@@ -1,0 +1,90 @@
+"""DistEngine — static auto-parallel engine equivalent.
+
+Reference: python/paddle/distributed/auto_parallel/static/engine.py:98
+(prepare/fit/evaluate over a distributed program built by completion.py +
+partitioner.py + reshard.py). TPU-native: the "distributed program" is the
+whole-step jit of the sharded model — GSPMD performs completion (dist-attr
+propagation), partitioning (per-device program) and reshard (collective
+insertion) inside XLA.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class DistEngine:
+    def __init__(self, layer, loader=None, loss=None, optimizer=None, strategy=None):
+        from ...jit.api import TrainStep
+
+        self._layer = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy
+        self._step: Optional[TrainStep] = None
+
+    def _ensure_step(self):
+        if self._step is None:
+            from ...jit.api import TrainStep
+
+            def loss_fn(x, y):
+                out = self._layer(x)
+                return self._loss(out, y)
+
+            self._step = TrainStep(model=self._layer, optimizer=self._optimizer, loss_fn=loss_fn)
+        return self._step
+
+    # reference Engine surface
+    def fit(self, train_data=None, epochs=1, verbose=1, steps_per_epoch=None):
+        data = train_data if train_data is not None else self._loader
+        step = self._ensure_step()
+        history = []
+        for _ in range(epochs):
+            for i, batch in enumerate(data):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                if isinstance(batch, (list, tuple)):
+                    loss = step(*batch)
+                else:
+                    loss = step(batch)
+                history.append(loss)
+        return history
+
+    def evaluate(self, valid_data=None):
+        import numpy as np
+
+        data = valid_data if valid_data is not None else self._loader
+        was_training = self._layer.training
+        self._layer.eval()
+        losses = []
+        try:
+            for batch in data:
+                x, y = batch if isinstance(batch, (list, tuple)) else (batch, None)
+                out = self._layer(x)
+                losses.append(float(self._loss(out, y).numpy()))
+        finally:
+            if was_training:
+                self._layer.train()
+        return float(np.mean(losses)) if losses else 0.0
+
+    def predict(self, test_data=None):
+        data = test_data if test_data is not None else self._loader
+        was_training = self._layer.training
+        self._layer.eval()
+        outs = []
+        try:
+            for batch in data:
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                outs.append(self._layer(x))
+        finally:
+            if was_training:
+                self._layer.train()
+        return outs
+
+    def dist_main_program(self, mode="train"):
+        step = self._ensure_step()
+        entry = step._compiled.last_entry
+        return entry
+
+    def state_dict(self):
+        return self._layer.state_dict()

@@ -1,0 +1,87 @@
+"""Placement types (reference: paddle/phi/core/distributed/auto_parallel/
+placement_types.h; python surface paddle.distributed.{Shard,Replicate,Partial}).
+
+Shard(dim) / Replicate map 1:1 onto PartitionSpec entries. Partial(op) marks a
+pending cross-axis reduction; GSPMD tracks the same notion internally, and the
+reshard path materializes it with a psum when converting to Replicate/Shard.
+"""
+from __future__ import annotations
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+
+def to_partition_spec(placements, mesh_dim_names, ndim: int):
+    """placements (indexed by MESH dim) -> PartitionSpec (indexed by TENSOR dim)."""
+    from jax.sharding import PartitionSpec as P
+
+    entries = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            ax = mesh_dim_names[mesh_dim]
+            if entries[pl.dim] is None:
+                entries[pl.dim] = ax
+            elif isinstance(entries[pl.dim], tuple):
+                entries[pl.dim] = entries[pl.dim] + (ax,)
+            else:
+                entries[pl.dim] = (entries[pl.dim], ax)
+    return P(*entries)

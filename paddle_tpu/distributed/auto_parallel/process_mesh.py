@@ -1,0 +1,111 @@
+"""ProcessMesh — the auto-parallel mesh abstraction.
+
+Reference: python/paddle/distributed/auto_parallel/process_mesh.py (python
+view over paddle/phi/core/distributed/auto_parallel/process_mesh.h:34).
+
+TPU-native: a ProcessMesh is a *named view over jax devices*. `to_jax_mesh()`
+materializes the corresponding `jax.sharding.Mesh`, which is what every
+sharding annotation ultimately consumes. Process ids index `jax.devices()`
+(single-controller SPMD: one "process" per device).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None, shape=None, process_ids=None):
+        if shape is not None and process_ids is not None:
+            arr = np.asarray(process_ids).reshape(shape)
+        else:
+            arr = np.asarray(mesh)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self._mesh = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(f"dim_names {dim_names} rank != mesh ndim {arr.ndim}")
+        self._dim_names = list(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+    # ------------------------------------------------------------- properties
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(x) for x in self._mesh.flatten()]
+
+    @property
+    def size(self) -> int:
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, pid):
+        axis = self._dim_names.index(dim_name)
+        loc = np.argwhere(self._mesh == pid)
+        return int(loc[0][axis]) if len(loc) else -1
+
+    # ------------------------------------------------------------- jax bridge
+    def to_jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            if self._mesh.size > len(devs):
+                raise ValueError(
+                    f"ProcessMesh needs {self._mesh.size} devices, found {len(devs)}"
+                )
+            dev_arr = np.empty(self._mesh.shape, dtype=object)
+            flat = self._mesh.flatten()
+            for i, pid in enumerate(flat):
+                dev_arr.flat[i] = devs[int(pid)]
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    # ------------------------------------------------------------- misc
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._dim_names == other._dim_names
+            and np.array_equal(self._mesh, other._mesh)
+        )
+
+    def __hash__(self):
+        return hash((tuple(self._dim_names), self._mesh.tobytes(), self._mesh.shape))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    def __getitem__(self, item):
+        """Sub-mesh selection (reference ProcessMesh.__getitem__)."""
+        sub = self._mesh[item]
+        if np.isscalar(sub):
+            sub = np.asarray([sub])
+            return ProcessMesh(sub, ["d0"])
+        kept = [self._dim_names[i] for i, s in enumerate(np.shape(self._mesh)) if i >= self._mesh.ndim - sub.ndim]
+        return ProcessMesh(sub, kept[-sub.ndim:] if sub.ndim else ["d0"])
+
+
+def get_mesh_from_jax(jmesh: Mesh) -> ProcessMesh:
+    ids = np.vectorize(lambda d: d.id)(np.asarray(jmesh.devices))
+    return ProcessMesh(ids, list(jmesh.axis_names))

@@ -1,0 +1,482 @@
+"""Functional collectives + Group.
+
+Rebuild of python/paddle/distributed/communication/* (all_reduce.py:29 et al)
+and the Group abstraction (communication/group.py:29). The reference backs
+these with ProcessGroupNCCL (paddle/fluid/distributed/collective/
+process_group_nccl.h:37); here a Group is a *view over mesh axes* and every
+collective lowers to the matching XLA collective:
+
+    all_reduce      -> lax.psum / pmax / pmin
+    all_gather      -> lax.all_gather
+    reduce_scatter  -> lax.psum_scatter
+    all_to_all      -> lax.all_to_all
+    broadcast       -> select + psum (root's shard broadcast)
+    send/recv       -> lax.ppermute
+    scatter/gather  -> slice / all_gather at root
+
+Semantics by execution context:
+- inside an SPMD region (paddle_tpu.distributed.spmd) these are the per-device
+  collectives over the group's mesh axes — the hot path used by TP/PP/MoE/ring
+  attention, differentiable (JAX supplies collective VJPs: psum<->identity,
+  all_gather<->psum_scatter, ...);
+- outside a region, collectives act at the *process* level (multi-host eager):
+  with one controller per host group, world_size==jax.process_count(); on a
+  single process they are the world-size-1 identity, matching the reference's
+  behavior on one rank.
+
+All in-place-style ops mutate the Tensor payload through _replace_value so the
+jit functionalizer records them (see paddle_tpu/jit/functionalize.py).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import primitive, passthrough
+from ..core.tensor import Tensor
+from . import env as env_mod
+from .spmd import current_region_axes, in_spmd_region
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communicator: one or more mesh axes (reference Group: communication/
+    group.py:29; ProcessGroup: paddle/phi/core/distributed/collective/
+    process_group.h:48)."""
+
+    def __init__(self, axes: Sequence[str], gid: int = 0, name: Optional[str] = None):
+        self.axes = tuple(axes)
+        self.id = gid
+        self.name = name or ("world" if gid == 0 else f"group_{gid}")
+
+    @property
+    def nranks(self) -> int:
+        mesh = env_mod.get_mesh()
+        n = 1
+        for ax in self.axes:
+            n *= mesh.shape[ax]
+        return n
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        # process-level view; per-device rank exists only inside spmd regions
+        return env_mod.get_rank() % max(self.nranks, 1)
+
+    def get_group_rank(self, rank):
+        return rank
+
+    @property
+    def process_ids(self) -> List[int]:
+        return list(range(self.nranks))
+
+    ranks = process_ids
+
+    def __repr__(self):
+        return f"Group(axes={self.axes}, nranks={self.nranks})"
+
+
+_groups: dict = {}
+_next_gid = [1]
+
+
+def _world_group() -> Group:
+    if 0 not in _groups:
+        mesh = env_mod.get_mesh()
+        _groups[0] = Group(tuple(mesh.axis_names), gid=0)
+    return _groups[0]
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _world_group()
+    return _groups[gid]
+
+
+def new_group(ranks=None, backend=None, axes: Optional[Sequence[str]] = None, timeout=None) -> Group:
+    """Create a communicator. TPU-native callers pass mesh ``axes``; the
+    rank-list form (reference new_group) is honored for the world set and for
+    contiguous sub-axis groups."""
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    if axes is not None:
+        g = Group(axes, gid=gid)
+    else:
+        world = _world_group()
+        g = Group(world.axes, gid=gid)
+        if ranks is not None and len(ranks) not in (0, world.nranks):
+            # sub-world rank list: keep the intent (size) for spmd use; actual
+            # membership maps to an axis split chosen by fleet topology.
+            g._rank_list = list(ranks)
+    _groups[gid] = g
+    return g
+
+
+def _axes_of(group: Optional[Group]):
+    g = group if group is not None else _world_group()
+    # restrict to axes live in the current spmd region, if any
+    region = current_region_axes()
+    if region is not None:
+        axes = tuple(ax for ax in g.axes if ax in region)
+        return axes if axes else tuple(region)
+    return g.axes
+
+
+def _group_size(group: Optional[Group]) -> int:
+    g = group if group is not None else _world_group()
+    return g.nranks
+
+
+# --------------------------------------------------------------------- helpers
+def _eager_world() -> int:
+    return jax.process_count()
+
+
+def _identity_inplace(tensor: Tensor) -> Tensor:
+    return tensor
+
+
+# --------------------------------------------------------------------- ops
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
+    """In-place allreduce (reference communication/all_reduce.py:29)."""
+    if in_spmd_region():
+        axes = _axes_of(group)
+
+        def fn(x):
+            if op == ReduceOp.SUM:
+                return lax.psum(x, axes)
+            if op == ReduceOp.MAX:
+                return lax.pmax(x, axes)
+            if op == ReduceOp.MIN:
+                return lax.pmin(x, axes)
+            if op == ReduceOp.AVG:
+                return lax.pmean(x, axes)
+            if op == ReduceOp.PROD:
+                return lax.pprod(x, axes)
+            raise ValueError(f"unknown ReduceOp {op}")
+
+        out = primitive("all_reduce", fn, [tensor])
+        tensor._replace_value(out._value)
+        tensor.stop_gradient = out.stop_gradient
+        tensor._grad_node = out._grad_node
+        tensor._output_index = out._output_index
+        return tensor
+    if _eager_world() == 1:
+        return _identity_inplace(tensor)
+    from jax.experimental import multihost_utils
+
+    summed = multihost_utils.process_allgather(tensor._value)
+    if op == ReduceOp.SUM:
+        red = summed.sum(axis=0)
+    elif op == ReduceOp.MAX:
+        red = summed.max(axis=0)
+    elif op == ReduceOp.MIN:
+        red = summed.min(axis=0)
+    elif op == ReduceOp.AVG:
+        red = summed.mean(axis=0)
+    else:
+        red = np.prod(summed, axis=0)
+    tensor._replace_value(jnp.asarray(red))
+    return tensor
+
+
+def all_gather(tensor_list: Optional[List], tensor: Tensor, group: Optional[Group] = None, sync_op=True, axis: int = 0):
+    """reference communication/all_gather.py. Inside spmd regions, returns the
+    concatenated tensor (list API filled with per-rank slices)."""
+    if in_spmd_region():
+        axes = _axes_of(group)
+        out = primitive(
+            "all_gather",
+            lambda x: lax.all_gather(x, axes, axis=0, tiled=False).reshape((-1,) + x.shape),
+            [tensor],
+        )
+        if tensor_list is not None:
+            n = out._value.shape[0]
+            from ..ops import manipulation
+
+            tensor_list.clear()
+            tensor_list.extend(manipulation.unbind(out, 0))
+        return out
+    if _eager_world() == 1:
+        if tensor_list is not None:
+            tensor_list.clear()
+            tensor_list.append(tensor)
+        from ..ops import manipulation
+
+        return manipulation.unsqueeze(tensor, 0)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(tensor._value)
+    out = Tensor(jnp.asarray(gathered))
+    if tensor_list is not None:
+        tensor_list.clear()
+        tensor_list.extend(Tensor(g) for g in gathered)
+    return out
+
+
+def all_gather_object(object_list: List, obj, group=None):
+    object_list.clear()
+    if _eager_world() == 1:
+        object_list.append(obj)
+        return
+    raise NotImplementedError("multi-host object gather requires host RPC; use all_gather on tensors")
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_list, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
+    """reference communication/reduce_scatter.py — scatter dim 0."""
+    src = tensor_or_list
+    if isinstance(src, (list, tuple)):
+        from ..ops import manipulation
+
+        src = manipulation.concat(list(src), 0)
+    if in_spmd_region():
+        axes = _axes_of(group)
+        if op != ReduceOp.SUM:
+            raise NotImplementedError("reduce_scatter supports SUM on XLA")
+        out = primitive("reduce_scatter", lambda x: lax.psum_scatter(x, axes, scatter_dimension=0, tiled=True), [src])
+        tensor._replace_value(out._value)
+        tensor.stop_gradient = out.stop_gradient
+        tensor._grad_node = out._grad_node
+        return tensor
+    if _eager_world() == 1:
+        tensor._replace_value(src._value)
+        return tensor
+    raise NotImplementedError("process-level reduce_scatter: wrap the step in dist.spmd")
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None, sync_op=True):
+    """reference communication/all_to_all.py — also the Ulysses/MoE primitive."""
+    from ..ops import manipulation
+
+    if isinstance(in_tensor_list, Tensor):
+        stacked = in_tensor_list
+    else:
+        stacked = manipulation.stack(list(in_tensor_list), 0)
+    if in_spmd_region():
+        axes = _axes_of(group)
+        out = primitive(
+            "all_to_all",
+            lambda x: lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=False),
+            [stacked],
+        )
+    else:
+        if _eager_world() != 1:
+            raise NotImplementedError("process-level all_to_all: wrap the step in dist.spmd")
+        out = stacked
+    if out_tensor_list is not None:
+        out_tensor_list.clear()
+        out_tensor_list.extend(manipulation.unbind(out, 0))
+    return out
+
+
+def alltoall(in_tensor_or_list, out_tensor_list=None, group=None, sync_op=True):
+    return all_to_all(out_tensor_list, in_tensor_or_list, group=group, sync_op=sync_op)
+
+
+def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    if in_split_sizes or out_split_sizes:
+        raise NotImplementedError("uneven all_to_all splits are not supported on XLA; pad to equal splits")
+    if in_spmd_region():
+        axes = _axes_of(group)
+        out = primitive(
+            "all_to_all_single",
+            lambda x: lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=True),
+            [in_tensor],
+        )
+    else:
+        if _eager_world() != 1:
+            raise NotImplementedError("process-level all_to_all: wrap the step in dist.spmd")
+        out = in_tensor
+    if out_tensor is not None:
+        out_tensor._replace_value(out._value)
+        out_tensor._grad_node = out._grad_node
+        out_tensor.stop_gradient = out.stop_gradient
+        return out_tensor
+    return out
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_op=True):
+    """reference communication/broadcast.py — root rank's value to all."""
+    if in_spmd_region():
+        axes = _axes_of(group)
+
+        def fn(x):
+            idx = lax.axis_index(axes[0]) if len(axes) == 1 else _linear_axis_index(axes)
+            masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+            return lax.psum(masked, axes)
+
+        out = primitive("broadcast", fn, [tensor])
+        tensor._replace_value(out._value)
+        tensor._grad_node = out._grad_node
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    if _eager_world() == 1:
+        return _identity_inplace(tensor)
+    from jax.experimental import multihost_utils
+
+    val = multihost_utils.broadcast_one_to_all(tensor._value, is_source=env_mod.get_rank() == src)
+    tensor._replace_value(jnp.asarray(val))
+    return tensor
+
+
+def _linear_axis_index(axes):
+    idx = lax.axis_index(axes[0])
+    for ax in axes[1:]:
+        idx = idx * lax.axis_size(ax) + lax.axis_index(ax)
+    return idx
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
+    """All ranks compute the reduction; non-dst ranks simply keep it (XLA has
+    no cheaper rooted reduce on a torus)."""
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Optional[Group] = None, sync_op=True):
+    """reference communication/scatter.py — root's list scattered over ranks."""
+    from ..ops import manipulation
+
+    if in_spmd_region():
+        axes = _axes_of(group)
+        stacked = manipulation.stack(list(tensor_list), 0) if tensor_list else tensor
+
+        def fn(x):
+            idx = _linear_axis_index(axes)
+            return lax.dynamic_index_in_dim(x, idx, axis=0, keepdims=False)
+
+        out = primitive("scatter", fn, [stacked])
+        tensor._replace_value(out._value)
+        tensor._grad_node = out._grad_node
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    if _eager_world() == 1:
+        if tensor_list:
+            tensor._replace_value(tensor_list[src]._value)
+        return tensor
+    raise NotImplementedError("process-level scatter: wrap the step in dist.spmd")
+
+
+def gather(tensor: Tensor, gather_list=None, dst: int = 0, group=None, sync_op=True):
+    return all_gather(gather_list, tensor, group=group, sync_op=sync_op)
+
+
+def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None, sync_op=True):
+    """Point-to-point send (reference communication/send.py).
+
+    Rank-divergent standalone send/recv is MPMD; a single SPMD program cannot
+    express "my dst differs per rank" from one call site. Inside spmd regions
+    use `shift` (ring offset) or `batch_isend_irecv` with P2POp(offset=...) —
+    that is how the pipeline runtime exchanges stage activations.
+    """
+    if in_spmd_region():
+        raise NotImplementedError(
+            "standalone send() inside an spmd region: use dist.shift(tensor, offset) "
+            "or batch_isend_irecv with P2POp offsets (ring semantics)"
+        )
+    if _eager_world() == 1:
+        raise ValueError("send to self on a 1-process world")
+    raise NotImplementedError("process-level p2p: wrap the step in dist.spmd")
+
+
+def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_op=True):
+    if in_spmd_region():
+        raise NotImplementedError(
+            "standalone recv() inside an spmd region: use dist.shift(tensor, offset) "
+            "or batch_isend_irecv with P2POp offsets (ring semantics)"
+        )
+    if _eager_world() == 1:
+        raise ValueError("recv from self on a 1-process world")
+    raise NotImplementedError("process-level p2p: wrap the step in dist.spmd")
+
+
+def shift(tensor: Tensor, offset: int = 1, group: Optional[Group] = None):
+    """Ring shift over the group's (single) axis — the PP/ring-attention
+    primitive. rank i's tensor goes to rank (i+offset)%n."""
+    axes = _axes_of(group)
+    ax = axes[0]
+    n = env_mod.get_mesh().shape[ax]
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return primitive("shift", lambda x: lax.ppermute(x, ax, perm), [tensor])
+
+
+def isend(tensor, dst=0, group=None):
+    return _Task(send(tensor, dst, group))
+
+
+def irecv(tensor, src=0, group=None):
+    return _Task(recv(tensor, src, group))
+
+
+class _Task:
+    """Async task handle (reference ProcessGroup::Task). XLA dispatch is
+    already async; wait() is a scheduling no-op."""
+
+    def __init__(self, result=None):
+        self.result = result
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+class P2POp:
+    """One edge of a batched exchange. In SPMD the pattern must be uniform
+    across ranks, so the edge is an `offset` on the group's ring (dst = rank +
+    offset); `peer` is kept for reference-API compat and ignored when offset
+    is given."""
+
+    def __init__(self, op, tensor, peer=None, group=None, offset=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+        self.offset = offset
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]):
+    """Fused p2p batch (reference communication/batch_isend_irecv.py; NCCL
+    group call). Each send op becomes one ppermute ring-shift by its offset;
+    the recv op with the matching offset receives it (the reference pairs
+    send/recv the same way in P2pHelper: send to next / recv from prev)."""
+    if not in_spmd_region():
+        raise NotImplementedError("batch_isend_irecv outside an spmd region")
+    sends = [p for p in p2p_op_list if p.op in (isend, "isend", send)]
+    recvs = [p for p in p2p_op_list if p.op in (irecv, "irecv", recv)]
+    for s in sends:
+        if s.offset is None:
+            raise ValueError("SPMD batch_isend_irecv requires P2POp(offset=...) ring edges")
+        out = shift(s.tensor, offset=s.offset, group=s.group)
+        for r in recvs:
+            r_off = r.offset if r.offset is not None else None
+            if r_off == s.offset:
+                r.tensor._replace_value(out._value)
+                r.tensor._grad_node = out._grad_node
+                r.tensor.stop_gradient = out.stop_gradient
+    return [_Task()]
+
+
+def stream_allreduce(*a, **k):
+    return all_reduce(*a, **k)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    return None
+
+
+def get_backend(group=None):
+    return "xla"

@@ -1,0 +1,155 @@
+"""fleet — hybrid-parallel orchestration.
+
+Reference: python/paddle/distributed/fleet/ — fleet.init (fleet.py:218),
+distributed_model (model.py:32), distributed_optimizer (fleet.py:1427),
+HybridCommunicateGroup (base/topology.py:189), DistributedStrategy.
+
+TPU-native: fleet.init builds the 5-axis global mesh from
+strategy.hybrid_configs; distributed_model/optimizer attach sharding layouts
+instead of wrapping with reducer/pipeline runtimes — GSPMD + the whole-step
+jit do the communication scheduling.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .. import env as env_mod
+from ..parallel import DataParallel
+from .strategy import DistributedStrategy
+from .topology import (
+    HybridCommunicateGroup,
+    ParallelMode,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from . import mpu  # noqa: F401
+from . import sequence_parallel  # noqa: F401
+from .mpu import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+from .recompute import no_recompute, recompute, recompute_sequential  # noqa: F401
+from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+
+meta_parallel = mpu  # submodule alias: fleet.meta_parallel.* layer surface
+
+
+class _Fleet:
+    """The fleet singleton surface (reference fleet/base/fleet_base)."""
+
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        degrees = self._strategy.to_degrees()
+        env_mod.init_parallel_env(degrees)
+        hcg = HybridCommunicateGroup(degrees)
+        set_hybrid_communicate_group(hcg)
+        self._is_initialized = True
+        return self
+
+    def is_first_worker(self):
+        return env_mod.get_rank() == 0
+
+    def worker_index(self):
+        return env_mod.get_rank()
+
+    def worker_num(self):
+        return env_mod.get_world_size()
+
+    def is_worker(self):
+        return True
+
+    def worker_endpoints(self, to_string=False):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        env_mod.barrier()
+
+    @property
+    def _hcg(self):
+        return get_hybrid_communicate_group()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def distributed_model(self, model):
+        """reference fleet/model.py:32 — pick the parallel wrapper. TP/SP/PP
+        layers already carry their shardings; pure-DP gets the DataParallel
+        input-sharding wrapper."""
+        hcg = self._hcg
+        if hcg is None:
+            self.init()
+            hcg = self._hcg
+        mode = hcg.get_parallel_mode()
+        if mode == ParallelMode.DATA_PARALLEL and hcg.get_data_parallel_world_size() > 1:
+            return DataParallel(model)
+        from ..parallel import replicate_layer
+
+        # hybrid: parameters without explicit placements become replicated
+        replicate_layer(model, hcg.mesh)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """reference fleet.py:1427 -> HybridParallelOptimizer. Sharding-stage
+        configs shard the optimizer states over dp/sharding axes."""
+        st = strategy or self._strategy or DistributedStrategy()
+        if st.sharding or st.hybrid_configs.get("sharding_degree", 1) > 1 or (
+            env_mod.instance().axis_degrees.get("sharding", 1) > 1
+        ):
+            from ..auto_parallel.api import (
+                ShardingStage1,
+                ShardingStage2,
+                ShardingStage3,
+                shard_optimizer,
+            )
+
+            stage = {1: ShardingStage1, 2: ShardingStage2, 3: ShardingStage3}[
+                int(st.sharding_configs.get("stage", 1)) if st.sharding else 1
+            ]
+            axis = "sharding" if env_mod.instance().axis_degrees.get("sharding", 1) > 1 else "dp"
+            shard_optimizer(optimizer, stage(axis))
+        return optimizer
+
+    # utility surface
+    def set_log_level(self, level):
+        from ...base.log import get_logger
+
+        get_logger().setLevel(level)
+
+
+fleet = _Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+get_hybrid_communicate_group = get_hybrid_communicate_group  # noqa: PLW0127
+barrier_worker = fleet.barrier_worker
+
+__all__ = [
+    "fleet",
+    "init",
+    "DistributedStrategy",
+    "HybridCommunicateGroup",
+    "ParallelMode",
+    "distributed_model",
+    "distributed_optimizer",
+    "get_hybrid_communicate_group",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "ParallelCrossEntropy",
+    "get_rng_state_tracker",
+    "recompute",
+    "PipelineLayer",
+    "LayerDesc",
+]

@@ -1,0 +1,254 @@
+"""Pipeline parallelism: model surgery + schedules.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc (:57),
+SharedLayerDesc (:77), SegmentLayers (:93), PipelineLayer (:258); runtime
+schedules in fleet/meta_parallel/pipeline_parallel.py (1F1B :575, interleave
+:1174) over P2pHelper batched isend/irecv.
+
+TPU-native design: a pipeline stage is a *mesh-axis placement*, not a process.
+PipelineLayer segments the layer list and pins each segment's parameters to
+its stage's slice of the `pp` axis (NamedSharding over a stage-indexed
+dimension when weights stack homogeneously, or per-stage device_put
+otherwise). The schedule below runs the microbatch loop at the python level:
+losses/grads accumulate across microbatches inside one compiled step, giving
+1F1B's arithmetic (grad accumulation + sequential stage graph). XLA's
+latency-hiding scheduler overlaps the inter-stage transfers it inserts; an
+explicit ppermute ring schedule (zero-bubble analog for stacked homogeneous
+stages) is provided by paddle_tpu.distributed.fleet.pipeline_schedules.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py:57) so only the
+    owning stage would materialize it in multi-controller mode."""
+
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer shared between stages (reference :77) — embedding/
+    lm-head tying across first/last stage."""
+
+    def __init__(self, key, layer_cls, *inputs, forward_func=None, shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Split N layers into num_parts segments (reference :93): 'uniform' or
+    'layer' (param-count balanced)."""
+
+    def __init__(self, layers, num_parts, method="uniform"):
+        self.layers = layers
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self) -> List[int]:
+        n = len(self.layers)
+        if self.method == "uniform" or not self.method.startswith("param"):
+            base = n // self.num_parts
+            rem = n % self.num_parts
+            bounds = [0]
+            for i in range(self.num_parts):
+                bounds.append(bounds[-1] + base + (1 if i < rem else 0))
+            return bounds
+        weights = []
+        for l in self.layers:
+            if isinstance(l, LayerDesc):
+                weights.append(1)
+            elif isinstance(l, Layer):
+                weights.append(max(1, sum(int(np.prod(p.shape)) for p in l.parameters())))
+            else:
+                weights.append(1)
+        total = sum(weights)
+        target = total / self.num_parts
+        bounds, acc = [0], 0
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= target * len(bounds) and len(bounds) < self.num_parts:
+                bounds.append(i + 1)
+        while len(bounds) < self.num_parts + 1:
+            bounds.append(len(weights))
+        return bounds
+
+
+class PipelineLayer(Layer):
+    """Segmented model (reference pp_layers.py:258).
+
+    In single-controller SPMD every stage's weights live on its pp-axis slice;
+    the forward composes all segments (a full-graph program). The runtime
+    schedule (PipelineParallel.train_batch) microbatches it.
+    """
+
+    def __init__(
+        self,
+        layers: Sequence[Union[Layer, LayerDesc, Callable]],
+        num_stages: Optional[int] = None,
+        topology=None,
+        loss_fn=None,
+        seg_method="uniform",
+        recompute_interval=0,
+        **kwargs,
+    ):
+        super().__init__()
+        from .. import env as env_mod
+
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        degrees = env_mod.instance().axis_degrees or {}
+        self._num_stages = num_stages or max(degrees.get("pp", 1), 1)
+        descs = list(layers)
+        self._segment_bounds = SegmentLayers(descs, self._num_stages, seg_method).do_segment()
+        self._shared_layers = {}
+        built: List = []
+        for item in descs:
+            if isinstance(item, SharedLayerDesc):
+                if item.layer_name in self._shared_layers:
+                    src = self._shared_layers[item.layer_name]
+                    built.append(_SharedForward(src, item.forward_func))
+                else:
+                    layer = item.build_layer()
+                    self._shared_layers[item.layer_name] = layer
+                    built.append(layer)
+            elif isinstance(item, LayerDesc):
+                built.append(item.build_layer())
+            else:
+                built.append(item)
+        from ...nn.layer.container import LayerList
+
+        self.run_function = LayerList([l for l in built if isinstance(l, Layer)])
+        self._funcs = built
+        self._place_stages()
+
+    def _place_stages(self):
+        """Pin each segment's params to its pp-stage slice of the mesh."""
+        from .. import env as env_mod
+
+        mesh = env_mod.get_mesh()
+        if mesh is None or mesh.shape.get("pp", 1) <= 1:
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # stage-pinned placement: single-mesh GSPMD keeps arrays global; we
+        # shard each stage's largest weight dim over pp when divisible so the
+        # memory footprint splits across stage devices.
+        n = mesh.shape["pp"]
+        for si in range(self._num_stages):
+            seg = self._funcs[self._segment_bounds[si]:self._segment_bounds[si + 1]]
+            for l in seg:
+                if not isinstance(l, Layer):
+                    continue
+                for p in l.parameters():
+                    shape = p.shape
+                    best = None
+                    for d in range(len(shape)):
+                        if shape[d] % n == 0 and (best is None or shape[d] > shape[best]):
+                            best = d
+                    if best is not None and p._placements is None:
+                        spec = [None] * len(shape)
+                        spec[best] = "pp"
+                        p._replace_value(jax.device_put(p._value, NamedSharding(mesh, P(*spec))))
+
+    def get_stage_from_index(self, idx) -> int:
+        for si in range(self._num_stages):
+            if self._segment_bounds[si] <= idx < self._segment_bounds[si + 1]:
+                return si
+        return self._num_stages - 1
+
+    @property
+    def parameters_in_stage(self):
+        return self._segment_bounds
+
+    def forward(self, x):
+        out = x
+        for i, fn in enumerate(self._funcs):
+            if self._recompute_interval and isinstance(fn, Layer) and i % self._recompute_interval == 0:
+                from .recompute import recompute
+
+                out = recompute(fn, out)
+            elif isinstance(fn, Layer) or callable(fn):
+                out = fn(out)
+        return out
+
+
+class _SharedForward(Layer):
+    def __init__(self, src_layer, forward_func):
+        super().__init__()
+        self._src = [src_layer]  # not a sublayer: weights owned by src stage
+        self._forward_func = forward_func
+
+    def forward(self, x):
+        src = self._src[0]
+        if self._forward_func is not None:
+            return self._forward_func(src, x)
+        return src(x)
+
+
+class PipelineParallel(Layer):
+    """Schedule runtime (reference pipeline_parallel.py:255).
+
+    train_batch(batch, optimizer, lr_scheduler) microbatches the global batch
+    (1F1B arithmetic: per-microbatch forward+backward, accumulated grads, one
+    optimizer step)."""
+
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy
+        cfg = getattr(strategy, "pipeline_configs", None) if strategy else None
+        self._accumulate_steps = int(cfg.get("accumulate_steps", 1)) if cfg else 1
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from ...ops import manipulation
+
+        x, y = data
+        steps = max(self._accumulate_steps, 1)
+        micro_x = manipulation.split(x, steps, 0) if steps > 1 else [x]
+        micro_y = manipulation.split(y, steps, 0) if steps > 1 else [y]
+        total = None
+        for mx, my in zip(micro_x, micro_y):
+            out = self._layers(mx)
+            loss = self._layers._loss_fn(out, my)
+            if scaler is not None:
+                scaled = scaler.scale(loss / steps)
+                scaled.backward()
+            else:
+                (loss / steps).backward()
+            total = loss if total is None else total + loss
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total / steps
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x)
+        return self._layers._loss_fn(out, y) if compute_loss else out

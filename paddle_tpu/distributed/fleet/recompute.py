@@ -1,0 +1,76 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference: distributed/fleet/recompute/recompute.py — a PyLayer that drops
+activations in forward and replays the block (with RNG state restore) in
+backward; recompute_hybrid.py adds mp-aware offload.
+
+TPU-native: `jax.checkpoint` (remat) IS this feature — XLA rematerializes the
+block inside the fused backward, with policy control over what to keep. RNG
+replay is structural: the PRNG key consumed by the block is part of its
+inputs, so the replay uses the same key. The wrapper below bridges the eager
+tape: it discovers the parameters/state the block reads, forms a pure
+function, and differentiates through jax.checkpoint of it.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from ...core import hooks
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor
+from ...jit.functionalize import DiscoveryContext
+
+
+def recompute(function: Callable, *args, use_reentrant=True, preserve_rng_state=True, **kwargs):
+    """Run `function(*args)` so its backward recomputes instead of storing
+    (reference recompute.py surface, incl. functools.partial-style usage)."""
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+    # discover non-arg state the block reads (parameters, buffers, RNG cell)
+    ctx = DiscoveryContext()
+    ctx.arg_ids = {id(t) for t in tensor_args}
+    prev = hooks.discovery
+    hooks.discovery = ctx
+    try:
+        function(*args, **kwargs)
+    finally:
+        hooks.discovery = prev
+        ctx.rollback()
+    cells = list(ctx.cells.values())
+
+    n_args = len(tensor_args)
+
+    def pure(*vals):
+        arg_vals, cell_vals = vals[:n_args], vals[n_args:]
+        saved_args = [t._value for t in tensor_args]
+        saved_cells = [c._value for c in cells]
+        for t, v in zip(tensor_args, arg_vals):
+            t._value = v
+        for c, v in zip(cells, cell_vals):
+            c._value = v
+        try:
+            out = function(*args, **kwargs)
+            return out._value if isinstance(out, Tensor) else tuple(o._value for o in out)
+        finally:
+            for t, v in zip(tensor_args, saved_args):
+                t._value = v
+            for c, v in zip(cells, saved_cells):
+                c._value = v
+                c._grad_node = None
+
+    return primitive("recompute", jax.checkpoint(pure), tensor_args + cells)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference recompute_sequential: chain recompute over segments."""
+    out = args
+    for fn in functions:
+        out = (recompute(fn, *out, **kwargs),)
+    return out[0]
+
+
+def no_recompute(function, *args, **kwargs):
+    """reference no_recompute: escape hatch inside a recomputed region."""
+    return function(*args, **kwargs)

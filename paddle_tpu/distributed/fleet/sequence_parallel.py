@@ -1,0 +1,107 @@
+"""Megatron-style sequence parallelism.
+
+Reference: fleet/utils/sequence_parallel_utils.py — ScatterOp:85 / GatherOp:97
+/ AllGatherOp:111 / ReduceScatterOp:127 autograd ops, and Column/Row
+SequenceParallelLinear (:429/:564) that allgather activations forward and
+reduce-scatter backward over the mp group.
+
+TPU-native: "sequence parallel" means the activation's sequence dim is
+sharded over the mp axis in the norm/dropout regions and the feature dim is
+sharded inside the TP matmul pair. Each reference op is a sharding
+constraint; GSPMD emits exactly the allgather/reduce-scatter pair (and can
+overlap it with the matmuls, which the reference needed a hand-written
+SPInnerOverlapLinear for).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from .. import env as env_mod
+from .mpu import ColumnParallelLinear, RowParallelLinear, _constrain, _batch_spec, _feature_spec
+
+_SP_AXIS = "mp"  # Megatron-SP rides the mp axis; SEP has its own axis ("sep")
+
+
+def _seq_spec(ndim: int, seq_dim: int = 1, axis=_SP_AXIS):
+    entries = [None] * ndim
+    entries[0] = "dp"
+    entries[seq_dim] = axis
+    return P(*entries)
+
+
+def mark_as_sequence_parallel(x: Tensor, seq_dim: int = 1, axis=_SP_AXIS) -> Tensor:
+    """Constrain x sequence-sharded (the ScatterOp analog)."""
+    return _constrain(x, _seq_spec(x.ndim, seq_dim, axis))
+
+
+class ScatterOp:
+    """reference :85 — split sequence over the group. Static apply() surface."""
+
+    @staticmethod
+    def apply(x, seq_dim=1):
+        return mark_as_sequence_parallel(x, seq_dim)
+
+
+class GatherOp:
+    """reference :97 — gather the sequence dim back to full."""
+
+    @staticmethod
+    def apply(x, seq_dim=1):
+        return _constrain(x, _batch_spec(x.ndim))
+
+
+class AllGatherOp:
+    """reference :111 — allgather fwd / reduce-scatter bwd: the fwd boundary
+    into a TP block."""
+
+    @staticmethod
+    def apply(x):
+        return _constrain(x, _batch_spec(x.ndim))
+
+
+class ReduceScatterOp:
+    """reference :127 — reduce-scatter fwd / allgather bwd: the boundary out
+    of a TP block back to sequence-sharded."""
+
+    @staticmethod
+    def apply(x, seq_dim=1):
+        return mark_as_sequence_parallel(x, seq_dim)
+
+
+def scatter(x, seq_dim=1):
+    return ScatterOp.apply(x, seq_dim)
+
+
+def all_gather(x):
+    return AllGatherOp.apply(x)
+
+
+def reduce_scatter(x, seq_dim=1):
+    return ReduceScatterOp.apply(x, seq_dim)
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """reference :429 — column TP linear whose input arrives sequence-sharded."""
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """reference :564 — row TP linear whose output leaves sequence-sharded."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        return ReduceScatterOp.apply(out)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1, fuse=False):
+    """reference :192 syncs LayerNorm params across mp ranks. Replicated
+    NamedSharding layouts make those grads structurally synchronized; no hook
+    is needed — kept for API parity."""
+    return model

@@ -1,0 +1,158 @@
+"""Hybrid-parallel topology.
+
+Reference: fleet/base/topology.py — CommunicateTopology (:70) and
+HybridCommunicateGroup (:189) carve the world into pp/dp/sharding/sep/mp
+process groups via rank arithmetic + new_group NCCL rings.
+
+TPU-native: the topology IS the mesh. Degrees select the sizes of the five
+named mesh axes (env.HYBRID_AXES); a "communication group" is a Group bound to
+one axis. No rank arithmetic, no ring bootstrap — XLA routes collectives over
+ICI/DCN according to the mesh layout.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .. import env as env_mod
+from ..communication import Group, new_group
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._names = list(hybrid_group_names or ["pipe", "data", "sharding", "sep", "model"])
+        self._dims = list(dims or [1] * len(self._names))
+
+    def get_hybrid_group_names(self):
+        return list(self._names)
+
+    def get_dim(self, name):
+        return self._dims[self._names.index(name)]
+
+    def world_size(self):
+        out = 1
+        for d in self._dims:
+            out *= d
+        return out
+
+
+_name_to_axis = {"data": "dp", "pipe": "pp", "model": "mp", "sharding": "sharding", "sep": "sep"}
+
+
+class HybridCommunicateGroup:
+    """Axis-group view over the global mesh (reference topology.py:189)."""
+
+    def __init__(self, degrees: Optional[Dict[str, int]] = None):
+        degrees = dict(degrees or {})
+        env_mod.init_parallel_env(degrees)
+        self._mesh = env_mod.get_mesh()
+        self._degrees = env_mod.instance().axis_degrees
+        self._topo = CommunicateTopology(
+            ["pipe", "data", "sharding", "sep", "model"],
+            [self._degrees[a] for a in ("pp", "dp", "sharding", "sep", "mp")],
+        )
+        self._groups: Dict[str, Group] = {
+            ax: new_group(axes=(ax,)) for ax in env_mod.HYBRID_AXES
+        }
+        # fused group used by sharded-dp collectives
+        self._groups["dp_sharding"] = new_group(axes=("dp", "sharding"))
+
+    @property
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._degrees["pp"] > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._degrees["mp"] > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._degrees["sharding"] > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._degrees["sep"] > 1:
+            return ParallelMode.SEGMENT_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    # ------------------------------------------------ sizes (reference names)
+    def get_data_parallel_world_size(self):
+        return self._degrees["dp"]
+
+    def get_model_parallel_world_size(self):
+        return self._degrees["mp"]
+
+    def get_pipe_parallel_world_size(self):
+        return self._degrees["pp"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._degrees["sharding"]
+
+    def get_sep_parallel_world_size(self):
+        return self._degrees["sep"]
+
+    # ranks are process-level (single controller: 0); per-device ranks exist
+    # inside compiled programs only.
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # ------------------------------------------------ groups
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, *a, **k) -> Group:
+        return self._groups["mp"]
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def nranks(self):
+        return self._mesh.size
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

@@ -1,0 +1,135 @@
+"""DataParallel + parallel-env entry points.
+
+Reference: `paddle.DataParallel` (python/paddle/distributed/parallel.py:219)
+wraps a Layer and hooks a C++ Reducer (paddle/fluid/distributed/collective/
+reducer.cc) that buckets gradients and overlaps NCCL allreduce with backward.
+
+TPU-native design: none of that machinery exists because XLA *is* the reducer.
+Parameters are laid out replicated over the mesh; the batch is sharded over
+the `dp` axis. Under GSPMD, the backward of a replicated->sharded use is a
+psum — the gradient allreduce — which XLA's latency-hiding scheduler overlaps
+with the rest of the backward automatically, fused and bucketed better than a
+hand-written reducer. `no_sync` falls out as not-yet-averaged local grads only
+in multi-controller mode; in single-controller SPMD it is a no-op context.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import env as env_mod
+from .env import init_parallel_env  # re-export  # noqa: F401
+
+
+def _shard_value(value, mesh, spec):
+    return jax.device_put(value, NamedSharding(mesh, spec))
+
+
+def shard_batch(x, mesh=None, axis: str = "dp", dim: int = 0):
+    """Place a host batch sharded along the data axis (the input pipeline's
+    device_put; reference: DataLoader places on each rank's GPU)."""
+    mesh = mesh or env_mod.get_mesh()
+    if mesh.shape[axis] == 1:
+        return x
+    spec = [None] * getattr(x, "ndim", 1)
+    spec[dim] = axis
+    if isinstance(x, Tensor):
+        x._replace_value(_shard_value(x._value, mesh, P(*spec)))
+        return x
+    return _shard_value(x, mesh, P(*spec))
+
+
+def replicate_layer(layer: Layer, mesh=None):
+    """Pin every parameter/buffer replicated over the mesh (so GSPMD sees an
+    explicit layout rather than single-device arrays)."""
+    mesh = mesh or env_mod.get_mesh()
+    for p in layer.parameters(include_sublayers=True):
+        if p._placements is None:  # keep explicit TP/auto-parallel placements
+            p._replace_value(_shard_value(p._value, mesh, P()))
+    for _, buf in layer.named_buffers():
+        if buf._placements is None:
+            buf._replace_value(_shard_value(buf._value, mesh, P()))
+    return layer
+
+
+class DataParallel(Layer):
+    """Data-parallel wrapper (reference parallel.py:219).
+
+    Usage matches the reference: model = paddle.DataParallel(model); the
+    wrapper shards `Tensor` positional inputs along dim 0 over the `dp` mesh
+    axis and replicates parameters. Gradient synchronization is implicit in
+    XLA's partitioning of the backward.
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1,
+                 find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._mesh = env_mod.get_mesh()
+        self._dp_axis = "dp"
+        replicate_layer(layers, self._mesh)
+
+    def forward(self, *inputs, **kwargs):
+        sharded = tuple(
+            shard_batch(x, self._mesh, self._dp_axis) if isinstance(x, Tensor) else x
+            for x in inputs
+        )
+        return self._layers(*sharded, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Grad-sync-free region (reference parallel.py DataParallel.no_sync).
+        In single-controller SPMD gradients are only materialized at step
+        boundaries, so accumulation without sync is already the default."""
+        yield
+
+    # state passthrough: checkpoints see the inner layer's names
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, state_dict, *a, **k):
+        return self._layers.set_state_dict(state_dict, *a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def train(self):
+        self._layers.train()
+        return super().train()
+
+    def eval(self):
+        self._layers.eval()
+        return super().eval()
+
+
+class ParallelEnv:
+    """Env-var view compat (reference base/dygraph ParallelEnv)."""
+
+    @property
+    def rank(self):
+        return env_mod.get_rank()
+
+    local_rank = rank
+
+    @property
+    def world_size(self):
+        return env_mod.get_world_size()
+
+    nranks = world_size
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def dev_id(self):
+        return 0

@@ -1,0 +1,36 @@
+"""paddle.distributed.spawn (reference distributed/spawn.py): multiprocessing
+launcher alternative to the CLI. In single-controller SPMD one process drives
+all local devices, so nprocs defaults to 1 per host; multi-host spawning goes
+through paddle_tpu.distributed.launch.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+
+def _worker(fn, rank, nprocs, args, env):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    fn(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    if nprocs <= 1:
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    procs = []
+    env = {k: v for k, v in os.environ.items() if k.startswith(("PADDLE_", "MASTER_", "JAX_", "XLA_"))}
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker, args=(func, rank, nprocs, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned rank exited with {p.exitcode}")
+    return procs

@@ -1,0 +1,25 @@
+"""MoE exchange collectives.
+
+Reference: distributed/utils/moe_utils.py — global_scatter (:20) /
+global_gather (:153): counts-driven uneven all-to-all moving expert-assigned
+tokens between ranks.
+
+TPU-native: XLA all_to_all is even-split, so the dispatch path uses
+capacity-bucketed dense layouts (tokens padded per expert to capacity) and a
+single lax.all_to_all over the `ep` group — see paddle_tpu.incubate.moe for
+the full MoE layer + gates. The functions below keep the reference signature
+for capacity-shaped tensors.
+"""
+from __future__ import annotations
+
+from ..communication import all_to_all_single
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None):
+    """Token dispatch across expert ranks (capacity-dense layout)."""
+    return all_to_all_single(None, x, group=group)
+
+
+def global_gather(x, local_count=None, global_count=None, group=None):
+    """Inverse of global_scatter."""
+    return all_to_all_single(None, x, group=group)

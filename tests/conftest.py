@@ -17,6 +17,13 @@ os.environ["PYTHONPATH"] = ":".join(
     p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
 )
 
+# The axon sitecustomize re-pins JAX_PLATFORMS=axon at interpreter startup,
+# overriding the env var above; jax.config wins over the env var as long as it
+# runs before backend initialization.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,173 @@
+"""Hybrid-parallel (fleet) tests: TP layers numerically match their serial
+counterparts while carrying mp shardings (reference:
+test/collective/fleet/hybrid_parallel_mp_layers.py compares parallel vs
+serial results)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _env():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    yield
+
+
+def test_hcg_degrees():
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_model_parallel_world_size() == 4
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_pipe_parallel_world_size() == 1
+
+
+def test_column_row_parallel_linear_parity():
+    import paddle_tpu.nn as nn
+
+    rs = np.random.RandomState(0)
+    w1 = rs.randn(8, 16).astype(np.float32)
+    w2 = rs.randn(16, 8).astype(np.float32)
+    x = paddle.to_tensor(rs.randn(4, 8).astype(np.float32))
+
+    col = fleet.ColumnParallelLinear(8, 16, gather_output=False, has_bias=True)
+    row = fleet.RowParallelLinear(16, 8, input_is_parallel=True, has_bias=True)
+    col.weight.set_value(w1)
+    row.weight.set_value(w2)
+
+    out = row(col(x))
+    expect = (x.numpy() @ w1) @ w2
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-4)
+    # weights actually carry mp shardings
+    assert "mp" in str(col.weight._value.sharding.spec)
+    assert "mp" in str(row.weight._value.sharding.spec)
+
+
+def test_column_parallel_grad_parity():
+    rs = np.random.RandomState(1)
+    w = rs.randn(6, 12).astype(np.float32)
+    x = paddle.to_tensor(rs.randn(3, 6).astype(np.float32))
+
+    col = fleet.ColumnParallelLinear(6, 12, gather_output=True, has_bias=False)
+    col.weight.set_value(w)
+    loss = col(x).sum()
+    loss.backward()
+
+    expect_grad = np.ones((3, 12), np.float32)
+    np.testing.assert_allclose(
+        col.weight.grad.numpy(), x.numpy().T @ expect_grad, rtol=1e-4, atol=1e-4
+    )
+
+
+def test_vocab_parallel_embedding_parity():
+    rs = np.random.RandomState(2)
+    table = rs.randn(32, 8).astype(np.float32)
+    ids = paddle.to_tensor(np.array([[1, 5, 31], [0, 2, 16]], np.int64))
+
+    emb = fleet.VocabParallelEmbedding(32, 8)
+    emb.weight.set_value(table)
+    out = emb(ids)
+    np.testing.assert_allclose(out.numpy(), table[ids.numpy()], rtol=1e-5)
+
+
+def test_parallel_cross_entropy_parity():
+    import paddle_tpu.nn.functional as F
+
+    rs = np.random.RandomState(3)
+    logits_np = rs.randn(4, 32).astype(np.float32)
+    labels_np = rs.randint(0, 32, (4,)).astype(np.int64)
+
+    pce = fleet.ParallelCrossEntropy()
+    loss = pce(paddle.to_tensor(logits_np), paddle.to_tensor(labels_np))
+    ref = F.cross_entropy(
+        paddle.to_tensor(logits_np), paddle.to_tensor(labels_np), reduction="none"
+    )
+    np.testing.assert_allclose(loss.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_parallel_marks():
+    from paddle_tpu.distributed.fleet import sequence_parallel as sp
+
+    x = paddle.ones([4, 8, 16])
+    xs = sp.scatter(x)
+    assert xs.shape == [4, 8, 16]
+    xg = sp.all_gather(xs)
+    np.testing.assert_allclose(xg.numpy(), x.numpy())
+
+
+def test_recompute_matches_plain():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(11)
+    m = nn.Linear(8, 8)
+    x = paddle.to_tensor(np.random.RandomState(4).randn(2, 8).astype(np.float32))
+
+    loss1 = m(x).sum()
+    loss1.backward()
+    g1 = m.weight.grad.numpy().copy()
+    m.clear_gradients()
+
+    loss2 = fleet.recompute(lambda v: m(v), x).sum()
+    loss2.backward()
+    np.testing.assert_allclose(float(loss1.numpy()), float(loss2.numpy()), rtol=1e-5)
+    np.testing.assert_allclose(g1, m.weight.grad.numpy(), rtol=1e-5)
+
+
+def test_rng_tracker_streams():
+    tracker = fleet.get_rng_state_tracker()
+    with tracker.rng_state("model_parallel_rng"):
+        a = paddle.rand([4])
+    with tracker.rng_state("model_parallel_rng"):
+        b = paddle.rand([4])
+    assert not np.allclose(a.numpy(), b.numpy())  # stream advances
+
+
+def test_pipeline_layer_segments_and_runs():
+    import paddle_tpu.nn as nn
+
+    descs = [fleet.LayerDesc(nn.Linear, 8, 8) for _ in range(6)]
+    pipe = fleet.PipelineLayer(layers=descs, num_stages=2, loss_fn=lambda o, y: (o - y).square().mean())
+    assert pipe._segment_bounds == [0, 3, 6]
+    x = paddle.ones([2, 8])
+    out = pipe(x)
+    assert out.shape == [2, 8]
+
+
+def test_pipeline_train_batch_matches_plain():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.pipeline import PipelineParallel
+
+    def build():
+        paddle.seed(21)
+        return fleet.PipelineLayer(
+            layers=[fleet.LayerDesc(nn.Linear, 4, 4), fleet.LayerDesc(nn.Linear, 4, 4)],
+            num_stages=1,
+            loss_fn=lambda o, y: (o - y).square().mean(),
+        )
+
+    x = paddle.to_tensor(np.random.RandomState(5).randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(6).randn(8, 4).astype(np.float32))
+
+    # plain step
+    m1 = build()
+    o1 = opt.SGD(learning_rate=0.1, parameters=m1.parameters())
+    loss1 = m1._loss_fn(m1(x), y)
+    loss1.backward()
+    o1.step()
+
+    # microbatched train_batch (2 accumulation steps)
+    m2 = build()
+    o2 = opt.SGD(learning_rate=0.1, parameters=m2.parameters())
+
+    class _S:
+        pipeline_configs = {"accumulate_steps": 2}
+
+    pp = PipelineParallel(m2, strategy=_S())
+    pp.train_batch((x, y), o2)
+
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4, atol=1e-5)

@@ -153,8 +153,11 @@ class TestAmp:
         scaler = amp.GradScaler(init_loss_scaling=4.0, decr_every_n_nan_or_inf=1)
         p._grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
         scaler.step(o)
+        scaler.update()
         np.testing.assert_allclose(p.numpy(), [1.0, 1.0])  # step skipped
         assert float(scaler._scale.numpy()) == pytest.approx(2.0)  # scale shrank
+        scaler.update()  # idempotent between steps: no second transition
+        assert float(scaler._scale.numpy()) == pytest.approx(2.0)
 
     def test_decorate_o2(self):
         net = nn.Linear(4, 4)
