@@ -161,18 +161,9 @@ class ShardingStage3(_ShardingStage):
 
 def _shard_over_axis(value, mesh: ProcessMesh, axis_name: str):
     """Pick the largest dim divisible by the axis size; replicate if none."""
-    n = mesh.get_dim_size(axis_name)
-    shape = value.shape
-    best = None
-    for d in range(len(shape)):
-        if shape[d] % n == 0 and shape[d] >= n:
-            if best is None or shape[d] > shape[best]:
-                best = d
-    if best is None:
-        return jax.device_put(value, NamedSharding(mesh.to_jax_mesh(), P()))
-    spec = [None] * len(shape)
-    spec[best] = axis_name
-    return jax.device_put(value, NamedSharding(mesh.to_jax_mesh(), P(*spec)))
+    from .. import env as _env
+
+    return _env.shard_largest_dim(value, mesh.to_jax_mesh(), axis_name)
 
 
 def shard_optimizer(optimizer, shard_fn: Optional[_ShardingStage] = None):

@@ -97,13 +97,24 @@ class ProcessMesh:
         return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
 
     def __getitem__(self, item):
-        """Sub-mesh selection (reference ProcessMesh.__getitem__)."""
+        """Sub-mesh selection (reference ProcessMesh.__getitem__). Dim names
+        follow the dims that survive indexing (integer indices drop a dim,
+        slices keep it)."""
         sub = self._mesh[item]
         if np.isscalar(sub):
-            sub = np.asarray([sub])
-            return ProcessMesh(sub, ["d0"])
-        kept = [self._dim_names[i] for i, s in enumerate(np.shape(self._mesh)) if i >= self._mesh.ndim - sub.ndim]
-        return ProcessMesh(sub, kept[-sub.ndim:] if sub.ndim else ["d0"])
+            return ProcessMesh(np.asarray([sub]), ["d0"])
+        idx = item if isinstance(item, tuple) else (item,)
+        kept, pos = [], 0
+        for entry in idx:
+            if isinstance(entry, int):
+                pos += 1  # dim dropped
+            else:
+                kept.append(self._dim_names[pos])
+                pos += 1
+        kept.extend(self._dim_names[pos:])
+        if not kept:
+            kept = ["d0"]
+        return ProcessMesh(sub, kept)
 
 
 def get_mesh_from_jax(jmesh: Mesh) -> ProcessMesh:

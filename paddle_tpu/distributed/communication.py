@@ -118,9 +118,13 @@ def new_group(ranks=None, backend=None, axes: Optional[Sequence[str]] = None, ti
         world = _world_group()
         g = Group(world.axes, gid=gid)
         if ranks is not None and len(ranks) not in (0, world.nranks):
-            # sub-world rank list: keep the intent (size) for spmd use; actual
-            # membership maps to an axis split chosen by fleet topology.
-            g._rank_list = list(ranks)
+            # A proper-subset rank list has no mesh-axis representation here;
+            # honoring it silently would reduce over the whole world. Callers
+            # wanting subgroups pass axes= (fleet topology does).
+            raise NotImplementedError(
+                "new_group(ranks=<proper subset>) has no mesh-axis mapping; "
+                "pass axes=... (e.g. axes=('dp',)) to communicate over a mesh axis"
+            )
     _groups[gid] = g
     return g
 
@@ -250,6 +254,7 @@ def reduce_scatter(tensor: Tensor, tensor_or_list, op=ReduceOp.SUM, group: Optio
         tensor._replace_value(out._value)
         tensor.stop_gradient = out.stop_gradient
         tensor._grad_node = out._grad_node
+        tensor._output_index = out._output_index
         return tensor
     if _eager_world() == 1:
         tensor._replace_value(src._value)
@@ -303,6 +308,7 @@ def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None, out_split_size
     if out_tensor is not None:
         out_tensor._replace_value(out._value)
         out_tensor._grad_node = out._grad_node
+        out_tensor._output_index = out._output_index
         out_tensor.stop_gradient = out.stop_gradient
         return out_tensor
     return out
@@ -321,6 +327,7 @@ def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_
         out = primitive("broadcast", fn, [tensor])
         tensor._replace_value(out._value)
         tensor._grad_node = out._grad_node
+        tensor._output_index = out._output_index
         tensor.stop_gradient = out.stop_gradient
         return tensor
     if _eager_world() == 1:
@@ -360,6 +367,7 @@ def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Optional[Grou
         out = primitive("scatter", fn, [stacked])
         tensor._replace_value(out._value)
         tensor._grad_node = out._grad_node
+        tensor._output_index = out._output_index
         tensor.stop_gradient = out.stop_gradient
         return tensor
     if _eager_world() == 1:
@@ -466,6 +474,7 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]):
             if r_off == s.offset:
                 r.tensor._replace_value(out._value)
                 r.tensor._grad_node = out._grad_node
+                r.tensor._output_index = out._output_index
                 r.tensor.stop_gradient = out.stop_gradient
     return [_Task()]
 

@@ -169,3 +169,23 @@ def barrier(group=None):
         multihost_utils.sync_global_devices("paddle_tpu_barrier")
     else:
         jax.effects_barrier()
+
+
+def shard_largest_dim(value, jmesh: Mesh, axis_name: str):
+    """Place `value` with its largest axis-size-divisible dim sharded over
+    ``axis_name`` (replicated when no dim divides). Shared by ZeRO param/state
+    sharding and pipeline stage placement."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = jmesh.shape.get(axis_name, 1)
+    shape = value.shape
+    best = None
+    for d in range(len(shape)):
+        if shape[d] % n == 0 and shape[d] >= n:
+            if best is None or shape[d] > shape[best]:
+                best = d
+    if best is None:
+        return jax.device_put(value, NamedSharding(jmesh, P()))
+    spec = [None] * len(shape)
+    spec[best] = axis_name
+    return jax.device_put(value, NamedSharding(jmesh, P(*spec)))

@@ -147,27 +147,19 @@ class PipelineLayer(Layer):
         if mesh is None or mesh.shape.get("pp", 1) <= 1:
             return
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         # stage-pinned placement: single-mesh GSPMD keeps arrays global; we
         # shard each stage's largest weight dim over pp when divisible so the
         # memory footprint splits across stage devices.
-        n = mesh.shape["pp"]
+        from .. import env as _env
+
         for si in range(self._num_stages):
             seg = self._funcs[self._segment_bounds[si]:self._segment_bounds[si + 1]]
             for l in seg:
                 if not isinstance(l, Layer):
                     continue
                 for p in l.parameters():
-                    shape = p.shape
-                    best = None
-                    for d in range(len(shape)):
-                        if shape[d] % n == 0 and (best is None or shape[d] > shape[best]):
-                            best = d
-                    if best is not None and p._placements is None:
-                        spec = [None] * len(shape)
-                        spec[best] = "pp"
-                        p._replace_value(jax.device_put(p._value, NamedSharding(mesh, P(*spec))))
+                    if p._placements is None:
+                        p._replace_value(_env.shard_largest_dim(p._value, mesh, "pp"))
 
     def get_stage_from_index(self, idx) -> int:
         for si in range(self._num_stages):

@@ -81,7 +81,7 @@ def spmd(fn=None, *, mesh=None, in_specs=None, out_specs=None, axes=None, check_
                 return tuple(o._value if isinstance(o, Tensor) else o for o in out)
             return out._value if isinstance(out, Tensor) else out
 
-        smapped = jax.shard_map(body, mesh=m, in_specs=ispecs, out_specs=ospecs, check_vma=False)
+        smapped = jax.shard_map(body, mesh=m, in_specs=ispecs, out_specs=ospecs, check_vma=check_vma)
         # route through the dispatcher so the eager tape links across the
         # shard_map boundary (jax.vjp differentiates through shard_map)
         return primitive("spmd_region", smapped, list(args))

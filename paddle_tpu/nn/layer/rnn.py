@@ -1,0 +1,411 @@
+"""Recurrent layers: SimpleRNN/LSTM/GRU cells + sequence wrappers.
+
+Rebuild of the reference's RNN stack (python/paddle/nn/layer/rnn.py:
+RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM,
+GRU). TPU-native: the whole-sequence run is ONE framework primitive whose
+implementation is `lax.scan` over time — XLA compiles the recurrence into a
+single fused loop on device (no per-step python dispatch, static shapes), and
+`jax.vjp` through the scan gives the BPTT gradient. Variable lengths use a
+mask inside the scan instead of dynamic shapes.
+
+Gate order matches the reference (i, f, c, o for LSTM; r, z, c for GRU) so
+state dicts are interchangeable.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor, unwrap
+from .. import functional as F
+from ..initializer import Uniform
+from .layers import Layer
+
+
+def _std_init(hidden_size):
+    k = 1.0 / math.sqrt(hidden_size)
+    return Uniform(-k, k)
+
+
+class RNNCellBase(Layer):
+    """Reference: rnn.py::RNNCellBase — provides get_initial_states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        batch = unwrap(batch_ref).shape[batch_dim_idx]
+        dtype = dtype or "float32"
+        if isinstance(self.state_shape, tuple):
+            return tuple(
+                Tensor(jnp.full((batch,) + tuple(s), init_value, dtype)) for s in self.state_shape
+            )
+        return Tensor(jnp.full((batch,) + tuple(self.state_shape), init_value, dtype))
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh). Reference rnn.py::SimpleRNNCell."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        init = _std_init(hidden_size)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=init) if bias_ih_attr is not False else None
+        self.bias_hh = self.create_parameter([hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=init) if bias_hh_attr is not False else None
+        self.input_size, self.hidden_size = input_size, hidden_size
+        if activation not in ("tanh", "relu"):
+            raise ValueError("SimpleRNNCell activation must be tanh or relu")
+        self.activation = activation
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+    def _weights(self):
+        return [self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh]
+
+    @staticmethod
+    def _step(act, x, h, w_ih, w_hh, b_ih, b_hh):
+        z = x @ w_ih.T + h @ w_hh.T
+        if b_ih is not None:
+            z = z + b_ih
+        if b_hh is not None:
+            z = z + b_hh
+        h = jnp.tanh(z) if act == "tanh" else jax.nn.relu(z)
+        return h, h
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = self.activation
+
+        def fn(x, h, w_ih, w_hh, *biases):
+            b_ih = biases[0] if len(biases) > 0 else None
+            b_hh = biases[1] if len(biases) > 1 else None
+            return SimpleRNNCell._step(act, x, h, w_ih, w_hh, b_ih, b_hh)[0]
+
+        args = [inputs, states, self.weight_ih, self.weight_hh] + [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h = primitive("simple_rnn_cell", fn, args)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    """Gate order i,f,c,o (reference rnn.py::LSTMCell)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=0, name=None):
+        super().__init__()
+        init = _std_init(hidden_size)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=init) if bias_ih_attr is not False else None
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=init) if bias_hh_attr is not False else None
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+    @property
+    def state_shape(self):
+        return ([self.hidden_size], [self.hidden_size])
+
+    def _weights(self):
+        return [self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh]
+
+    @staticmethod
+    def _step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+        z = x @ w_ih.T + h @ w_hh.T
+        if b_ih is not None:
+            z = z + b_ih
+        if b_hh is not None:
+            z = z + b_hh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return h, c
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+
+        def fn(x, h, c, w_ih, w_hh, *biases):
+            b_ih = biases[0] if len(biases) > 0 else None
+            b_hh = biases[1] if len(biases) > 1 else None
+            return LSTMCell._step(x, h, c, w_ih, w_hh, b_ih, b_hh)
+
+        args = [inputs, h0, c0, self.weight_ih, self.weight_hh] + [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h, c = primitive("lstm_cell", fn, args, n_outputs=2)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    """Gate order r,z,c; candidate uses r * (W_hh_c h + b_hh_c) like the
+    reference (rnn.py::GRUCell)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        init = _std_init(hidden_size)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=init) if bias_ih_attr is not False else None
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=init) if bias_hh_attr is not False else None
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+    def _weights(self):
+        return [self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh]
+
+    @staticmethod
+    def _step(x, h, w_ih, w_hh, b_ih, b_hh):
+        zi = x @ w_ih.T
+        zh = h @ w_hh.T
+        if b_ih is not None:
+            zi = zi + b_ih
+        if b_hh is not None:
+            zh = zh + b_hh
+        ri, zi_, ci = jnp.split(zi, 3, axis=-1)
+        rh, zh_, ch = jnp.split(zh, 3, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        z = jax.nn.sigmoid(zi_ + zh_)
+        c = jnp.tanh(ci + r * ch)
+        return (1.0 - z) * c + z * h
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, w_ih, w_hh, *biases):
+            b_ih = biases[0] if len(biases) > 0 else None
+            b_hh = biases[1] if len(biases) > 1 else None
+            return GRUCell._step(x, h, w_ih, w_hh, b_ih, b_hh)
+
+        args = [inputs, states, self.weight_ih, self.weight_hh] + [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h = primitive("gru_cell", fn, args)
+        return h, h
+
+
+def _scan_layer(step, x, init_states, weights, *, reverse, mask):
+    """Run one direction of one layer with lax.scan. x: [T,B,I] time-major.
+
+    mask: [T,B] float (1=valid) or None. With a mask, state updates freeze
+    past each sequence's length (the reference's sequence_length semantics).
+    """
+    def body(carry, inp):
+        if mask is None:
+            xt = inp
+            new = step(xt, carry, weights)
+            return new, (new[0] if isinstance(new, tuple) else new)
+        xt, mt = inp
+        new = step(xt, carry, weights)
+        mt = mt[:, None]
+        if isinstance(new, tuple):
+            merged = tuple(mt * n + (1 - mt) * o for n, o in zip(new, carry))
+            return merged, merged[0]
+        merged = mt * new + (1 - mt) * carry
+        return merged, merged
+
+    xs = x if mask is None else (x, mask)
+    final, outs = lax.scan(body, init_states, xs, reverse=reverse)
+    return outs, final
+
+
+class RNN(Layer):
+    """Wrap a cell into a full-sequence runner (reference rnn.py::RNN).
+
+    The wrapped run compiles to a single lax.scan primitive.
+    """
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            bi = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(inputs, batch_dim_idx=bi)
+        is_lstm = isinstance(self.cell, LSTMCell)
+        cell = self.cell
+        weights = [w for w in cell._weights() if w is not None]
+        has_b_ih = cell.bias_ih is not None
+        has_b_hh = cell.bias_hh is not None
+        time_major, reverse = self.time_major, self.is_reverse
+
+        def step_of(ws):
+            w_ih, w_hh = ws[0], ws[1]
+            b_ih = ws[2] if has_b_ih else None
+            b_hh = ws[2 + int(has_b_ih)] if has_b_hh else None
+
+            def step(xt, carry, _):
+                if is_lstm:
+                    return LSTMCell._step(xt, carry[0], carry[1], w_ih, w_hh, b_ih, b_hh)
+                if isinstance(cell, GRUCell):
+                    return GRUCell._step(xt, carry, w_ih, w_hh, b_ih, b_hh)
+                return SimpleRNNCell._step(cell.activation, xt, carry, w_ih, w_hh, b_ih, b_hh)[0]
+
+            return step
+
+        def fn(x, *rest):
+            if is_lstm:
+                h0, c0, *ws = rest
+                init = (h0, c0)
+            else:
+                h0, *ws = rest
+                init = h0
+            seq_mask = None
+            if sequence_length is not None:
+                T = x.shape[1] if not time_major else x.shape[0]
+                sl = unwrap(sequence_length)
+                seq_mask = (jnp.arange(T)[:, None] < sl[None, :]).astype(x.dtype)
+            xt = x if time_major else jnp.swapaxes(x, 0, 1)
+            step = step_of(ws)
+            outs, final = _scan_layer(step, xt, init, None, reverse=reverse, mask=seq_mask)
+            outs = outs if time_major else jnp.swapaxes(outs, 0, 1)
+            if is_lstm:
+                return outs, final[0], final[1]
+            return outs, final
+
+        init_list = list(initial_states) if is_lstm else [initial_states]
+        n_out = 3 if is_lstm else 2
+        res = primitive("rnn", fn, [inputs] + init_list + weights, n_outputs=n_out)
+        if is_lstm:
+            return res[0], (res[1], res[2])
+        return res[0], res[1]
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper (reference rnn.py::BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (None, None) if initial_states is None else initial_states
+        out_fw, fst_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, fst_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        from ...ops.manipulation import concat
+
+        return concat([out_fw, out_bw], axis=-1), (fst_fw, fst_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer, optionally bidirectional runner shared by SimpleRNN/LSTM/
+    GRU (reference rnn.py::RNNBase). Per-(layer,direction) weights live in
+    cells; sequence execution is scan-per-layer."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        if direction in ("forward",):
+            self.num_directions = 1
+        elif direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        else:
+            raise ValueError(f"unknown direction {direction!r}")
+        self.mode, self.input_size, self.hidden_size = mode, input_size, hidden_size
+        self.num_layers, self.time_major, self.dropout = num_layers, time_major, dropout
+        self.direction = direction
+
+        def make_cell(in_sz):
+            kw = dict(weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+            if mode == "LSTM":
+                return LSTMCell(in_sz, hidden_size, **kw)
+            if mode == "GRU":
+                return GRUCell(in_sz, hidden_size, **kw)
+            return SimpleRNNCell(in_sz, hidden_size, activation=activation, **kw)
+
+        from .container import LayerList
+
+        runners = []
+        for layer_i in range(num_layers):
+            in_sz = input_size if layer_i == 0 else hidden_size * self.num_directions
+            if self.num_directions == 2:
+                runners.append(BiRNN(make_cell(in_sz), make_cell(in_sz), time_major=time_major))
+            else:
+                runners.append(RNN(make_cell(in_sz), time_major=time_major))
+        self._runners = LayerList(runners)
+        self.state_components = 2 if mode == "LSTM" else 1
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import stack
+
+        x = inputs
+        finals = []
+        for i, runner in enumerate(self._runners):
+            st = None
+            if initial_states is not None:
+                st = self._layer_states(initial_states, i)
+            x, final = runner(x, st, sequence_length)
+            finals.append(final)
+            if self.dropout > 0.0 and i < self.num_layers - 1 and self.training:
+                x = F.dropout(x, p=self.dropout, training=True)
+        return x, self._pack_states(finals, stack)
+
+    def _layer_states(self, initial_states, i):
+        """Slice [num_layers*num_directions, B, H]-shaped states for layer i."""
+        nd = self.num_directions
+        if self.mode == "LSTM":
+            h, c = initial_states
+            if nd == 2:
+                return ((h[2 * i], c[2 * i]), (h[2 * i + 1], c[2 * i + 1]))
+            return (h[i], c[i])
+        h = initial_states
+        if nd == 2:
+            return (h[2 * i], h[2 * i + 1])
+        return h[i]
+
+    def _pack_states(self, finals, stack):
+        nd = self.num_directions
+        if self.mode == "LSTM":
+            hs, cs = [], []
+            for f in finals:
+                if nd == 2:
+                    (h_f, c_f), (h_b, c_b) = f
+                    hs += [h_f, h_b]
+                    cs += [c_f, c_b]
+                else:
+                    hs.append(f[0])
+                    cs.append(f[1])
+            return stack(hs, axis=0), stack(cs, axis=0)
+        hs = []
+        for f in finals:
+            if nd == 2:
+                hs += [f[0], f[1]]
+            else:
+                hs.append(f)
+        return stack(hs, axis=0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)

@@ -1,0 +1,161 @@
+"""Transformer + RNN layer tests (reference test model: test/legacy_test
+test_transformer_api.py, test_rnn_op.py family — numeric vs numpy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestMultiHeadAttention:
+    def test_self_attention_matches_numpy(self):
+        np.random.seed(0)
+        d, h = 16, 4
+        mha = paddle.nn.MultiHeadAttention(d, h, dropout=0.0)
+        x = np.random.randn(2, 5, d).astype("float32")
+        out = mha(paddle.to_tensor(x))
+        assert out.shape == [2, 5, d]
+
+        # numpy reference
+        def lin(x, l):
+            return x @ _np(l.weight) + _np(l.bias)
+
+        q = lin(x, mha.q_proj).reshape(2, 5, h, d // h)
+        k = lin(x, mha.k_proj).reshape(2, 5, h, d // h)
+        v = lin(x, mha.v_proj).reshape(2, 5, h, d // h)
+        q, k, v = [a.transpose(0, 2, 1, 3) for a in (q, k, v)]
+        s = q @ k.transpose(0, 1, 3, 2) / np.sqrt(d // h)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        o = (p @ v).transpose(0, 2, 1, 3).reshape(2, 5, d)
+        ref = lin(o, mha.out_proj)
+        np.testing.assert_allclose(_np(out), ref, rtol=2e-4, atol=2e-4)
+
+    def test_cache_incremental_decode_matches_full(self):
+        np.random.seed(1)
+        d = 8
+        mha = paddle.nn.MultiHeadAttention(d, 2, dropout=0.0)
+        mha.eval()
+        x = np.random.randn(1, 4, d).astype("float32")
+        causal = np.tril(np.ones((4, 4), dtype=bool))
+        full = _np(mha(paddle.to_tensor(x), attn_mask=paddle.to_tensor(causal)))
+
+        cache = mha.gen_cache(paddle.to_tensor(x[:, :1]))
+        outs = []
+        for t in range(4):
+            tok = paddle.to_tensor(x[:, t : t + 1])
+            o, cache = mha(tok, tok, tok, cache=cache)
+            outs.append(_np(o))
+        inc = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(inc, full, rtol=1e-4, atol=1e-4)
+
+    def test_grad_flows(self):
+        mha = paddle.nn.MultiHeadAttention(8, 2, dropout=0.0)
+        x = paddle.to_tensor(np.random.randn(2, 3, 8).astype("float32"))
+        mha(x).sum().backward()
+        assert mha.q_proj.weight.grad is not None
+
+
+class TestTransformer:
+    def test_encoder_decoder_shapes(self):
+        t = paddle.nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                                  num_decoder_layers=2, dim_feedforward=32, dropout=0.0)
+        src = paddle.to_tensor(np.random.randn(2, 6, 16).astype("float32"))
+        tgt = paddle.to_tensor(np.random.randn(2, 4, 16).astype("float32"))
+        out = t(src, tgt, tgt_mask=t.generate_square_subsequent_mask(4))
+        assert out.shape == [2, 4, 16]
+
+    def test_pre_norm_variant(self):
+        layer = paddle.nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0, normalize_before=True)
+        enc = paddle.nn.TransformerEncoder(layer, 2, norm=paddle.nn.LayerNorm(16))
+        x = paddle.to_tensor(np.random.randn(2, 5, 16).astype("float32"))
+        assert enc(x).shape == [2, 5, 16]
+
+    def test_independent_layer_params(self):
+        layer = paddle.nn.TransformerEncoderLayer(8, 2, 16, dropout=0.0)
+        enc = paddle.nn.TransformerEncoder(layer, 2)
+        p0 = enc.layers[0].linear1.weight
+        p1 = enc.layers[1].linear1.weight
+        assert p0 is not p1
+
+    def test_decoder_cache_matches_full(self):
+        np.random.seed(2)
+        dl = paddle.nn.TransformerDecoderLayer(8, 2, 16, dropout=0.0)
+        dec = paddle.nn.TransformerDecoder(dl, 2)
+        dec.eval()
+        mem = paddle.to_tensor(np.random.randn(1, 3, 8).astype("float32"))
+        tgt = np.random.randn(1, 4, 8).astype("float32")
+        causal = paddle.to_tensor(np.tril(np.ones((4, 4), dtype=bool)))
+        full = _np(dec(paddle.to_tensor(tgt), mem, tgt_mask=causal))
+        cache = dec.gen_cache(mem)
+        outs = []
+        for t in range(4):
+            o, cache = dec(paddle.to_tensor(tgt[:, t : t + 1]), mem, cache=cache)
+            outs.append(_np(o))
+        np.testing.assert_allclose(np.concatenate(outs, 1), full, rtol=1e-4, atol=1e-4)
+
+
+class TestRNN:
+    def test_lstm_matches_numpy(self):
+        np.random.seed(3)
+        net = paddle.nn.LSTM(4, 6)
+        x = np.random.randn(2, 5, 4).astype("float32")
+        y, (h, c) = net(paddle.to_tensor(x))
+        cell = net._runners[0].cell
+        w_ih, w_hh = _np(cell.weight_ih), _np(cell.weight_hh)
+        b = _np(cell.bias_ih) + _np(cell.bias_hh)
+
+        def sigmoid(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        hh = np.zeros((2, 6), "float32")
+        cc = np.zeros((2, 6), "float32")
+        outs = []
+        for t in range(5):
+            z = x[:, t] @ w_ih.T + hh @ w_hh.T + b
+            i, f, g, o = np.split(z, 4, -1)
+            cc = sigmoid(f) * cc + sigmoid(i) * np.tanh(g)
+            hh = sigmoid(o) * np.tanh(cc)
+            outs.append(hh.copy())
+        ref = np.stack(outs, 1)
+        np.testing.assert_allclose(_np(y), ref, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(_np(h)[0], hh, rtol=1e-4, atol=1e-4)
+
+    def test_gru_shapes_and_grad(self):
+        net = paddle.nn.GRU(4, 6, num_layers=2)
+        x = paddle.to_tensor(np.random.randn(2, 5, 4).astype("float32"))
+        y, h = net(x)
+        assert y.shape == [2, 5, 6] and h.shape == [2, 2, 6]
+        y.mean().backward()
+        assert net._runners[0].cell.weight_ih.grad is not None
+
+    def test_bidirectional(self):
+        net = paddle.nn.SimpleRNN(4, 6, direction="bidirectional")
+        x = paddle.to_tensor(np.random.randn(2, 5, 4).astype("float32"))
+        y, h = net(x)
+        assert y.shape == [2, 5, 12] and h.shape == [2, 2, 6]
+
+    def test_sequence_length_freezes_state(self):
+        net = paddle.nn.GRU(4, 6)
+        x = np.random.randn(2, 5, 4).astype("float32")
+        sl = paddle.to_tensor(np.array([2, 5], np.int64))
+        y, h = net(paddle.to_tensor(x), sequence_length=sl)
+        # final state of row 0 equals output at t=1
+        np.testing.assert_allclose(_np(h)[0, 0], _np(y)[0, 1], rtol=1e-5, atol=1e-5)
+
+    def test_time_major(self):
+        net = paddle.nn.LSTM(4, 6, time_major=True)
+        x = paddle.to_tensor(np.random.randn(5, 2, 4).astype("float32"))
+        y, (h, c) = net(x)
+        assert y.shape == [5, 2, 6]
+
+    def test_initial_states_roundtrip(self):
+        net = paddle.nn.LSTM(4, 6, num_layers=2)
+        x = paddle.to_tensor(np.random.randn(2, 3, 4).astype("float32"))
+        h0 = paddle.zeros([2, 2, 6])
+        c0 = paddle.zeros([2, 2, 6])
+        y, (h, c) = net(x, (h0, c0))
+        assert h.shape == [2, 2, 6] and c.shape == [2, 2, 6]
