@@ -80,4 +80,4 @@ Tensor.element_size = lambda self: self._value.dtype.itemsize
 Tensor.dot = lambda self, y: math.dot(self, y)
 Tensor.is_floating_point = lambda self: "float" in self.dtype.name or "bfloat" in self.dtype.name
 Tensor.is_complex = lambda self: "complex" in self.dtype.name
-Tensor.is_integer = lambda self: _jnp.issubdtype(self.dtype, _jnp.integer)
+Tensor.is_integer = lambda self: _jnp.issubdtype(self._value.dtype, _jnp.integer)

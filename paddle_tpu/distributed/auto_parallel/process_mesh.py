@@ -101,12 +101,23 @@ class ProcessMesh:
         follow the dims that survive indexing (integer indices drop a dim,
         slices keep it)."""
         sub = self._mesh[item]
-        if np.isscalar(sub):
+        if np.isscalar(sub) or sub.ndim == 0:
             return ProcessMesh(np.asarray([sub]), ["d0"])
         idx = item if isinstance(item, tuple) else (item,)
-        kept, pos = [], 0
+        # expand Ellipsis to the slices it stands for so name tracking stays
+        # aligned with numpy's dim bookkeeping
+        n_explicit = sum(1 for e in idx if e is not Ellipsis and e is not None)
+        expanded = []
         for entry in idx:
-            if isinstance(entry, int):
+            if entry is Ellipsis:
+                expanded.extend([slice(None)] * (self._mesh.ndim - n_explicit))
+            else:
+                expanded.append(entry)
+        kept, pos = [], 0
+        for entry in expanded:
+            if entry is None:
+                kept.append("d%d" % len(kept))  # np.newaxis adds an unnamed dim
+            elif isinstance(entry, (int, np.integer)):
                 pos += 1  # dim dropped
             else:
                 kept.append(self._dim_names[pos])

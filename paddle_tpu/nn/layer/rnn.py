@@ -81,10 +81,12 @@ class SimpleRNNCell(RNNCellBase):
         if states is None:
             states = self.get_initial_states(inputs)
         act = self.activation
+        has_ih, has_hh = self.bias_ih is not None, self.bias_hh is not None
 
         def fn(x, h, w_ih, w_hh, *biases):
-            b_ih = biases[0] if len(biases) > 0 else None
-            b_hh = biases[1] if len(biases) > 1 else None
+            it = iter(biases)
+            b_ih = next(it) if has_ih else None
+            b_hh = next(it) if has_hh else None
             return SimpleRNNCell._step(act, x, h, w_ih, w_hh, b_ih, b_hh)[0]
 
         args = [inputs, states, self.weight_ih, self.weight_hh] + [b for b in (self.bias_ih, self.bias_hh) if b is not None]
@@ -98,6 +100,11 @@ class LSTMCell(RNNCellBase):
     def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
                  bias_ih_attr=None, bias_hh_attr=None, proj_size=0, name=None):
         super().__init__()
+        if proj_size:
+            raise NotImplementedError(
+                "LSTMCell proj_size is not supported yet; use a Linear "
+                "projection on the output instead"
+            )
         init = _std_init(hidden_size)
         self.weight_ih = self.create_parameter([4 * hidden_size, input_size], attr=weight_ih_attr, default_initializer=init)
         self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=init)
@@ -130,10 +137,12 @@ class LSTMCell(RNNCellBase):
         if states is None:
             states = self.get_initial_states(inputs)
         h0, c0 = states
+        has_ih, has_hh = self.bias_ih is not None, self.bias_hh is not None
 
         def fn(x, h, c, w_ih, w_hh, *biases):
-            b_ih = biases[0] if len(biases) > 0 else None
-            b_hh = biases[1] if len(biases) > 1 else None
+            it = iter(biases)
+            b_ih = next(it) if has_ih else None
+            b_hh = next(it) if has_hh else None
             return LSTMCell._step(x, h, c, w_ih, w_hh, b_ih, b_hh)
 
         args = [inputs, h0, c0, self.weight_ih, self.weight_hh] + [b for b in (self.bias_ih, self.bias_hh) if b is not None]
@@ -180,10 +189,12 @@ class GRUCell(RNNCellBase):
     def forward(self, inputs, states=None):
         if states is None:
             states = self.get_initial_states(inputs)
+        has_ih, has_hh = self.bias_ih is not None, self.bias_hh is not None
 
         def fn(x, h, w_ih, w_hh, *biases):
-            b_ih = biases[0] if len(biases) > 0 else None
-            b_hh = biases[1] if len(biases) > 1 else None
+            it = iter(biases)
+            b_ih = next(it) if has_ih else None
+            b_hh = next(it) if has_hh else None
             return GRUCell._step(x, h, w_ih, w_hh, b_ih, b_hh)
 
         args = [inputs, states, self.weight_ih, self.weight_hh] + [b for b in (self.bias_ih, self.bias_hh) if b is not None]
@@ -253,7 +264,13 @@ class RNN(Layer):
 
             return step
 
+        has_sl = sequence_length is not None
+
         def fn(x, *rest):
+            rest = list(rest)
+            # sequence_length rides the primitive's tensor args (not a python
+            # closure) so discovery tracing records the read per batch
+            sl = jnp.asarray(rest.pop(0)) if has_sl else None
             if is_lstm:
                 h0, c0, *ws = rest
                 init = (h0, c0)
@@ -261,9 +278,8 @@ class RNN(Layer):
                 h0, *ws = rest
                 init = h0
             seq_mask = None
-            if sequence_length is not None:
+            if sl is not None:
                 T = x.shape[1] if not time_major else x.shape[0]
-                sl = unwrap(sequence_length)
                 seq_mask = (jnp.arange(T)[:, None] < sl[None, :]).astype(x.dtype)
             xt = x if time_major else jnp.swapaxes(x, 0, 1)
             step = step_of(ws)
@@ -274,8 +290,9 @@ class RNN(Layer):
             return outs, final
 
         init_list = list(initial_states) if is_lstm else [initial_states]
+        sl_list = [sequence_length] if has_sl else []
         n_out = 3 if is_lstm else 2
-        res = primitive("rnn", fn, [inputs] + init_list + weights, n_outputs=n_out)
+        res = primitive("rnn", fn, [inputs] + sl_list + init_list + weights, n_outputs=n_out)
         if is_lstm:
             return res[0], (res[1], res[2])
         return res[0], res[1]

@@ -88,7 +88,7 @@ class MultiHeadAttention(Layer):
         from ...ops.creation import zeros
 
         b = key.shape[0]
-        empty = zeros([b, 0, self.num_heads, self.head_dim], dtype="float32")
+        empty = zeros([b, 0, self.num_heads, self.head_dim], dtype=key.dtype)
         return MultiHeadAttention.Cache(empty, empty)
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
@@ -108,14 +108,36 @@ class MultiHeadAttention(Layer):
                 cache = MultiHeadAttention.Cache(k, v)
 
         mask = _convert_attention_mask(attn_mask, jnp.float32)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=mask, dropout_p=self.dropout, training=self.training
-        )
+        weights = None
+        if self.need_weights:
+            # explicit-softmax path (flash attention never materializes the
+            # weight matrix); [B,S,H,D] -> [B,H,S,T] scores
+            from ...ops.activation import softmax
+            from ...ops.linalg import matmul
+            from ...ops.manipulation import transpose
+
+            qt = transpose(q, [0, 2, 1, 3])
+            kt = transpose(k, [0, 2, 1, 3])
+            vt = transpose(v, [0, 2, 1, 3])
+            product = matmul(qt, kt, transpose_y=True) * (self.head_dim ** -0.5)
+            if mask is not None:
+                product = product + mask
+            weights = softmax(product)
+            dropped = F.dropout(weights, self.dropout, training=self.training)
+            out = transpose(matmul(dropped, vt), [0, 2, 1, 3])
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, dropout_p=self.dropout, training=self.training
+            )
         out = out.reshape([out.shape[0], out.shape[1], self.embed_dim])
         out = self.out_proj(out)
-        if cache is not None and not isinstance(cache, MultiHeadAttention.StaticCache):
-            return out, cache
-        return out
+        # reference returns (out[, weights][, cache]) for any non-None cache
+        outs = [out]
+        if self.need_weights:
+            outs.append(weights)
+        if cache is not None:
+            outs.append(cache)
+        return outs[0] if len(outs) == 1 else tuple(outs)
 
 
 class TransformerEncoderLayer(Layer):
@@ -256,7 +278,7 @@ class TransformerDecoderLayer(Layer):
         if static_cache is None:
             tgt = self.cross_attn(tgt, memory, memory, memory_mask)
         else:
-            tgt = self.cross_attn(tgt, memory, memory, memory_mask, static_cache)
+            tgt, static_cache = self.cross_attn(tgt, memory, memory, memory_mask, static_cache)
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
             tgt = self.norm2(tgt)
