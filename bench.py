@@ -1,0 +1,81 @@
+"""Headline benchmark: GPT-2 small causal-LM training throughput (tokens/sec)
+on one chip, bf16 AMP, whole-step jit.
+
+This is the rebuild's measurement of BASELINE.md's "Fleet hybrid-parallel GPT
+tokens/sec" target scoped to a single chip (the driver's bench environment).
+The reference publishes no absolute numbers (BASELINE.json `published: {}`),
+so `vs_baseline` is reported as null until a measured reference lands.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion, gpt2_small, gpt_tiny
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        cfg = gpt2_small(hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        batch, seq, steps = 8, 1024, 20
+    else:  # CPU smoke path so the bench is runnable anywhere
+        cfg = gpt_tiny()
+        batch, seq, steps = 4, 128, 5
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    criterion = GPTPretrainingCriterion(cfg)
+    if on_tpu:
+        paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def loss_fn(ids):
+        if on_tpu:
+            with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+                logits = model(ids)
+        else:
+            logits = model(ids)
+        return criterion(logits, ids)
+
+    step = TrainStep(model=model, optimizer=opt, loss_fn=loss_fn)
+
+    rs = np.random.RandomState(0)
+    ids = paddle.Tensor(
+        rs.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int64),
+        stop_gradient=True,
+    )
+
+    loss = step(ids)  # warmup: compile
+    _ = loss.numpy()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids)
+    _ = loss.numpy()  # drain the async stream
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    print(json.dumps({
+        "metric": f"gpt2_small_train_tokens_per_sec_{platform}" if on_tpu
+                  else f"gpt_tiny_train_tokens_per_sec_{platform}",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    main()

@@ -1,0 +1,18 @@
+"""Model zoo: flagship architectures built on paddle_tpu.nn.
+
+The reference ships its model zoo in python/paddle/vision/models (CNNs) and,
+for the Fleet GPT benchmark path, GPT implementations in the PaddleNLP/
+fleet examples built from fleet/layers/mpu/mp_layers.py. Here the language
+flagship (GPT) lives in-tree because it is the hybrid-parallel benchmark
+target (BASELINE.md: "Fleet hybrid-parallel GPT ... tokens/sec").
+"""
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    GPTForCausalLM,
+    GPTPretrainingCriterion,
+    gpt_tiny,
+    gpt2_small,
+    gpt2_medium,
+    gpt_1p3b,
+)
