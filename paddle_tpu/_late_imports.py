@@ -58,6 +58,13 @@ except ImportError:
     pass
 
 try:
+    from . import models  # noqa: F401
+
+    __all__.append("models")
+except ImportError:
+    pass
+
+try:
     from . import metric  # noqa: F401
 
     __all__.append("metric")

@@ -1,0 +1,28 @@
+"""paddle.io parity: datasets, samplers, DataLoader
+(reference: python/paddle/io/__init__.py)."""
+from .dataloader import (  # noqa: F401
+    DataLoader,
+    WorkerInfo,
+    default_collate_fn,
+    default_convert_fn,
+    get_worker_info,
+)
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)

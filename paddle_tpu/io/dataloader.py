@@ -1,0 +1,220 @@
+"""DataLoader (reference: python/paddle/io/reader.py:262 DataLoader,
+dataloader/dataloader_iter.py worker machinery).
+
+TPU-native design: the reference forks multiprocess workers that feed a
+blocking queue consumed by the device; here the loader runs a small
+thread pipeline — batch fetch + collate happen in worker threads (numpy
+releases the GIL for the heavy copies) and the jax.Array conversion happens
+eagerly in the worker so host→device transfer overlaps the training step's
+async dispatch. Order is preserved with a sequence-numbered reorder buffer,
+matching the reference's ordered blocking queue.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+_worker_info = threading.local()
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    dataset: Any
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    """Inside a worker: its shard info (reference get_worker_info); None in
+    the main thread."""
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples into batched Tensors (reference
+    dataloader/collate.py::default_collate_fn)."""
+    from ..core.tensor import Tensor
+
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([s.numpy() for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, dtype=np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, dtype=np.float32))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_collate_fn(list(fields)) for fields in zip(*batch))
+    raise TypeError(f"batch data can not be a {type(sample)}")
+
+
+def default_convert_fn(batch):
+    from ..core.tensor import Tensor
+
+    if isinstance(batch, (Tensor, np.ndarray)):
+        return batch if isinstance(batch, Tensor) else Tensor(batch)
+    if isinstance(batch, dict):
+        return {k: default_convert_fn(v) for k, v in batch.items()}
+    if isinstance(batch, (tuple, list)):
+        return tuple(default_convert_fn(v) for v in batch)
+    return batch
+
+
+class _MapIter:
+    """Iterator over a map dataset: optional thread workers + reorder buffer."""
+
+    def __init__(self, loader: "DataLoader"):
+        self.loader = loader
+        self.batch_iter = enumerate(iter(loader.batch_sampler))
+        self.lock = threading.Lock()
+        self.n_workers = max(loader.num_workers, 0)
+        if self.n_workers:
+            depth = loader.prefetch_factor * self.n_workers
+            self.out_q: "queue.Queue" = queue.Queue()
+            self.reorder = {}
+            self.next_seq = 0
+            self.done_workers = 0
+            self.threads = [
+                threading.Thread(target=self._worker, args=(i,), daemon=True)
+                for i in range(self.n_workers)
+            ]
+            self.sem = threading.Semaphore(depth)
+            for t in self.threads:
+                t.start()
+
+    def _fetch(self, indices):
+        ds = self.loader.dataset
+        samples = [ds[i] for i in indices]
+        return self.loader.collate_fn(samples)
+
+    def _worker(self, wid):
+        _worker_info.info = WorkerInfo(wid, self.n_workers, self.loader.dataset)
+        if self.loader.worker_init_fn is not None:
+            self.loader.worker_init_fn(wid)
+        while True:
+            self.sem.acquire()
+            with self.lock:
+                try:
+                    seq, indices = next(self.batch_iter)
+                except StopIteration:
+                    self.out_q.put((None, None))
+                    return
+            try:
+                self.out_q.put((seq, self._fetch(indices)))
+            except BaseException as e:  # surface worker errors to the consumer
+                self.out_q.put((seq, e))
+
+    def __next__(self):
+        if not self.n_workers:
+            _, indices = next(self.batch_iter)
+            return self._fetch(indices)
+        while True:
+            if self.next_seq in self.reorder:
+                item = self.reorder.pop(self.next_seq)
+                self.next_seq += 1
+                self.sem.release()
+                if isinstance(item, BaseException):
+                    raise item
+                return item
+            if self.done_workers == self.n_workers and not self.reorder:
+                raise StopIteration
+            seq, item = self.out_q.get()
+            if seq is None:
+                self.done_workers += 1
+                continue
+            self.reorder[seq] = item
+
+    def __iter__(self):
+        return self
+
+
+class _IterableIter:
+    def __init__(self, loader: "DataLoader"):
+        self.loader = loader
+        _worker_info.info = WorkerInfo(0, max(loader.num_workers, 1), loader.dataset)
+        self.stream = iter(loader.dataset)
+        _worker_info.info = None
+
+    def __next__(self):
+        bs = self.loader.batch_size
+        if bs is None:
+            return self.loader.collate_fn(next(self.stream))
+        batch = list(itertools.islice(self.stream, bs))
+        if not batch or (self.loader.drop_last and len(batch) < bs):
+            raise StopIteration
+        return self.loader.collate_fn(batch)
+
+    def __iter__(self):
+        return self
+
+
+class DataLoader:
+    """Batched, optionally shuffled, prefetching loader over a Dataset.
+
+    Mirrors the reference signature (return_list defaults True here — the
+    static-graph feed-dict mode has no TPU analog)."""
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        feed_list=None,
+        places=None,
+        return_list: bool = True,
+        batch_sampler: Optional[BatchSampler] = None,
+        batch_size: Optional[int] = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        num_workers: int = 0,
+        use_buffer_reader: bool = True,
+        prefetch_factor: int = 2,
+        use_shared_memory: bool = True,
+        timeout: int = 0,
+        worker_init_fn: Optional[Callable] = None,
+        persistent_workers: bool = False,
+    ):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.num_workers = num_workers if use_buffer_reader else 0
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            if batch_sampler is not None or shuffle:
+                raise ValueError("IterableDataset does not support batch_sampler/shuffle")
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+            self.collate_fn = collate_fn or (default_collate_fn if batch_size is not None else default_convert_fn)
+            return
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", None)
+            self.drop_last = getattr(batch_sampler, "drop_last", False)
+        else:
+            if batch_size is None:
+                raise ValueError("batch_size=None requires an explicit batch_sampler")
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
+        self.collate_fn = collate_fn or default_collate_fn
+
+    def __iter__(self):
+        return _IterableIter(self) if self._iterable else _MapIter(self)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)

@@ -1,0 +1,183 @@
+"""Samplers (reference: python/paddle/io/dataloader/sampler.py,
+batch_sampler.py): index-order policy objects consumed by DataLoader.
+
+DistributedBatchSampler shards the index stream across data-parallel ranks —
+in the TPU rebuild a "rank" is a *process* (multi-host SPMD); within one
+process the global batch is already device-sharded by the dp mesh axis, so
+num_replicas defaults to the process count, not the chip count.
+"""
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+        if not replacement and num_samples is not None and num_samples > len(data_source):
+            raise ValueError("num_samples cannot exceed dataset size when replacement=False")
+
+    @property
+    def num_samples(self):
+        return self._num_samples if self._num_samples is not None else len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = self.generator if isinstance(self.generator, np.random.Generator) else np.random.default_rng(self.generator)
+        if self.replacement:
+            yield from rng.integers(0, n, size=self.num_samples).tolist()
+        else:
+            yield from rng.permutation(n)[: self.num_samples].tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights: Sequence[float], num_samples: int, replacement=True):
+        super().__init__()
+        if num_samples <= 0:
+            raise ValueError("num_samples must be positive")
+        self.weights = np.asarray(weights, dtype=np.float64)
+        if (self.weights < 0).any():
+            raise ValueError("weights must be non-negative")
+        self.num_samples = num_samples
+        self.replacement = replacement
+        if not replacement and num_samples > len(self.weights):
+            raise ValueError("num_samples cannot exceed weight count when replacement=False")
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.default_rng().choice(
+            len(self.weights), size=self.num_samples, replace=self.replacement, p=p
+        )
+        yield from idx.tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    def __init__(self, indices: Sequence[int]):
+        super().__init__()
+        self.indices = list(indices)
+
+    def __iter__(self):
+        perm = np.random.default_rng().permutation(len(self.indices))
+        yield from (self.indices[i] for i in perm)
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class BatchSampler(Sampler):
+    """Group a sampler's indices into batches (reference BatchSampler)."""
+
+    def __init__(self, dataset=None, sampler: Optional[Sampler] = None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        super().__init__()
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if sampler is not None:
+            if dataset is not None:
+                raise ValueError("pass either dataset or sampler, not both")
+            self.sampler = sampler
+        else:
+            if dataset is None:
+                raise ValueError("either dataset or sampler is required")
+            self.sampler = RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self) -> Iterator[List[int]]:
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Per-rank shard of the epoch (reference DistributedBatchSampler):
+    pads/subsets so every rank sees the same number of batches; set_epoch
+    reseeds the shuffle identically on all ranks."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        from ..distributed import env as dist_env
+
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None else dist_env.instance().world_size
+        self.local_rank = rank if rank is not None else dist_env.instance().rank
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        n = len(dataset)
+        if drop_last:
+            self.num_samples = n // self.nranks
+        else:
+            self.num_samples = int(math.ceil(n / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            indices = np.random.default_rng(self.epoch).permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        if not self.drop_last:
+            indices += indices[: self.total_size - len(indices)]
+        else:
+            indices = indices[: self.total_size]
+        local = indices[self.local_rank::self.nranks]
+        batch = []
+        for idx in local:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
