@@ -1,0 +1,205 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py —
+Model.prepare/fit/evaluate/predict/save/load + summary).
+
+TPU-native: train/eval batches run through a jit-compiled step (the
+paddle_tpu.jit functionalizer), so `Model.fit` trains at whole-program XLA
+speed out of the box — the reference's dygraph loop pays per-op dispatch
+instead. Metrics accumulate host-side per step.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric.metrics import Metric
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+
+    # ------------------------------------------------------------ prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        metrics = _to_list(metrics)
+        for m in metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric must be paddle.metric.Metric, got {type(m)}")
+        self._metrics = metrics
+        self._train_step = None
+
+    # ------------------------------------------------------------ stepping
+    def _build_train_step(self):
+        from ..jit.api import TrainStep
+
+        model = self.network
+        loss_fn = self._loss
+
+        def fn(*batch):
+            *xs, y = batch
+            return loss_fn(model(*xs), y)
+
+        self._train_step = TrainStep(model=model, optimizer=self._optimizer, loss_fn=fn)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One optimizer step; returns the loss (reference train_batch)."""
+        if self._optimizer is None or self._loss is None:
+            raise RuntimeError("call prepare(optimizer, loss) before training")
+        self.network.train()
+        if self._train_step is None:
+            self._build_train_step()
+        batch = _to_list(inputs) + _to_list(labels)
+        loss = self._train_step(*batch)
+        return [float(np.asarray(loss.numpy()))]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        outputs = self.network(*_to_list(inputs))
+        losses = []
+        if self._loss is not None and labels is not None:
+            loss = self._loss(outputs, *_to_list(labels))
+            losses = [float(np.asarray(loss.numpy()))]
+        metric_outs = []
+        for m in self._metrics:
+            computed = m.compute(outputs, *_to_list(labels))
+            metric_outs.append(m.update(*_to_list(computed)))
+        return losses, metric_outs
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        out = self.network(*_to_list(inputs))
+        return [o.numpy() if isinstance(o, Tensor) else o for o in _to_list(out)]
+
+    # ------------------------------------------------------------ loops
+    def _make_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = self._make_loader(train_data, batch_size, shuffle)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, self, epochs=epochs, steps=steps,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir, metrics=self._metrics)
+        self.stop_training = False
+        cbks.on_train_begin()
+        logs = {}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for step, batch in enumerate(loader):
+                xs, ys = self._split_batch(batch)
+                cbks.on_train_batch_begin(step)
+                losses = self.train_batch(xs, ys)
+                logs = {"loss": losses[0]}
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch % eval_freq == 0 or epoch == epochs - 1):
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0, num_workers=num_workers)
+                cbks.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._make_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            xs, ys = self._split_batch(batch)
+            batch_losses, _ = self.eval_batch(xs, ys)
+            losses.extend(batch_losses)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            # datasets that yield (x, y) keep working for predict: the label
+            # column is dropped, matching fit's input/label split
+            xs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(xs))
+        # transpose [steps][n_outs] -> [n_outs][steps]
+        outs = list(map(list, zip(*outputs))) if outputs else []
+        if stack_outputs:
+            outs = [np.concatenate(o) for o in outs]
+        return outs
+
+    @staticmethod
+    def _split_batch(batch, has_label=True):
+        if isinstance(batch, (tuple, list)):
+            if has_label and len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return list(batch), []
+        return [batch], []
+
+    # ------------------------------------------------------------ persistence
+    def save(self, path, training=True):
+        from ..framework.io import save as fw_save
+
+        fw_save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fw_save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        from ..framework.io import load as fw_load
+
+        params = fw_load(path + ".pdparams") if not path.endswith(".pdparams") else fw_load(path)
+        self.network.set_state_dict(params)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fw_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        """Parameter-count summary (reference hapi/model_summary.py)."""
+        rows, total = [], 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            rows.append((name, tuple(p.shape), n))
+        width = max((len(r[0]) for r in rows), default=10) + 2
+        lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Param #':>12}"]
+        lines += [f"{n:<{width}}{str(s):<20}{c:>12,}" for n, s, c in rows]
+        lines.append(f"Total params: {total:,}")
+        print("\n".join(lines))
+        return {"total_params": total, "trainable_params": total}

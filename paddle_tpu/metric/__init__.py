@@ -1,0 +1,1 @@
+from .metrics import Accuracy, Auc, Metric, Precision, Recall  # noqa: F401

@@ -1,0 +1,4 @@
+"""paddle.vision parity: model zoo, transforms, datasets
+(reference: python/paddle/vision/__init__.py)."""
+from . import datasets, models, transforms  # noqa: F401
+from .models import *  # noqa: F401,F403

@@ -1,0 +1,112 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/{mnist,cifar}.py).
+
+No network egress in this environment, so MNIST/Cifar10 load from a local
+`data_file`/`data_dir` the user provides (same file formats as the
+reference's cached downloads); FakeData generates deterministic synthetic
+images for input-pipeline and benchmark plumbing.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic image classification dataset (deterministic per index)."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, dtype="float32"):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+
+    def __getitem__(self, idx):
+        rs = np.random.RandomState(idx)
+        img = rs.standard_normal(self.image_shape).astype(self.dtype)
+        label = np.int64(rs.randint(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST (reference mnist.py). Pass image_path/label_path to the
+    `train-images-idx3-ubyte.gz` / `train-labels-idx1-ubyte.gz` files."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 backend=None):
+        if image_path is None or label_path is None:
+            raise ValueError(
+                "no network egress: MNIST needs explicit image_path/label_path "
+                "to locally available IDX files"
+            )
+        self.transform = transform
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad MNIST image magic {magic}")
+            self.images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+        with gzip.open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad MNIST label magic {magic}")
+            self.labels = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(Dataset):
+    """python-pickle CIFAR-10 (reference cifar.py). data_file points at the
+    `cifar-10-python.tar.gz` archive or an extracted batches directory."""
+
+    def __init__(self, data_file=None, mode="train", transform=None, backend=None):
+        if data_file is None:
+            raise ValueError("no network egress: Cifar10 needs a local data_file")
+        self.transform = transform
+        names = (
+            [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" else ["test_batch"]
+        )
+        imgs, labels = [], []
+        if os.path.isdir(data_file):
+            for name in names:
+                with open(os.path.join(data_file, name), "rb") as f:
+                    batch = pickle.load(f, encoding="bytes")
+                imgs.append(batch[b"data"])
+                labels.extend(batch[b"labels"])
+        else:
+            with tarfile.open(data_file) as tf:
+                for member in tf.getmembers():
+                    if any(member.name.endswith(n) for n in names):
+                        batch = pickle.load(tf.extractfile(member), encoding="bytes")
+                        imgs.append(batch[b"data"])
+                        labels.extend(batch[b"labels"])
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)

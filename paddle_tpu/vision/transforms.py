@@ -1,0 +1,180 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/transforms.py).
+
+Numpy-native: transforms operate on HWC uint8/float arrays (the reference's
+'cv2'/'pil' backends both reduce to array math; TPU input pipelines are
+host-side numpy anyway). ToTensor emits CHW float32 scaled to [0,1].
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Sequence
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(np.asarray(img))
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+def _as_pair(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+def _resize(img, h, w):
+    """Bilinear resize via independent axis interpolation (no cv2/PIL dep)."""
+    ih, iw = img.shape[:2]
+    if (ih, iw) == (h, w):
+        return img
+    ys = (np.arange(h) + 0.5) * ih / h - 0.5
+    xs = (np.arange(w) + 0.5) * iw / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, ih - 1)
+    y1 = np.clip(y0 + 1, 0, ih - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, iw - 1)
+    x1 = np.clip(x0 + 1, 0, iw - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+    img_f = img.astype(np.float32)
+    if img_f.ndim == 2:
+        img_f = img_f[:, :, None]
+        squeeze = True
+    else:
+        squeeze = False
+    top = img_f[y0][:, x0] * (1 - wx) + img_f[y0][:, x1] * wx
+    bot = img_f[y1][:, x0] * (1 - wx) + img_f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if squeeze:
+        out = out[:, :, 0]
+    return out.astype(img.dtype) if np.issubdtype(img.dtype, np.integer) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        if isinstance(self.size, numbers.Number):
+            ih, iw = img.shape[:2]
+            short, scale = (ih, self.size / ih) if ih <= iw else (iw, self.size / iw)
+            h, w = int(round(ih * scale)), int(round(iw * scale))
+        else:
+            h, w = _as_pair(self.size)
+        return _resize(img, h, w)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = _as_pair(size)
+
+    def _apply_image(self, img):
+        h, w = self.size
+        ih, iw = img.shape[:2]
+        top, left = max((ih - h) // 2, 0), max((iw - w) // 2, 0)
+        return img[top:top + h, left:left + w]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = _as_pair(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+
+    def _apply_image(self, img):
+        h, w = self.size
+        if self.padding:
+            p = self.padding if not isinstance(self.padding, numbers.Number) else [self.padding] * 4
+            pads = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, pads, mode="constant")
+        ih, iw = img.shape[:2]
+        if self.pad_if_needed and (ih < h or iw < w):
+            pads = [(0, max(h - ih, 0)), (0, max(w - iw, 0))] + [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, pads, mode="constant")
+            ih, iw = img.shape[:2]
+        top = random.randint(0, ih - h)
+        left = random.randint(0, iw - w)
+        return img[top:top + h, left:left + w]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return img[:, ::-1].copy() if random.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return img[::-1].copy() if random.random() < self.prob else img
+
+
+class Normalize(BaseTransform):
+    """(x - mean) / std over CHW or HWC float input (reference Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW"):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = img.astype(np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img.transpose(self.order)
+
+
+class ToTensor(BaseTransform):
+    """HWC [0,255] -> CHW float32 [0,1] (reference ToTensor)."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        out = img.astype(np.float32)
+        if np.issubdtype(img.dtype, np.integer):
+            out = out / 255.0
+        return out.transpose(2, 0, 1) if self.data_format == "CHW" else out
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = 1.0 + np.random.uniform(-self.value, self.value)
+        dtype = img.dtype
+        out = img.astype(np.float32) * factor
+        return np.clip(out, 0, 255).astype(dtype) if np.issubdtype(dtype, np.integer) else out

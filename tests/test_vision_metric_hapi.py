@@ -1,0 +1,158 @@
+"""vision zoo + metric + hapi Model tests (reference analogs:
+test/legacy_test/test_vision_models.py, test_metrics.py, test_model.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.vision import models, transforms
+from paddle_tpu.vision.datasets import FakeData
+
+
+def _forward(model, shape=(1, 3, 64, 64)):
+    x = paddle.to_tensor(np.random.RandomState(0).randn(*shape).astype(np.float32))
+    model.eval()
+    return model(x)
+
+
+@pytest.mark.parametrize("factory,num_classes", [
+    (models.resnet18, 10),
+    (models.resnet50, 10),
+    (models.resnext50_32x4d, 10),
+    (models.wide_resnet50_2, 10),
+    (models.mobilenet_v1, 10),
+    (models.mobilenet_v2, 10),
+])
+def test_cnn_forward_shapes(factory, num_classes):
+    m = factory(num_classes=num_classes)
+    out = _forward(m)
+    assert out.shape == [1, num_classes]
+
+
+def test_vgg_and_alexnet():
+    out = _forward(models.vgg11(num_classes=7), (1, 3, 224, 224))
+    assert out.shape == [1, 7]
+    out = _forward(models.alexnet(num_classes=5), (1, 3, 224, 224))
+    assert out.shape == [1, 5]
+
+
+def test_lenet_train_decreases_loss():
+    m = models.LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 10, (8,)).astype(np.int64))
+    losses = []
+    for _ in range(5):
+        loss = nn.CrossEntropyLoss()(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_transforms_pipeline():
+    t = transforms.Compose([
+        transforms.Resize(40),
+        transforms.CenterCrop(32),
+        transforms.RandomHorizontalFlip(1.0),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+    ])
+    img = (np.random.RandomState(0).rand(50, 60, 3) * 255).astype(np.uint8)
+    out = t(img)
+    assert out.shape == (3, 32, 32) and out.dtype == np.float32
+    assert out.min() >= -1.0001 and out.max() <= 1.0001
+
+
+def test_resize_matches_identity():
+    img = np.arange(36, dtype=np.float32).reshape(6, 6)
+    np.testing.assert_allclose(transforms.Resize((6, 6))(img), img)
+
+
+def test_fake_data_deterministic():
+    ds = FakeData(num_samples=4, image_shape=(1, 8, 8), num_classes=3)
+    a, la = ds[2]
+    b, lb = ds[2]
+    np.testing.assert_array_equal(a, b)
+    assert la == lb and len(ds) == 4
+
+
+def test_accuracy_topk():
+    acc = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.7, 0.2], [0.6, 0.3, 0.1]], np.float32)
+    label = np.array([1, 2], np.int64)
+    correct = acc.compute(pred, label)
+    acc.update(correct)
+    top1, top2 = acc.accumulate()
+    assert top1 == pytest.approx(0.5) and top2 == pytest.approx(0.5)
+    assert acc.name() == ["acc_top1", "acc_top2"]
+
+
+def test_precision_recall_auc():
+    p, r = Precision(), Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.7], np.float32)
+    labels = np.array([1, 0, 1, 1], np.int64)
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.accumulate() == pytest.approx(2 / 3)
+    assert r.accumulate() == pytest.approx(2 / 3)
+    auc = Auc()
+    auc.update(np.array([0.9, 0.1, 0.8, 0.2]), np.array([1, 0, 1, 0]))
+    assert auc.accumulate() == pytest.approx(1.0)
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 4).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.int64)
+    train = TensorDataset([X, y])
+
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.05, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy(),
+    )
+    model.fit(train, epochs=6, batch_size=16, verbose=0)
+    logs = model.evaluate(train, batch_size=16, verbose=0)
+    assert logs["acc"] > 0.8 and logs["loss"] < 0.7
+
+    preds = model.predict(train, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 2)
+
+    path = str(tmp_path / "ckpt")
+    model.save(path)
+    net2 = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    model2 = paddle.Model(net2)
+    model2.prepare(loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    model2.load(path)
+    logs2 = model2.evaluate(train, batch_size=16, verbose=0)
+    assert logs2["acc"] == pytest.approx(logs["acc"])
+
+
+def test_hapi_early_stopping():
+    from paddle_tpu.hapi import EarlyStopping
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, 4).astype(np.float32)
+    y = rs.randint(0, 2, (32,)).astype(np.int64)  # unlearnable noise
+    ds = TensorDataset([X, y])
+    net = nn.Sequential(nn.Linear(4, 2))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(learning_rate=0.0, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+    )
+    stopper = EarlyStopping(monitor="loss", patience=1, verbose=0)
+    model.fit(ds, eval_data=ds, epochs=10, batch_size=16, verbose=0, callbacks=[stopper])
+    assert model.stop_training
+
+
+def test_model_summary(capsys):
+    net = nn.Linear(4, 2)
+    info = paddle.Model(net).summary()
+    assert info["total_params"] == 4 * 2 + 2
