@@ -32,6 +32,7 @@ from .mpu import (  # noqa: F401
     VocabParallelEmbedding,
     get_rng_state_tracker,
 )
+from .context_parallel import ring_attention, ulysses_attention  # noqa: F401
 from .recompute import no_recompute, recompute, recompute_sequential  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 

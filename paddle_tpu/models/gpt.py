@@ -47,6 +47,9 @@ class GPTConfig:
     tensor_parallel: bool = False  # use mpu layers sharded over the mp axis
     sequence_parallel: bool = False  # keep activations seq-sharded between blocks
     use_flash_attention: bool = True
+    # long-context: shard the sequence over the `sep` mesh axis and attend
+    # via "ring" (ppermute blockwise) or "ulysses" (all_to_all head swap)
+    context_parallel: str = ""
 
     def __post_init__(self):
         if self.intermediate_size == 0:
@@ -96,10 +99,19 @@ class GPTAttention(Layer):
         q = qkv[:, :, :, 0, :]
         k = qkv[:, :, :, 1, :]
         v = qkv[:, :, :, 2, :]
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True,
-            dropout_p=cfg.attention_dropout_prob, training=self.training,
-        )
+        if cfg.context_parallel:
+            from ..distributed.fleet.context_parallel import (
+                ring_attention,
+                ulysses_attention,
+            )
+
+            cp = ring_attention if cfg.context_parallel == "ring" else ulysses_attention
+            out = cp(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=cfg.attention_dropout_prob, training=self.training,
+            )
         out = manipulation.reshape(out, [b, s, heads * cfg.head_dim])
         return self.out_proj(out)
 

@@ -1,0 +1,130 @@
+"""Context-parallel attention tests: ring/Ulysses vs serial attention on the
+8-device CPU mesh (capability absent from the reference — SURVEY.md §2.14)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import env as dist_env
+from paddle_tpu.distributed.fleet.context_parallel import (
+    ring_attention,
+    ulysses_attention,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _mesh():
+    dist_env.instance().build_mesh({"sep": 4, "dp": 2})
+    yield
+    dist_env.instance().build_mesh({})
+
+
+def _serial_attention(q, k, v, causal):
+    qf, kf, vf = (x.astype(np.float32) for x in (q, k, v))
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = np.einsum("bqhd,bkhd->bqhk", qf, kf) * scale
+    if causal:
+        S, T = q.shape[1], k.shape[1]
+        mask = np.arange(S)[:, None] >= np.arange(T)[None, :]
+        s = np.where(mask[None, :, None, :], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bqhk,bkhd->bqhd", p, vf)
+
+
+def _qkv(b=2, s=32, h=8, d=16, seed=0):
+    rs = np.random.RandomState(seed)
+    return tuple(rs.randn(b, s, h, d).astype(np.float32) for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_serial(causal):
+    qn, kn, vn = _qkv()
+    q, k, v = (paddle.to_tensor(x) for x in (qn, kn, vn))
+    out = ring_attention(q, k, v, causal=causal)
+    expect = _serial_attention(qn, kn, vn, causal)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_serial(causal):
+    qn, kn, vn = _qkv()
+    q, k, v = (paddle.to_tensor(x) for x in (qn, kn, vn))
+    out = ulysses_attention(q, k, v, causal=causal)
+    expect = _serial_attention(qn, kn, vn, causal)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_grads_match_serial():
+    qn, kn, vn = _qkv(s=16)
+    q, k, v = (paddle.to_tensor(x, stop_gradient=False) for x in (qn, kn, vn))
+    out = ring_attention(q, k, v, causal=True)
+    out.sum().backward()
+
+    qs, ks, vs = (paddle.to_tensor(x, stop_gradient=False) for x in (qn, kn, vn))
+    from paddle_tpu.nn.functional.attention import _xla_attention
+    from paddle_tpu.core.dispatch import primitive
+
+    scale = 1.0 / np.sqrt(qn.shape[-1])
+    ref = primitive(
+        "ref_attn", lambda a, b, c: _xla_attention(a, b, c, causal=True, scale=scale), [qs, ks, vs]
+    )
+    ref.sum().backward()
+    for got, want in ((q, qs), (k, ks), (v, vs)):
+        np.testing.assert_allclose(got.grad.numpy(), want.grad.numpy(), rtol=2e-3, atol=2e-4)
+
+
+def test_ring_attention_output_stays_sequence_sharded():
+    qn, kn, vn = _qkv()
+    q, k, v = (paddle.to_tensor(x) for x in (qn, kn, vn))
+    out = ring_attention(q, k, v)
+    assert "sep" in str(out._value.sharding)
+
+
+def test_ring_attention_under_jit():
+    import jax
+
+    qn, kn, vn = _qkv(s=16)
+
+    from paddle_tpu.jit.functionalize import functionalize
+
+    @functionalize
+    def fn(q, k, v):
+        return ring_attention(q, k, v, causal=True)
+
+    out = fn(paddle.to_tensor(qn), paddle.to_tensor(kn), paddle.to_tensor(vn))
+    expect = _serial_attention(qn, kn, vn, True)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_head_divisibility_error():
+    rs = np.random.RandomState(0)
+    bad = tuple(paddle.to_tensor(rs.randn(2, 32, 6, 8).astype(np.float32)) for _ in range(3))
+    with pytest.raises(ValueError, match="num_heads"):
+        ulysses_attention(*bad)
+
+
+def test_long_sequence_ring():
+    # sequence far beyond a single block: 4 devices x 64-token chunks
+    qn, kn, vn = _qkv(b=1, s=256, h=4, d=8, seed=3)
+    q, k, v = (paddle.to_tensor(x) for x in (qn, kn, vn))
+    out = ring_attention(q, k, v, causal=True)
+    expect = _serial_attention(qn, kn, vn, True)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=2e-4, atol=2e-5)
+
+
+def test_gpt_with_context_parallel_trains():
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny
+
+    paddle.seed(5)
+    cfg = gpt_tiny(context_parallel="ring")
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (2, 32)).astype(np.int64))
+    step = TrainStep(model=model, optimizer=opt, loss_fn=lambda x: crit(model(x), x))
+    first = float(step(ids).numpy())
+    for _ in range(5):
+        last = float(step(ids).numpy())
+    assert np.isfinite(last) and last < first
