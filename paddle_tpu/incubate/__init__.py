@@ -1,0 +1,2 @@
+"""paddle.incubate parity surface (reference: python/paddle/incubate/)."""
+from . import distributed  # noqa: F401

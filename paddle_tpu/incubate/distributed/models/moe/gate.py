@@ -1,0 +1,97 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/
+{naive_gate,gshard_gate,switch_gate}.py).
+
+A gate maps tokens [T, d_model] to (combine_weights [T, k], expert_idx
+[T, k], aux_loss). Aux loss is the GShard/Switch load-balancing loss
+E * sum_e(mean_prob_e * frac_tokens_e).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..... import nn
+from .....core.dispatch import primitive
+from .....core.tensor import Tensor
+from .....nn.initializer import XavierUniform
+
+
+def _gate_stats(probs, idx, num_experts):
+    """Load-balance loss terms from router probabilities + top-1 choices."""
+    me = jnp.mean(probs, axis=0)  # [E] mean router prob
+    ce = jnp.mean(
+        jnp.sum(jnp.eye(num_experts, dtype=probs.dtype)[idx], axis=1), axis=0
+    )  # [E] fraction of tokens routed (over all k slots)
+    return num_experts * jnp.sum(me * ce)
+
+
+class BaseGate(nn.Layer):
+    def __init__(self, d_model: int, num_experts: int, top_k: int):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=XavierUniform()
+        )
+        self.loss = None  # reference gates stash l_aux on the gate
+
+    def _route(self, x: Tensor, normalize: bool):
+        k, E = self.top_k, self.num_experts
+
+        def fn(xv, wv):
+            import jax
+
+            logits = xv.astype(jnp.float32) @ wv.astype(jnp.float32)
+            probs = jnp.exp(logits - jnp.max(logits, -1, keepdims=True))
+            probs = probs / jnp.sum(probs, -1, keepdims=True)
+            top_val, top_idx = jax.lax.top_k(probs, k)
+            if normalize:
+                top_val = top_val / jnp.maximum(jnp.sum(top_val, -1, keepdims=True), 1e-9)
+            aux = _gate_stats(probs, top_idx, E)
+            return top_val, top_idx, aux
+
+        val, idx, aux = primitive("moe_gate", fn, [x, self.weight], n_outputs=3)
+        idx.stop_gradient = True
+        self.loss = aux
+        return val, idx, aux
+
+    def forward(self, x: Tensor):
+        raise NotImplementedError
+
+
+class NaiveGate(BaseGate):
+    """Plain top-k softmax routing, no capacity enforcement at the gate
+    (reference naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=2, num_experts=None):
+        total = (num_experts if num_experts is not None else num_expert * world_size)
+        super().__init__(d_model, total, topk)
+
+    def forward(self, x):
+        return self._route(x, normalize=False)
+
+
+class GShardGate(BaseGate):
+    """Top-2 with renormalized weights + balance loss (reference gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), group=None, num_experts=None):
+        total = (num_experts if num_experts is not None else num_expert * world_size)
+        super().__init__(d_model, total, topk)
+        self.capacity = capacity
+
+    def forward(self, x):
+        return self._route(x, normalize=True)
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch routing (reference switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=1,
+                 capacity=(1.2, 2.4), group=None, num_experts=None):
+        total = (num_experts if num_experts is not None else num_expert * world_size)
+        super().__init__(d_model, total, 1)
+        self.capacity = capacity
+
+    def forward(self, x):
+        return self._route(x, normalize=False)

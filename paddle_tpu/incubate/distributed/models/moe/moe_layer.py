@@ -1,0 +1,177 @@
+"""MoELayer (reference: python/paddle/incubate/distributed/models/moe/
+moe_layer.py:263 — MoEScatter :99 / MoEGather :149 over a moe_group, with
+global_scatter/global_gather collectives and capacity kernels
+number_count/limit_by_capacity/prune_gate_by_capacity).
+
+TPU-native design: dispatch is the GShard einsum formulation —
+  dispatch[t, e, c] (one-hot) scatters tokens into per-expert capacity
+  slots, experts run as ONE batched einsum over stacked weights [E, ...],
+  and combine[t, e, c] gathers weighted outputs back.
+Expert parallelism is a sharding: the stacked expert dim is placed over a
+mesh axis (``ep_axis``, default "dp" — the reference's default moe_group is
+the data-parallel group) and the dispatched activations get a matching
+sharding constraint, so GSPMD lowers scatter/gather to exactly the
+all_to_all pair the reference hand-codes, fused into the surrounding step.
+Capacity enforcement (limit_by_capacity/prune_gate) is the `pos < capacity`
+mask — dropped tokens pass through with zero combine weight, matching the
+reference's residual behavior.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..... import nn
+from .....core.dispatch import primitive
+from .....core.tensor import Tensor
+from .....distributed import env as env_mod
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+
+class ExpertMLP(nn.Layer):
+    """Stacked expert FFN: weights [E, d, d_hidden] / [E, d_hidden, d] so all
+    experts compute in one einsum (MXU-batched) and the E dim can shard."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation=None):
+        super().__init__()
+        from .....nn.initializer import XavierUniform
+
+        self.num_experts = num_experts
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden],
+                                        default_initializer=XavierUniform())
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model],
+                                        default_initializer=XavierUniform())
+        self.b2 = self.create_parameter([num_experts, 1, d_model], is_bias=True)
+
+    def forward(self, expert_in: Tensor) -> Tensor:
+        """expert_in: [E, C, d] -> [E, C, d]."""
+
+        def fn(x, w1, b1, w2, b2):
+            h = jnp.einsum("ecd,edh->ech", x, w1) + b1
+            h = jax.nn.gelu(h)
+            return jnp.einsum("ech,ehd->ecd", h, w2) + b2
+
+        return primitive("moe_expert_mlp", fn,
+                         [expert_in, self.w1, self.b1, self.w2, self.b2])
+
+
+class MoELayer(nn.Layer):
+    """Mixture-of-experts layer (reference moe_layer.py:263).
+
+    Args mirror the reference: d_model, experts (list of Layers, or an
+    ExpertMLP, or None to build one), gate (BaseGate instance or name
+    'naive'/'gshard'/'switch'), top_k, capacity_factor.
+    The reference's `moe_group` becomes ``ep_axis`` — the mesh axis the
+    expert dim shards over.
+    """
+
+    def __init__(self, d_model: int, experts=None, gate="gshard", top_k: int = 2,
+                 num_experts: Optional[int] = None, d_hidden: Optional[int] = None,
+                 capacity_factor: float = 1.25, ep_axis: str = "dp",
+                 moe_group=None, recompute_interval: int = 0):
+        super().__init__()
+        self.d_model = d_model
+        self.ep_axis = ep_axis
+        self.capacity_factor = capacity_factor
+
+        if isinstance(experts, (list, tuple)):
+            self.experts = nn.LayerList(list(experts))
+            self.num_experts = len(experts)
+            self._stacked = None
+        elif isinstance(experts, ExpertMLP):
+            self.experts = None
+            self._stacked = experts
+            self.num_experts = experts.num_experts
+        else:
+            if num_experts is None:
+                raise ValueError("num_experts required when experts is not given")
+            self.num_experts = num_experts
+            self._stacked = ExpertMLP(num_experts, d_model, d_hidden or 4 * d_model)
+            self.experts = None
+            self.add_sublayer("stacked_experts", self._stacked)
+
+        if isinstance(gate, BaseGate):
+            self.gate = gate
+        else:
+            cls = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}[gate]
+            self.gate = cls(d_model, num_experts=self.num_experts,
+                            topk=(1 if gate == "switch" else top_k))
+        self.top_k = self.gate.top_k
+        self.l_aux: Optional[Tensor] = None
+        self._shard_experts()
+
+    # ------------------------------------------------------------------ ep
+    def _shard_experts(self):
+        """Pin stacked expert weights over the ep axis (the EP placement)."""
+        if self._stacked is None:
+            return
+        mesh = env_mod.get_mesh()
+        n = mesh.shape.get(self.ep_axis, 1)
+        if n == 1 or self.num_experts % n != 0:
+            return
+        for p in self._stacked.parameters():
+            spec = P(self.ep_axis, *([None] * (len(p.shape) - 1)))
+            p._replace_value(jax.device_put(p._value, NamedSharding(mesh, spec)))
+            p._placements = spec
+
+    def _ep_constrain(self, value):
+        """Sharding constraint on [E, C, d] dispatched activations."""
+        mesh = env_mod.get_mesh()
+        n = mesh.shape.get(self.ep_axis, 1)
+        if n == 1 or self.num_experts % n != 0:
+            return value
+        sharding = NamedSharding(mesh, P(self.ep_axis, None, None))
+        if isinstance(value, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(value, sharding)
+        return jax.device_put(value, sharding)
+
+    # ------------------------------------------------------------- forward
+    def forward(self, x: Tensor) -> Tensor:
+        orig_shape = x.shape
+        T = int(math.prod(orig_shape[:-1]))
+        E, k = self.num_experts, self.top_k
+        capacity = max(int(self.capacity_factor * T * k / E), k)
+
+        from .....ops import manipulation
+
+        flat = manipulation.reshape(x, [T, self.d_model])
+        combine_w, expert_idx, aux = self.gate(flat)
+        self.l_aux = aux
+
+        def dispatch_fn(xv, wv, iv):
+            # per-(token, slot) position inside the chosen expert's buffer
+            onehot = jax.nn.one_hot(iv, E, dtype=jnp.int32)  # [T, k, E]
+            flat_oh = onehot.reshape(T * k, E)
+            pos = jnp.cumsum(flat_oh, axis=0) - 1  # running count per expert
+            pos = jnp.sum(pos * flat_oh, axis=-1).reshape(T, k)  # [T, k]
+            keep = (pos < capacity).astype(xv.dtype)
+            # dispatch/combine tensors [T, E, C]
+            clipped = jnp.minimum(pos, capacity - 1)
+            d_onehot = jax.nn.one_hot(iv, E, dtype=xv.dtype) * keep[..., None]
+            c_onehot = jax.nn.one_hot(clipped, capacity, dtype=xv.dtype)
+            dispatch = jnp.einsum("tke,tkc->tec", d_onehot, c_onehot)
+            combine = jnp.einsum("tke,tkc,tk->tec", d_onehot, c_onehot,
+                                 wv.astype(xv.dtype))
+            expert_in = jnp.einsum("tec,td->ecd", dispatch, xv)
+            return self._ep_constrain(expert_in), combine
+
+        expert_in, combine = primitive(
+            "moe_dispatch", dispatch_fn, [flat, combine_w, expert_idx], n_outputs=2
+        )
+
+        if self._stacked is not None:
+            expert_out = self._stacked(expert_in)
+        else:
+            outs = [self.experts[e](expert_in[e]) for e in range(E)]
+            expert_out = manipulation.stack(outs, axis=0)
+
+        def gather_fn(h, c):
+            return jnp.einsum("tec,ecd->td", c, self._ep_constrain(h))
+
+        out = primitive("moe_combine", gather_fn, [expert_out, combine])
+        return manipulation.reshape(out, orig_shape)
