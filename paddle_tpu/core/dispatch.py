@@ -66,6 +66,13 @@ def primitive(
     dtype is floating.
     """
     attrs = attrs or {}
+    if hooks.op_profiler is not None:
+        with hooks.op_profiler(name):
+            return _primitive_impl(name, fn, tensor_args, attrs)
+    return _primitive_impl(name, fn, tensor_args, attrs)
+
+
+def _primitive_impl(name, fn, tensor_args, attrs):
     amp = global_state.amp_state()
     if amp is not None:
         tensor_args = amp.cast_inputs(name, tensor_args)

@@ -7,3 +7,7 @@ writes. Kept in its own module to avoid import cycles.
 from __future__ import annotations
 
 discovery = None  # Optional[DiscoveryContext]
+
+# set by paddle_tpu/profiler when a Profiler is in a RECORD state: a callable
+# (op_name) -> context manager recording a host event around op dispatch
+op_profiler = None

@@ -1,0 +1,14 @@
+"""paddle.distribution parity (reference: python/paddle/distribution/)."""
+from .distributions import (  # noqa: F401
+    Bernoulli,
+    Categorical,
+    Distribution,
+    Exponential,
+    Gumbel,
+    Laplace,
+    Multinomial,
+    Normal,
+    Uniform,
+    kl_divergence,
+    register_kl,
+)

@@ -1,0 +1,496 @@
+"""Probability distributions (reference: python/paddle/distribution/
+{distribution,normal,uniform,bernoulli,categorical,exponential,laplace,
+gumbel,multinomial,kl}.py).
+
+Each distribution computes with jnp through the dispatcher (`primitive`), so
+log_prob/entropy are differentiable w.r.t. parameters and everything traces
+under jit. Sampling draws keys from the global generator (seeded by
+paddle.seed)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import global_state
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+def _key():
+    return global_state.default_generator.split()
+
+
+def _shape(sample_shape, batch_shape):
+    return tuple(int(s) for s in sample_shape) + tuple(batch_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..ops import math as ops_math
+
+        return ops_math.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(_val(loc))
+        self.scale = scale if isinstance(scale, Tensor) else Tensor(_val(scale))
+        super().__init__(np.broadcast_shapes(tuple(self.loc.shape), tuple(self.scale.shape)))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return primitive("normal_var", lambda s: s * s, [self.scale])
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        key = _key()
+        full = _shape(shape, self._batch_shape)
+        return primitive(
+            "normal_rsample",
+            lambda l, s: l + s * jax.random.normal(key, full, jnp.float32),
+            [self.loc, self.scale],
+        )
+
+    def log_prob(self, value):
+        return primitive(
+            "normal_log_prob",
+            lambda l, s, v: -((v - l) ** 2) / (2 * s * s) - jnp.log(s) - 0.5 * math.log(2 * math.pi),
+            [self.loc, self.scale, value],
+        )
+
+    def entropy(self):
+        return primitive(
+            "normal_entropy",
+            lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s) + jnp.zeros(self._batch_shape),
+            [self.scale],
+        )
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = low if isinstance(low, Tensor) else Tensor(_val(low))
+        self.high = high if isinstance(high, Tensor) else Tensor(_val(high))
+        super().__init__(np.broadcast_shapes(tuple(self.low.shape), tuple(self.high.shape)))
+
+    @property
+    def mean(self):
+        return primitive("uniform_mean", lambda a, b: (a + b) / 2, [self.low, self.high])
+
+    @property
+    def variance(self):
+        return primitive("uniform_var", lambda a, b: (b - a) ** 2 / 12, [self.low, self.high])
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        key = _key()
+        full = _shape(shape, self._batch_shape)
+        return primitive(
+            "uniform_rsample",
+            lambda a, b: a + (b - a) * jax.random.uniform(key, full, jnp.float32),
+            [self.low, self.high],
+        )
+
+    def log_prob(self, value):
+        return primitive(
+            "uniform_log_prob",
+            lambda a, b, v: jnp.where((v >= a) & (v < b), -jnp.log(b - a), -jnp.inf),
+            [self.low, self.high, value],
+        )
+
+    def entropy(self):
+        return primitive("uniform_entropy", lambda a, b: jnp.log(b - a), [self.low, self.high])
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = probs if isinstance(probs, Tensor) else Tensor(_val(probs))
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return primitive("bern_var", lambda p: p * (1 - p), [self.probs])
+
+    def sample(self, shape=()):
+        key = _key()
+        full = _shape(shape, self._batch_shape)
+        out = primitive(
+            "bern_sample",
+            lambda p: jax.random.bernoulli(key, p, full).astype(jnp.float32),
+            [self.probs],
+        )
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-sigmoid relaxation (reference Bernoulli.rsample)."""
+        key = _key()
+        full = _shape(shape, self._batch_shape)
+        t = float(temperature)
+        return primitive(
+            "bern_rsample",
+            lambda p: jax.nn.sigmoid(
+                (jnp.log(p) - jnp.log1p(-p) + jax.random.logistic(key, full)) / t
+            ),
+            [self.probs],
+        )
+
+    def log_prob(self, value):
+        return primitive(
+            "bern_log_prob",
+            lambda p, v: v * jnp.log(p) + (1 - v) * jnp.log1p(-p),
+            [self.probs, value],
+        )
+
+    def entropy(self):
+        return primitive(
+            "bern_entropy",
+            lambda p: -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)),
+            [self.probs],
+        )
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = logits if isinstance(logits, Tensor) else Tensor(_val(logits))
+        super().__init__(tuple(self.logits.shape[:-1]))
+        self._n = self.logits.shape[-1]
+
+    def _probs(self):
+        return primitive("cat_probs", lambda l: jax.nn.softmax(l, -1), [self.logits])
+
+    @property
+    def probs(self):
+        return self._probs()
+
+    def sample(self, shape=()):
+        key = _key()
+        full = _shape(shape, self._batch_shape)
+        out = primitive(
+            "cat_sample",
+            lambda l: jax.random.categorical(key, l, shape=full + ()) if not self._batch_shape
+            else jax.random.categorical(key, l, shape=full),
+            [self.logits],
+        )
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        return primitive(
+            "cat_log_prob",
+            lambda l, v: jnp.take_along_axis(
+                jax.nn.log_softmax(l, -1), v.astype(jnp.int32)[..., None], -1
+            )[..., 0],
+            [self.logits, value],
+        )
+
+    def entropy(self):
+        return primitive(
+            "cat_entropy",
+            lambda l: -jnp.sum(jax.nn.softmax(l, -1) * jax.nn.log_softmax(l, -1), -1),
+            [self.logits],
+        )
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = rate if isinstance(rate, Tensor) else Tensor(_val(rate))
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return primitive("exp_mean", lambda r: 1.0 / r, [self.rate])
+
+    @property
+    def variance(self):
+        return primitive("exp_var", lambda r: 1.0 / (r * r), [self.rate])
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        key = _key()
+        full = _shape(shape, self._batch_shape)
+        return primitive(
+            "exp_rsample", lambda r: jax.random.exponential(key, full) / r, [self.rate]
+        )
+
+    def log_prob(self, value):
+        return primitive(
+            "exp_log_prob",
+            lambda r, v: jnp.where(v >= 0, jnp.log(r) - r * v, -jnp.inf),
+            [self.rate, value],
+        )
+
+    def entropy(self):
+        return primitive("exp_entropy", lambda r: 1.0 - jnp.log(r), [self.rate])
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(_val(loc))
+        self.scale = scale if isinstance(scale, Tensor) else Tensor(_val(scale))
+        super().__init__(np.broadcast_shapes(tuple(self.loc.shape), tuple(self.scale.shape)))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return primitive("laplace_var", lambda s: 2 * s * s, [self.scale])
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        key = _key()
+        full = _shape(shape, self._batch_shape)
+        return primitive(
+            "laplace_rsample",
+            lambda l, s: l + s * jax.random.laplace(key, full),
+            [self.loc, self.scale],
+        )
+
+    def log_prob(self, value):
+        return primitive(
+            "laplace_log_prob",
+            lambda l, s, v: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            [self.loc, self.scale, value],
+        )
+
+    def entropy(self):
+        return primitive(
+            "laplace_entropy", lambda s: 1 + jnp.log(2 * s), [self.scale]
+        )
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(_val(loc))
+        self.scale = scale if isinstance(scale, Tensor) else Tensor(_val(scale))
+        super().__init__(np.broadcast_shapes(tuple(self.loc.shape), tuple(self.scale.shape)))
+
+    _EULER = 0.5772156649015329
+
+    @property
+    def mean(self):
+        return primitive(
+            "gumbel_mean", lambda l, s: l + s * self._EULER, [self.loc, self.scale]
+        )
+
+    @property
+    def variance(self):
+        return primitive(
+            "gumbel_var", lambda s: (math.pi ** 2 / 6) * s * s, [self.scale]
+        )
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        key = _key()
+        full = _shape(shape, self._batch_shape)
+        return primitive(
+            "gumbel_rsample",
+            lambda l, s: l + s * jax.random.gumbel(key, full),
+            [self.loc, self.scale],
+        )
+
+    def log_prob(self, value):
+        return primitive(
+            "gumbel_log_prob",
+            lambda l, s, v: -((v - l) / s + jnp.exp(-(v - l) / s)) - jnp.log(s),
+            [self.loc, self.scale, value],
+        )
+
+    def entropy(self):
+        return primitive(
+            "gumbel_entropy", lambda s: jnp.log(s) + 1 + self._EULER, [self.scale]
+        )
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = probs if isinstance(probs, Tensor) else Tensor(_val(probs))
+        super().__init__(tuple(self.probs.shape[:-1]), (self.probs.shape[-1],))
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return primitive("multi_mean", lambda p: n * p, [self.probs])
+
+    @property
+    def variance(self):
+        n = self.total_count
+        return primitive("multi_var", lambda p: n * p * (1 - p), [self.probs])
+
+    def sample(self, shape=()):
+        key = _key()
+        n = self.total_count
+        k = self.probs.shape[-1]
+        full = _shape(shape, self._batch_shape)
+
+        def fn(p):
+            logits = jnp.log(p)
+            draws = jax.random.categorical(key, logits, shape=full + (n,))
+            return jnp.sum(jax.nn.one_hot(draws, k, dtype=jnp.float32), axis=-2)
+
+        out = primitive("multi_sample", fn, [self.probs])
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        n = self.total_count
+
+        def fn(p, v):
+            logf = jax.scipy.special.gammaln(jnp.asarray(n + 1.0)) - jnp.sum(
+                jax.scipy.special.gammaln(v + 1.0), -1
+            )
+            return logf + jnp.sum(v * jnp.log(p), -1)
+
+        return primitive("multi_log_prob", fn, [self.probs, value])
+
+    def entropy(self):
+        raise NotImplementedError("Multinomial entropy has no closed form here")
+
+
+# --------------------------------------------------------------------- KL
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a KL implementation (reference kl.py::register_kl)."""
+
+    def wrap(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return wrap
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    return primitive(
+        "kl_normal",
+        lambda pl, ps, ql, qs: jnp.log(qs / ps) + (ps ** 2 + (pl - ql) ** 2) / (2 * qs ** 2) - 0.5,
+        [p.loc, p.scale, q.loc, q.scale],
+    )
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return primitive(
+        "kl_uniform",
+        lambda pa, pb, qa, qb: jnp.where(
+            (qa <= pa) & (pb <= qb), jnp.log((qb - qa) / (pb - pa)), jnp.inf
+        ),
+        [p.low, p.high, q.low, q.high],
+    )
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return primitive(
+        "kl_categorical",
+        lambda pl, ql: jnp.sum(
+            jax.nn.softmax(pl, -1) * (jax.nn.log_softmax(pl, -1) - jax.nn.log_softmax(ql, -1)), -1
+        ),
+        [p.logits, q.logits],
+    )
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    return primitive(
+        "kl_bernoulli",
+        lambda pp, qp: pp * (jnp.log(pp) - jnp.log(qp))
+        + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)),
+        [p.probs, q.probs],
+    )
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return primitive(
+        "kl_exponential",
+        lambda pr, qr: jnp.log(pr) - jnp.log(qr) + qr / pr - 1.0,
+        [p.rate, q.rate],
+    )

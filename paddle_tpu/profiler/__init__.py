@@ -1,0 +1,9 @@
+from .profiler import (  # noqa: F401
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    RecordEvent,
+    SortedKeys,
+    export_chrome_tracing,
+    make_scheduler,
+)

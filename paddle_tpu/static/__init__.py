@@ -1,0 +1,94 @@
+"""paddle.static compatibility surface (reference: python/paddle/static/).
+
+The reference's static graph (Program/Executor/feed-fetch) is subsumed by
+the jit compile path here — `to_static` traces to one XLA program and the
+"executor" is the compiled function cache (SURVEY.md §7: PIR+interpreter →
+jaxpr+XLA). This module keeps the reference's entry points importable and
+maps them onto that path; InputSpec is the shared shape/dtype declaration.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..base import dtype as dtype_mod
+
+
+class InputSpec:
+    """Shape/dtype/name declaration (reference static/input.py::InputSpec).
+    None/-1 dims mark dynamic axes (bucketing boundary under XLA)."""
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32", name=None,
+                 stop_gradient=True):
+        self.shape = [None if (s is None or s == -1) else int(s) for s in shape]
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(list(ndarray.shape), str(ndarray.dtype), name)
+
+    def batch(self, batch_size):
+        return InputSpec([batch_size] + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        if not self.shape:
+            raise ValueError("cannot unbatch a 0-D InputSpec")
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    def __eq__(self, other):
+        return (isinstance(other, InputSpec) and self.shape == other.shape
+                and self.dtype == other.dtype and self.name == other.name)
+
+    def __hash__(self):
+        return hash((tuple(self.shape), str(self.dtype), self.name))
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
+    """Maps to jit.save (reference static/io.py::save_inference_model — the
+    program+params export path)."""
+    program = kwargs.get("program")
+    layer = program if program is not None else fetch_vars
+    from ..jit.serialization import save as jit_save
+
+    jit_save(layer, path_prefix)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from ..jit.serialization import load as jit_load
+
+    return jit_load(path_prefix)
+
+
+# no-op graph-mode toggles: eager tracing is always live and to_static
+# compiles whole steps, so program guards are identity context managers
+class _NullGuard:
+    def __init__(self, *a, **k):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+program_guard = _NullGuard
+name_scope = _NullGuard
+
+
+def default_main_program():
+    return None
+
+
+def default_startup_program():
+    return None

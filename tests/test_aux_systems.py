@@ -1,0 +1,184 @@
+"""Aux subsystems: profiler, distribution, launcher CLI, static shims
+(reference analogs: test/legacy_test/test_profiler.py,
+test/distribution/, test/legacy_test/test_run.py)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ------------------------------------------------------------------ profiler
+def test_profiler_records_op_events(tmp_path):
+    from paddle_tpu import profiler as prof_mod
+
+    with prof_mod.Profiler(
+        targets=[prof_mod.ProfilerTarget.CPU],
+        on_trace_ready=prof_mod.export_chrome_tracing(str(tmp_path)),
+    ) as prof:
+        x = paddle.ones([4, 4])
+        (x @ x).sum().numpy()
+    assert any(e["name"] == "matmul" for e in prof._events)
+    trace_files = list(tmp_path.iterdir())
+    assert trace_files, "chrome trace not exported"
+    data = json.loads(trace_files[0].read_text())
+    assert any(ev["name"] == "matmul" for ev in data["traceEvents"])
+    # hook cleared after stop
+    from paddle_tpu.core import hooks
+
+    assert hooks.op_profiler is None
+
+
+def test_profiler_scheduler_states():
+    from paddle_tpu.profiler import ProfilerState, make_scheduler
+
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sched(i) for i in range(5)]
+    assert states[0] == ProfilerState.CLOSED
+    assert states[1] == ProfilerState.READY
+    assert states[2] == ProfilerState.RECORD
+    assert states[3] == ProfilerState.RECORD_AND_RETURN
+    assert states[4] == ProfilerState.CLOSED
+
+
+def test_profiler_summary_and_benchmark(capsys):
+    from paddle_tpu import profiler as prof_mod
+
+    prof = prof_mod.Profiler()
+    prof.start()
+    for _ in range(3):
+        paddle.ones([2, 2]).sum().numpy()
+        prof.step()
+    prof.stop()
+    stats = prof.summary()
+    assert stats
+    bench = prof.benchmark()
+    assert bench["steps"] == 3
+
+
+# -------------------------------------------------------------- distribution
+def test_normal_distribution():
+    from paddle_tpu.distribution import Normal, kl_divergence
+
+    paddle.seed(0)
+    d = Normal(loc=1.0, scale=2.0)
+    s = d.sample([2000])
+    assert abs(float(s.numpy().mean()) - 1.0) < 0.2
+    assert abs(float(s.numpy().std()) - 2.0) < 0.2
+    lp = d.log_prob(paddle.to_tensor(1.0))
+    expect = -np.log(2.0) - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(float(lp.numpy()), expect, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(d.entropy().numpy()), 0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0), rtol=1e-5
+    )
+    kl = kl_divergence(d, Normal(loc=1.0, scale=2.0))
+    np.testing.assert_allclose(float(kl.numpy()), 0.0, atol=1e-6)
+
+
+def test_normal_rsample_grad():
+    from paddle_tpu.distribution import Normal
+
+    loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(1.5), stop_gradient=False)
+    d = Normal(loc, scale)
+    d.rsample([64]).mean().backward()
+    np.testing.assert_allclose(float(loc.grad.numpy()), 1.0, rtol=1e-5)
+
+
+def test_uniform_bernoulli_categorical():
+    from paddle_tpu.distribution import Bernoulli, Categorical, Uniform, kl_divergence
+
+    paddle.seed(1)
+    u = Uniform(0.0, 4.0)
+    assert abs(float(u.sample([4000]).numpy().mean()) - 2.0) < 0.2
+    np.testing.assert_allclose(float(u.entropy().numpy()), np.log(4.0), rtol=1e-6)
+    assert np.isneginf(float(u.log_prob(paddle.to_tensor(5.0)).numpy()))
+
+    b = Bernoulli(paddle.to_tensor(0.3))
+    assert abs(float(b.sample([4000]).numpy().mean()) - 0.3) < 0.05
+    np.testing.assert_allclose(float(b.mean.numpy()), 0.3, rtol=1e-6)
+
+    logits = paddle.to_tensor(np.log(np.array([0.2, 0.8], np.float32)))
+    c = Categorical(logits)
+    samples = c.sample([4000]).numpy()
+    assert abs(samples.mean() - 0.8) < 0.05
+    np.testing.assert_allclose(
+        float(kl_divergence(c, Categorical(logits)).numpy()), 0.0, atol=1e-6
+    )
+
+
+def test_exponential_laplace_gumbel_multinomial():
+    from paddle_tpu.distribution import Exponential, Gumbel, Laplace, Multinomial
+
+    paddle.seed(2)
+    e = Exponential(rate=2.0)
+    assert abs(float(e.sample([4000]).numpy().mean()) - 0.5) < 0.1
+    l = Laplace(0.0, 1.0)
+    assert abs(float(l.sample([4000]).numpy().mean())) < 0.15
+    g = Gumbel(0.0, 1.0)
+    assert abs(float(g.sample([4000]).numpy().mean()) - 0.5772) < 0.15
+    m = Multinomial(10, paddle.to_tensor(np.array([0.25, 0.75], np.float32)))
+    s = m.sample([100])
+    assert s.shape == [100, 2]
+    np.testing.assert_allclose(s.numpy().sum(-1), np.full(100, 10.0))
+
+
+# ------------------------------------------------------------------ launcher
+def test_launcher_spawns_workers(tmp_path):
+    script = tmp_path / "worker.py"
+    # per-rank marker files: concurrent workers interleave a shared stdout
+    script.write_text(
+        "import os, pathlib\n"
+        f"out = pathlib.Path({str(tmp_path)!r})\n"
+        "rid = os.environ['PADDLE_TRAINER_ID']\n"
+        "n = os.environ['PADDLE_TRAINERS_NUM']\n"
+        "(out / f'rank_{rid}').write_text(f'{rid} of {n}')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", str(script)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert (tmp_path / "rank_0").read_text() == "0 of 2"
+    assert (tmp_path / "rank_1").read_text() == "1 of 2"
+
+
+def test_launcher_restarts_failed_worker(tmp_path):
+    marker = tmp_path / "marker"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        f"import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        f"if not os.path.exists(m):\n"
+        f"    open(m, 'w').close()\n"
+        f"    sys.exit(1)\n"
+        f"print('recovered')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--max_restarts", "1", str(script)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "recovered" in out.stdout
+
+
+# -------------------------------------------------------------------- static
+def test_input_spec():
+    from paddle_tpu.static import InputSpec
+
+    spec = InputSpec([None, 8], "float32", "x")
+    assert spec.shape == [None, 8]
+    t = paddle.ones([4, 8])
+    s2 = InputSpec.from_tensor(t)
+    assert s2.shape == [4, 8]
+    assert spec.batch(16).shape == [16, None, 8]
+    assert s2.unbatch().shape == [8]
+    assert InputSpec([2], "float32") == InputSpec([2], "float32")
