@@ -65,6 +65,15 @@ from .utils import moe_utils  # noqa: F401
 from .spawn import spawn  # noqa: F401
 
 
+def __getattr__(name):
+    # native TCPStore loads lazily (compiles the native lib on first use)
+    if name == "TCPStore":
+        from ..native import TCPStore
+
+        return TCPStore
+    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")
+
+
 def get_world_process_group():
     from .communication import get_group
 

@@ -12,6 +12,7 @@ matching the reference's ordered blocking queue.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 from dataclasses import dataclass
@@ -139,6 +140,142 @@ class _MapIter:
         return self
 
 
+def _numpy_collate(batch):
+    """Worker-side collate producing numpy trees (process workers must not
+    touch jax — forked children would re-initialize the backend)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, dict):
+        return {k: _numpy_collate([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return tuple(_numpy_collate(list(fields)) for fields in zip(*batch))
+    if hasattr(sample, "numpy"):
+        return np.stack([s.numpy() for s in batch])
+    raise TypeError(f"batch data can not be a {type(sample)}")
+
+
+class _ProcessMapIter:
+    """Forked worker processes streaming batches through native shared-memory
+    rings (reference: multiprocess dataloader workers over a shared-memory
+    blocking queue, python/paddle/io/dataloader/dataloader_iter.py).
+
+    Worker w owns ring w and produces batches w, w+W, 2W+w, ...; the consumer
+    pops rings round-robin, which preserves global batch order with no
+    reorder buffer. Payloads are pickled numpy trees; Tensor conversion
+    happens in the parent so children never touch jax.
+    """
+
+    _seq = 0
+
+    def __init__(self, loader: "DataLoader"):
+        import multiprocessing
+        import pickle
+
+        from ..native import ShmRing
+
+        self._pickle = pickle
+        self.loader = loader
+        self.n_workers = loader.num_workers
+        batches = list(loader.batch_sampler)
+        _ProcessMapIter._seq += 1
+        tag = f"/ptdl_{os.getpid()}_{_ProcessMapIter._seq}"
+        self.rings = [ShmRing(f"{tag}_{w}", capacity=loader.shm_capacity)
+                      for w in range(self.n_workers)]
+        ctx = multiprocessing.get_context("fork")
+        self.procs = []
+        for w in range(self.n_workers):
+            p = ctx.Process(
+                target=_process_worker,
+                args=(loader.dataset, loader.collate_fn, batches[w::self.n_workers],
+                      f"{tag}_{w}", w, self.n_workers, loader.worker_init_fn),
+                daemon=True,
+            )
+            p.start()
+            self.procs.append(p)
+        self.cursor = 0
+        self.done = [False] * self.n_workers
+        self.remaining = len(batches)
+
+    def __next__(self):
+        while True:
+            if self.remaining == 0 or all(self.done):
+                self._shutdown()
+                raise StopIteration
+            w = self.cursor % self.n_workers
+            self.cursor += 1
+            if self.done[w]:
+                continue
+            msg = self.rings[w].pop(timeout=300.0)
+            if msg is None:
+                self.done[w] = True
+                continue
+            kind, payload = self._pickle.loads(msg)
+            if kind == "error":
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker {w} failed:\n{payload}")
+            self.remaining -= 1
+            return default_convert_fn(payload)
+
+    def __iter__(self):
+        return self
+
+    def _shutdown(self):
+        for r in self.rings:
+            try:
+                r.close()
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        for r in self.rings:
+            try:
+                r.free()
+            except Exception:
+                pass
+        self.rings, self.procs = [], []
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
+def _process_worker(dataset, collate_fn, batches, ring_name, wid, n_workers,
+                    worker_init_fn):
+    import pickle
+    import traceback
+
+    from ..native import ShmRing
+
+    ring = ShmRing(ring_name, create=False)
+    _worker_info.info = WorkerInfo(wid, n_workers, dataset)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        np_collate = _numpy_collate if collate_fn is default_collate_fn else collate_fn
+        for indices in batches:
+            samples = [dataset[i] for i in indices]
+            batch = np_collate(samples)
+            ring.push(pickle.dumps(("batch", batch)), timeout=300.0)
+    except BrokenPipeError:
+        pass  # consumer shut down early
+    except BaseException:
+        try:
+            ring.push(pickle.dumps(("error", traceback.format_exc())), timeout=10.0)
+        except Exception:
+            pass
+    finally:
+        ring.close()
+
+
 class _IterableIter:
     def __init__(self, loader: "DataLoader"):
         self.loader = loader
@@ -183,6 +320,8 @@ class DataLoader:
         timeout: int = 0,
         worker_init_fn: Optional[Callable] = None,
         persistent_workers: bool = False,
+        worker_mode: str = "thread",
+        shm_capacity: int = 64 << 20,
     ):
         self.dataset = dataset
         self.return_list = return_list
@@ -190,6 +329,15 @@ class DataLoader:
         self.prefetch_factor = max(prefetch_factor, 1)
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
+        self.shm_capacity = shm_capacity
+        if worker_mode not in ("thread", "process"):
+            raise ValueError("worker_mode must be 'thread' or 'process'")
+        if worker_mode == "process" and self.num_workers > 0:
+            from ..native import available as native_available
+
+            if not native_available() or not use_shared_memory:
+                worker_mode = "thread"  # graceful fallback
+        self.worker_mode = worker_mode
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             if batch_sampler is not None or shuffle:
@@ -212,7 +360,11 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
 
     def __iter__(self):
-        return _IterableIter(self) if self._iterable else _MapIter(self)
+        if self._iterable:
+            return _IterableIter(self)
+        if self.worker_mode == "process" and self.num_workers > 0:
+            return _ProcessMapIter(self)
+        return _MapIter(self)
 
     def __len__(self):
         if self._iterable:

@@ -1,0 +1,193 @@
+"""Native runtime tier: C++ components bound via ctypes.
+
+- TCPStore: rendezvous KV store (reference
+  paddle/phi/core/distributed/store/tcp_store.h:121) — blocking get/wait,
+  atomic add, multi-client threaded server.
+- ShmRing: shared-memory SPSC ring for DataLoader worker->consumer batch
+  transport (reference's shared-memory dataloader queue,
+  paddle/fluid/imperative/data_loader.cc).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+from .build import build_library
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build_library())
+        lib.pt_store_server_start.restype = ctypes.c_void_p
+        lib.pt_store_server_start.argtypes = [ctypes.c_int]
+        lib.pt_store_server_port.restype = ctypes.c_int
+        lib.pt_store_server_port.argtypes = [ctypes.c_void_p]
+        lib.pt_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pt_store_client_connect.restype = ctypes.c_void_p
+        lib.pt_store_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.pt_store_client_close.argtypes = [ctypes.c_void_p]
+        for fn, args in [
+            ("pt_store_set", [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64]),
+            ("pt_store_get", [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64]),
+            ("pt_store_add", [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]),
+            ("pt_store_wait", [ctypes.c_void_p, ctypes.c_char_p]),
+            ("pt_store_delete", [ctypes.c_void_p, ctypes.c_char_p]),
+            ("pt_store_num_keys", [ctypes.c_void_p]),
+        ]:
+            getattr(lib, fn).restype = ctypes.c_int64
+            getattr(lib, fn).argtypes = args
+        lib.pt_ring_create.restype = ctypes.c_void_p
+        lib.pt_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.pt_ring_open.restype = ctypes.c_void_p
+        lib.pt_ring_open.argtypes = [ctypes.c_char_p]
+        lib.pt_ring_push.restype = ctypes.c_int
+        lib.pt_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int]
+        lib.pt_ring_pop.restype = ctypes.c_int64
+        lib.pt_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+        lib.pt_ring_next_size.restype = ctypes.c_int64
+        lib.pt_ring_next_size.argtypes = [ctypes.c_void_p]
+        lib.pt_ring_close.argtypes = [ctypes.c_void_p]
+        lib.pt_ring_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class TCPStore:
+    """Reference-parity store API: TCPStore(host, port, is_master, world_size).
+
+    The master rank hosts the server in-process; every rank (master included)
+    talks through a client connection.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 30.0):
+        lib = _load()
+        self._server = None
+        self.host = host
+        if is_master:
+            self._server = lib.pt_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = lib.pt_store_server_port(self._server)
+        self.port = port
+        self._client = lib.pt_store_client_connect(
+            host.encode(), port, int(timeout * 1000))
+        if not self._client:
+            if self._server:
+                lib.pt_store_server_stop(self._server)
+            raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        st = _load().pt_store_set(self._client, key.encode(), data, len(data))
+        if st < 0:
+            raise RuntimeError(f"TCPStore.set failed ({st})")
+
+    def get(self, key: str) -> bytes:
+        lib = _load()
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = lib.pt_store_get(self._client, key.encode(), buf, len(buf))
+        if n < 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) failed ({n})")
+        return buf.raw[:n]
+
+    def add(self, key: str, amount: int) -> int:
+        n = _load().pt_store_add(self._client, key.encode(), int(amount))
+        if n < 0 and n != int(amount):
+            raise RuntimeError(f"TCPStore.add failed ({n})")
+        return int(n)
+
+    def wait(self, keys) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            st = _load().pt_store_wait(self._client, k.encode())
+            if st < 0:
+                raise RuntimeError(f"TCPStore.wait({k!r}) failed ({st})")
+
+    def delete_key(self, key: str) -> bool:
+        return _load().pt_store_delete(self._client, key.encode()) > 0
+
+    def num_keys(self) -> int:
+        return int(_load().pt_store_num_keys(self._client))
+
+    def close(self):
+        lib = _load()
+        if self._client:
+            lib.pt_store_client_close(self._client)
+            self._client = None
+        if self._server:
+            lib.pt_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ShmRing:
+    """SPSC shared-memory message ring (one producer, one consumer)."""
+
+    def __init__(self, name: str, capacity: int = 64 << 20, create: bool = True):
+        lib = _load()
+        self.name = name
+        if create:
+            self._h = lib.pt_ring_create(name.encode(), capacity)
+        else:
+            self._h = lib.pt_ring_open(name.encode())
+        if not self._h:
+            raise RuntimeError(f"ShmRing: cannot {'create' if create else 'open'} {name}")
+
+    def push(self, data: bytes, timeout: float = 60.0) -> None:
+        st = _load().pt_ring_push(self._h, data, len(data), int(timeout * 1000))
+        if st == -3:
+            raise ValueError(f"message of {len(data)} bytes exceeds ring capacity")
+        if st == -2:
+            raise BrokenPipeError("ring closed")
+        if st != 0:
+            raise TimeoutError("ring push timed out")
+
+    def pop(self, timeout: float = 60.0) -> Optional[bytes]:
+        """Returns None when the ring is closed and drained."""
+        lib = _load()
+        cap = 1 << 20
+        while True:
+            nxt = lib.pt_ring_next_size(self._h)
+            if nxt > cap:
+                cap = int(nxt)
+            buf = ctypes.create_string_buffer(cap)
+            n = lib.pt_ring_pop(self._h, buf, cap, int(timeout * 1000))
+            if n == -4:  # message larger than buffer; retry bigger
+                cap *= 2
+                continue
+            if n == -2:
+                return None
+            if n == -1:
+                raise TimeoutError("ring pop timed out")
+            return buf.raw[:n]
+
+    def close(self):
+        if self._h:
+            _load().pt_ring_close(self._h)
+
+    def free(self):
+        if self._h:
+            _load().pt_ring_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        pass  # explicit lifecycle: close()/free()
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:
+        return False

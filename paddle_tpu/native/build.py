@@ -1,0 +1,50 @@
+"""On-demand g++ build of the native runtime library.
+
+The reference ships its native tier prebuilt into libpaddle.so via CMake;
+here the sources compile once per source-hash into a cached .so (pybind11 is
+unavailable in this environment, so bindings are ctypes over a C ABI).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_SOURCES = ["tcp_store.cc", "shm_ring.cc"]
+_lock = threading.Lock()
+_lib_path = None
+
+
+def _src_dir() -> str:
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("PADDLE_TPU_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def build_library() -> str:
+    """Compile (if needed) and return the path of the native .so."""
+    global _lib_path
+    with _lock:
+        if _lib_path and os.path.exists(_lib_path):
+            return _lib_path
+        srcs = [os.path.join(_src_dir(), s) for s in _SOURCES]
+        h = hashlib.sha256()
+        for s in srcs:
+            with open(s, "rb") as f:
+                h.update(f.read())
+        out = os.path.join(_cache_dir(), f"libpaddle_tpu_native_{h.hexdigest()[:16]}.so")
+        if not os.path.exists(out):
+            tmp = out + f".tmp{os.getpid()}"
+            cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+                   *srcs, "-o", tmp, "-lrt"]
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp, out)
+        _lib_path = out
+        return out

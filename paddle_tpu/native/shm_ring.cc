@@ -1,0 +1,227 @@
+// Shared-memory SPSC ring buffer for DataLoader worker->consumer batch
+// transport.
+//
+// TPU-native rebuild of the reference's shared-memory dataloader queue
+// (/root/reference/python/paddle/io/dataloader/worker.py +
+// paddle/fluid/imperative/data_loader.cc — multiprocess workers push
+// batches through shared memory instead of pickling over pipes). One ring
+// per worker process; the consumer drains rings round-robin, which
+// preserves batch order without a reorder buffer.
+//
+// Layout in the POSIX shm segment:
+//   Header { pthread mutex+conds (PROCESS_SHARED) | u64 capacity | u64 head
+//            | u64 tail | u32 closed }  followed by capacity data bytes.
+// Messages are length-prefixed: u32 len | payload. Blocking push/pop with
+// millisecond timeouts.
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <cstdio>
+#include <new>
+
+namespace {
+
+struct Header {
+  pthread_mutex_t mu;
+  pthread_cond_t not_full;
+  pthread_cond_t not_empty;
+  uint64_t capacity;
+  uint64_t head;  // read position (bytes consumed)
+  uint64_t tail;  // write position (bytes produced)
+  uint32_t closed;
+};
+
+struct Ring {
+  Header* hdr = nullptr;
+  uint8_t* data = nullptr;
+  size_t map_len = 0;
+  int owner = 0;
+  char name[128] = {0};
+};
+
+uint64_t used(const Header* h) { return h->tail - h->head; }
+
+void write_bytes(Ring* r, uint64_t pos, const void* src, uint64_t n) {
+  uint64_t off = pos % r->hdr->capacity;
+  uint64_t first = n < r->hdr->capacity - off ? n : r->hdr->capacity - off;
+  std::memcpy(r->data + off, src, first);
+  if (n > first) std::memcpy(r->data, static_cast<const uint8_t*>(src) + first, n - first);
+}
+
+void read_bytes(Ring* r, uint64_t pos, void* dst, uint64_t n) {
+  uint64_t off = pos % r->hdr->capacity;
+  uint64_t first = n < r->hdr->capacity - off ? n : r->hdr->capacity - off;
+  std::memcpy(dst, r->data + off, first);
+  if (n > first) std::memcpy(static_cast<uint8_t*>(dst) + first, r->data, n - first);
+}
+
+void abs_deadline(timespec* ts, int timeout_ms) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += static_cast<long>(timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_ring_create(const char* name, uint64_t capacity) {
+  size_t total = sizeof(Header) + capacity;
+  ::shm_unlink(name);
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (::ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = new (mem) Header();
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutex_init(&hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&hdr->not_full, &ca);
+  pthread_cond_init(&hdr->not_empty, &ca);
+  hdr->capacity = capacity;
+  hdr->head = hdr->tail = 0;
+  hdr->closed = 0;
+  auto* r = new Ring();
+  r->hdr = hdr;
+  r->data = static_cast<uint8_t*>(mem) + sizeof(Header);
+  r->map_len = total;
+  r->owner = 1;
+  std::snprintf(r->name, sizeof(r->name), "%s", name);
+  return r;
+}
+
+void* pt_ring_open(const char* name) {
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st{};
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, static_cast<size_t>(st.st_size),
+                     PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* r = new Ring();
+  r->hdr = static_cast<Header*>(mem);
+  r->data = static_cast<uint8_t*>(mem) + sizeof(Header);
+  r->map_len = static_cast<size_t>(st.st_size);
+  std::snprintf(r->name, sizeof(r->name), "%s", name);
+  return r;
+}
+
+// status: 0 ok, -1 timeout, -2 closed, -3 message too large
+int pt_ring_push(void* handle, const void* buf, uint32_t len, int timeout_ms) {
+  auto* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  uint64_t need = 4ull + len;
+  if (need > h->capacity) return -3;
+  timespec ts;
+  abs_deadline(&ts, timeout_ms);
+  pthread_mutex_lock(&h->mu);
+  while (h->capacity - used(h) < need && !h->closed) {
+    if (pthread_cond_timedwait(&h->not_full, &h->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  if (h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -2;
+  }
+  write_bytes(r, h->tail, &len, 4);
+  write_bytes(r, h->tail + 4, buf, len);
+  h->tail += need;
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// returns payload length (>=0), -1 timeout, -2 closed+empty, -4 out too small
+int64_t pt_ring_pop(void* handle, void* out, uint64_t cap, int timeout_ms) {
+  auto* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  timespec ts;
+  abs_deadline(&ts, timeout_ms);
+  pthread_mutex_lock(&h->mu);
+  while (used(h) < 4) {
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      return -2;
+    }
+    if (pthread_cond_timedwait(&h->not_empty, &h->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  uint32_t len = 0;
+  read_bytes(r, h->head, &len, 4);
+  if (len > cap) {
+    pthread_mutex_unlock(&h->mu);
+    return -4;
+  }
+  read_bytes(r, h->head + 4, out, len);
+  h->head += 4ull + len;
+  pthread_cond_signal(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  return static_cast<int64_t>(len);
+}
+
+// peek next message size without consuming; -1 empty
+int64_t pt_ring_next_size(void* handle) {
+  auto* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  pthread_mutex_lock(&h->mu);
+  int64_t res = -1;
+  if (used(h) >= 4) {
+    uint32_t len = 0;
+    read_bytes(r, h->head, &len, 4);
+    res = static_cast<int64_t>(len);
+  }
+  pthread_mutex_unlock(&h->mu);
+  return res;
+}
+
+void pt_ring_close(void* handle) {
+  auto* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  pthread_mutex_lock(&h->mu);
+  h->closed = 1;
+  pthread_cond_broadcast(&h->not_empty);
+  pthread_cond_broadcast(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+}
+
+void pt_ring_free(void* handle) {
+  auto* r = static_cast<Ring*>(handle);
+  if (r->owner) ::shm_unlink(r->name);
+  ::munmap(r->hdr, r->map_len);
+  delete r;
+}
+
+}  // extern "C"

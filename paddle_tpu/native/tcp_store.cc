@@ -1,0 +1,285 @@
+// TCPStore: native rendezvous key-value store.
+//
+// TPU-native rebuild of the reference's C++ TCPStore
+// (/root/reference/paddle/phi/core/distributed/store/tcp_store.h:121 and
+// socket.cpp): a threaded TCP server holding a bytes map with blocking
+// GET/WAIT and atomic ADD, plus a client. The JAX coordination service
+// covers collective bootstrap; this store covers the reference's other
+// TCPStore duties — barriers, rank registration, user KV exchange — and is
+// exposed as paddle_tpu.distributed.TCPStore via ctypes (no pybind11 in
+// this environment).
+//
+// Protocol (little-endian):
+//   request:  u8 cmd | u32 klen | key | u32 vlen | val
+//   response: i64 status (<0 error) | u32 payload_len | payload
+// Commands: 1 SET, 2 GET (blocks until key exists or timeout), 3 ADD
+// (val = i64 delta; creates key at 0), 4 WAIT (key exists), 5 DELETE,
+// 6 NUMKEYS.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<uint8_t>> data;
+  std::atomic<bool> stopping{false};
+  int port = 0;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void handle_conn(Server* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t cmd;
+    uint32_t klen, vlen;
+    if (!read_full(fd, &cmd, 1) || !read_full(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, &key[0], klen)) break;
+    if (!read_full(fd, &vlen, 4)) break;
+    std::vector<uint8_t> val(vlen);
+    if (vlen && !read_full(fd, val.data(), vlen)) break;
+
+    int64_t status = 0;
+    std::vector<uint8_t> payload;
+    switch (cmd) {
+      case 1: {  // SET
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->data[key] = std::move(val);
+        s->cv.notify_all();
+        break;
+      }
+      case 2: {  // GET — block until present
+        std::unique_lock<std::mutex> lk(s->mu);
+        s->cv.wait(lk, [&] { return s->stopping.load() || s->data.count(key); });
+        if (s->stopping.load()) {
+          status = -2;
+        } else {
+          payload = s->data[key];
+          status = static_cast<int64_t>(payload.size());
+        }
+        break;
+      }
+      case 3: {  // ADD
+        int64_t delta = 0;
+        if (vlen == 8) std::memcpy(&delta, val.data(), 8);
+        std::lock_guard<std::mutex> lk(s->mu);
+        int64_t cur = 0;
+        auto it = s->data.find(key);
+        if (it != s->data.end() && it->second.size() == 8)
+          std::memcpy(&cur, it->second.data(), 8);
+        cur += delta;
+        std::vector<uint8_t> enc(8);
+        std::memcpy(enc.data(), &cur, 8);
+        s->data[key] = enc;
+        s->cv.notify_all();
+        payload = enc;
+        status = 8;
+        break;
+      }
+      case 4: {  // WAIT
+        std::unique_lock<std::mutex> lk(s->mu);
+        s->cv.wait(lk, [&] { return s->stopping.load() || s->data.count(key); });
+        status = s->stopping.load() ? -2 : 0;
+        break;
+      }
+      case 5: {  // DELETE
+        std::lock_guard<std::mutex> lk(s->mu);
+        status = static_cast<int64_t>(s->data.erase(key));
+        break;
+      }
+      case 6: {  // NUMKEYS
+        std::lock_guard<std::mutex> lk(s->mu);
+        status = static_cast<int64_t>(s->data.size());
+        break;
+      }
+      default:
+        status = -1;
+    }
+    uint32_t plen = static_cast<uint32_t>(payload.size());
+    if (!write_full(fd, &status, 8) || !write_full(fd, &plen, 4)) break;
+    if (plen && !write_full(fd, payload.data(), plen)) break;
+  }
+  ::close(fd);
+}
+
+struct Client {
+  int fd = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_store_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] {
+    for (;;) {
+      int fd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;  // listen_fd closed on stop
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->conn_threads.emplace_back(handle_conn, s, fd);
+    }
+  });
+  return s;
+}
+
+int pt_store_server_port(void* handle) {
+  return handle ? static_cast<Server*>(handle)->port : -1;
+}
+
+void pt_store_server_stop(void* handle) {
+  if (!handle) return;
+  auto* s = static_cast<Server*>(handle);
+  s->stopping.store(true);
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  std::vector<std::thread> conns;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    conns.swap(s->conn_threads);
+  }
+  for (auto& t : conns)
+    if (t.joinable()) t.detach();  // blocked clients hold these; sockets are dead
+  delete s;
+}
+
+void* pt_store_client_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    ::inet_pton(AF_INET, host, &addr.sin_addr);
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+static int64_t request(Client* c, uint8_t cmd, const char* key, const void* val,
+                       uint32_t vlen, void* out, int64_t out_cap) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  if (!write_full(c->fd, &cmd, 1) || !write_full(c->fd, &klen, 4) ||
+      !write_full(c->fd, key, klen) || !write_full(c->fd, &vlen, 4) ||
+      (vlen && !write_full(c->fd, val, vlen)))
+    return -3;
+  int64_t status;
+  uint32_t plen;
+  if (!read_full(c->fd, &status, 8) || !read_full(c->fd, &plen, 4)) return -3;
+  if (plen) {
+    std::vector<uint8_t> payload(plen);
+    if (!read_full(c->fd, payload.data(), plen)) return -3;
+    if (out && out_cap >= static_cast<int64_t>(plen))
+      std::memcpy(out, payload.data(), plen);
+  }
+  return status;
+}
+
+int64_t pt_store_set(void* h, const char* key, const void* data, int64_t len) {
+  return request(static_cast<Client*>(h), 1, key, data, static_cast<uint32_t>(len),
+                 nullptr, 0);
+}
+
+int64_t pt_store_get(void* h, const char* key, void* out, int64_t cap) {
+  return request(static_cast<Client*>(h), 2, key, nullptr, 0, out, cap);
+}
+
+int64_t pt_store_add(void* h, const char* key, int64_t delta) {
+  int64_t result = 0;
+  int64_t st = request(static_cast<Client*>(h), 3, key, &delta, 8, &result, 8);
+  return st == 8 ? result : st < 0 ? st : -1;
+}
+
+int64_t pt_store_wait(void* h, const char* key) {
+  return request(static_cast<Client*>(h), 4, key, nullptr, 0, nullptr, 0);
+}
+
+int64_t pt_store_delete(void* h, const char* key) {
+  return request(static_cast<Client*>(h), 5, key, nullptr, 0, nullptr, 0);
+}
+
+int64_t pt_store_num_keys(void* h) {
+  return request(static_cast<Client*>(h), 6, "", nullptr, 0, nullptr, 0);
+}
+
+void pt_store_client_close(void* h) {
+  if (!h) return;
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"
