@@ -1,0 +1,70 @@
+"""paddle.fft parity (reference: python/paddle/fft.py) over jnp.fft.
+
+All transforms dispatch through `primitive`, so they are differentiable
+(jax.vjp covers FFT) and trace under jit. Norm semantics follow the
+reference: 'backward' (default), 'forward', 'ortho'.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import primitive
+from .core.tensor import Tensor
+
+
+def _wrap1(jfn, op_name):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return primitive(op_name, lambda v: jfn(v, n=n, axis=axis, norm=norm), [x])
+
+    op.__name__ = op_name
+    return op
+
+
+def _wrapn(jfn, op_name):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return primitive(op_name, lambda v: jfn(v, s=s, axes=axes, norm=norm), [x])
+
+    op.__name__ = op_name
+    return op
+
+
+def _wrap2(jfn, op_name):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return primitive(op_name, lambda v: jfn(v, s=s, axes=axes, norm=norm), [x])
+
+    op.__name__ = op_name
+    return op
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+
+fft2 = _wrap2(jnp.fft.fft2, "fft2")
+ifft2 = _wrap2(jnp.fft.ifft2, "ifft2")
+rfft2 = _wrap2(jnp.fft.rfft2, "rfft2")
+irfft2 = _wrap2(jnp.fft.irfft2, "irfft2")
+
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d))
+
+
+def fftshift(x, axes=None, name=None):
+    return primitive("fftshift", lambda v: jnp.fft.fftshift(v, axes=axes), [x])
+
+
+def ifftshift(x, axes=None, name=None):
+    return primitive("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), [x])

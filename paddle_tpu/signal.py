@@ -1,0 +1,118 @@
+"""paddle.signal parity (reference: python/paddle/signal.py — frame,
+overlap_add, stft, istft)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import primitive
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames (reference signal.py::frame)."""
+
+    def fn(v):
+        n = v.shape[axis]
+        n_frames = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        moved = jnp.moveaxis(v, axis, -1)
+        framed = moved[..., idx]  # [..., n_frames, frame_length]
+        # reference layout: frame_length before n_frames on the chosen axis
+        framed = jnp.swapaxes(framed, -1, -2)
+        return jnp.moveaxis(framed, (-2, -1), (axis - 1, axis) if axis != -1 else (-2, -1))
+
+    return primitive("frame", fn, [x])
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference signal.py::overlap_add)."""
+
+    def fn(v):
+        moved = jnp.moveaxis(v, axis, -1) if axis != -1 else v
+        # [..., frame_length, n_frames] on the last two dims
+        fl, nf = moved.shape[-2], moved.shape[-1]
+        out_len = fl + hop_length * (nf - 1)
+        starts = jnp.arange(nf) * hop_length
+        idx = starts[:, None] + jnp.arange(fl)[None, :]  # [nf, fl]
+        out = jnp.zeros(moved.shape[:-2] + (out_len,), moved.dtype)
+        out = out.at[..., idx].add(jnp.swapaxes(moved, -1, -2))
+        return out if axis == -1 else jnp.moveaxis(out, -1, axis)
+
+    return primitive("overlap_add", fn, [x])
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (reference signal.py::stft).
+    x: [batch?, signal_len] real or complex -> [batch?, n_fft(/2+1), n_frames].
+    """
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    win_val = None if window is None else (
+        window._value if hasattr(window, "_value") else jnp.asarray(window))
+
+    def fn(v, *w):
+        win = w[0] if w else jnp.ones(win_length, v.dtype if not jnp.iscomplexobj(v) else jnp.float32)
+        if win_length < n_fft:  # center-pad the window to n_fft
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        sig = v
+        if center:
+            pad = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            sig = jnp.pad(sig, pad, mode=pad_mode)
+        n = sig.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = sig[..., idx] * win  # [..., n_frames, n_fft]
+        if onesided and not jnp.iscomplexobj(v):
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, n_frames]
+
+    args = [x] + ([window] if window is not None else [])
+    return primitive("stft", fn, args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (reference istft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(v, *w):
+        win = w[0] if w else jnp.ones(win_length, jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        spec = jnp.swapaxes(v, -1, -2)  # [..., n_frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            frames = frames if return_complex else frames.real
+        frames = frames * win
+        nf = frames.shape[-2]
+        out_len = n_fft + hop_length * (nf - 1)
+        starts = jnp.arange(nf) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        out = out.at[..., idx].add(frames)
+        env = jnp.zeros(out_len, jnp.float32)
+        env = env.at[idx].add(win.astype(jnp.float32) ** 2)
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = [x] + ([window] if window is not None else [])
+    return primitive("istft", fn, args)

@@ -1,0 +1,218 @@
+"""paddle.sparse parity (reference: python/paddle/sparse/ — SparseCooTensor /
+SparseCsrTensor creation, unary/binary ops, sparse matmul, sparse nn).
+
+TPU note: XLA has no native sparse kernels; COO values/indices live as dense
+arrays and sparse x dense matmul lowers to gather + segment-sum, which XLA
+maps well to the TPU's scatter/gather units for moderate nnz. CSR is stored
+as compressed rows and converted to COO row ids on the fly.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from . import nn  # noqa: F401
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: indices [sparse_ndim, nnz] + values [nnz, ...]."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self.indices_t = indices if isinstance(indices, Tensor) else Tensor(np.asarray(indices))
+        self.values_t = values if isinstance(values, Tensor) else Tensor(np.asarray(values))
+        self._shape = [int(s) for s in shape]
+        self.coalesced = coalesced
+
+    # reference method surface
+    def indices(self):
+        return self.indices_t
+
+    def values(self):
+        return self.values_t
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self.values_t.dtype
+
+    @property
+    def nnz(self):
+        return self.values_t.shape[0]
+
+    @property
+    def stop_gradient(self):
+        return self.values_t.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.values_t.stop_gradient = v
+
+    def to_dense(self) -> Tensor:
+        shape = tuple(self._shape)
+        nd = self.indices_t.shape[0]
+
+        def fn(idx, vals):
+            out = jnp.zeros(shape, vals.dtype)
+            return out.at[tuple(idx[d] for d in range(nd))].add(vals)
+
+        return primitive("sparse_to_dense", fn, [self.indices_t, self.values_t])
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self._shape) != 2:
+            raise ValueError("to_sparse_csr supports 2-D tensors")
+        idx = np.asarray(self.indices_t.numpy())
+        vals = self.values_t
+        order = np.lexsort((idx[1], idx[0]))
+        rows, cols = idx[0][order], idx[1][order]
+        crows = np.zeros(self._shape[0] + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        from ..ops.manipulation import gather
+
+        vals_sorted = gather(vals, Tensor(order.astype(np.int64)))
+        return SparseCsrTensor(crows, cols.astype(np.int64), vals_sorted, self._shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix: crows [rows+1], cols [nnz], values [nnz]."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows_t = crows if isinstance(crows, Tensor) else Tensor(np.asarray(crows))
+        self.cols_t = cols if isinstance(cols, Tensor) else Tensor(np.asarray(cols))
+        self.values_t = values if isinstance(values, Tensor) else Tensor(np.asarray(values))
+        self._shape = [int(s) for s in shape]
+
+    def crows(self):
+        return self.crows_t
+
+    def cols(self):
+        return self.cols_t
+
+    def values(self):
+        return self.values_t
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self.values_t.dtype
+
+    @property
+    def nnz(self):
+        return self.values_t.shape[0]
+
+    def _row_ids(self):
+        crows = np.asarray(self.crows_t.numpy())
+        counts = np.diff(crows)
+        return np.repeat(np.arange(len(counts)), counts)
+
+    def to_sparse_coo(self) -> SparseCooTensor:
+        rows = self._row_ids()
+        idx = np.stack([rows, np.asarray(self.cols_t.numpy())])
+        return SparseCooTensor(idx.astype(np.int64), self.values_t, self._shape)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self._shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    indices = np.asarray(indices.numpy() if isinstance(indices, Tensor) else indices)
+    vt = values if isinstance(values, Tensor) else Tensor(np.asarray(values), dtype=dtype)
+    vt.stop_gradient = stop_gradient
+    if shape is None:
+        dense_dims = list(vt.shape[1:])
+        shape = [int(indices[d].max()) + 1 for d in range(indices.shape[0])] + dense_dims
+    return SparseCooTensor(indices.astype(np.int64), vt, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vt = values if isinstance(values, Tensor) else Tensor(np.asarray(values), dtype=dtype)
+    vt.stop_gradient = stop_gradient
+    return SparseCsrTensor(crows, cols, vt, shape)
+
+
+def to_sparse_coo(dense: Tensor, sparse_dim=None) -> SparseCooTensor:
+    """Dense -> COO (reference Tensor.to_sparse_coo)."""
+    arr = np.asarray(dense.numpy())
+    sparse_dim = sparse_dim or arr.ndim
+    if sparse_dim != arr.ndim:
+        raise NotImplementedError("hybrid sparse_dim not supported")
+    idx = np.stack(np.nonzero(arr))
+    from ..ops.manipulation import gather_nd
+
+    vals = gather_nd(dense, Tensor(idx.T.astype(np.int64)))
+    return SparseCooTensor(idx.astype(np.int64), vals, list(arr.shape))
+
+
+def _as_coo(x):
+    return x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (reference sparse/binary.py::matmul): gather rows of
+    the dense operand by column id, scale by values, segment-sum by row."""
+    x = _as_coo(x)
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.matmul expects a sparse first operand")
+    n_rows = x.shape[0]
+
+    def fn(idx, vals, dense):
+        rows, cols = idx[0], idx[1]
+        contrib = vals[:, None] * dense[cols]  # [nnz, N]
+        return jax.ops.segment_sum(contrib, rows, num_segments=n_rows)
+
+    return primitive("sparse_matmul", fn, [x.indices_t, x.values_t, y])
+
+
+def add(x, y, name=None):
+    x, y = _as_coo(x), _as_coo(y)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        from ..ops.manipulation import concat
+
+        idx = np.concatenate([np.asarray(x.indices_t.numpy()),
+                              np.asarray(y.indices_t.numpy())], axis=1)
+        vals = concat([x.values_t, y.values_t], axis=0)
+        return SparseCooTensor(idx, vals, x.shape)
+    raise TypeError("sparse.add expects two sparse tensors")
+
+
+def _unary(op_name, jfn):
+    def op(x, name=None):
+        x = _as_coo(x)
+        out_vals = primitive(op_name, jfn, [x.values_t])
+        return SparseCooTensor(x.indices_t, out_vals, x.shape)
+
+    op.__name__ = op_name
+    return op
+
+
+relu = _unary("sparse_relu", lambda v: jnp.maximum(v, 0))
+sin = _unary("sparse_sin", jnp.sin)
+tanh = _unary("sparse_tanh", jnp.tanh)
+sqrt = _unary("sparse_sqrt", jnp.sqrt)
+abs = _unary("sparse_abs", jnp.abs)  # noqa: A001
+neg = _unary("sparse_neg", jnp.negative)

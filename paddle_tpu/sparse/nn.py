@@ -1,0 +1,44 @@
+"""paddle.sparse.nn (reference: python/paddle/sparse/nn/) — layer wrappers
+over the sparse functional ops."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu
+
+        return relu(x)
+
+
+class Softmax(Layer):
+    """Row-wise softmax over CSR values (reference sparse/nn/layer/activation
+    .py::Softmax, 2-D only)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1 only")
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..core.dispatch import primitive
+        from . import SparseCsrTensor
+
+        if not isinstance(x, SparseCsrTensor):
+            raise TypeError("sparse Softmax expects a SparseCsrTensor")
+        rows = np.asarray(x._row_ids())
+        n = x.shape[0]
+
+        def fn(vals):
+            row_max = jax.ops.segment_max(vals, rows, num_segments=n)
+            e = jnp.exp(vals - row_max[rows])
+            denom = jax.ops.segment_sum(e, rows, num_segments=n)
+            return e / denom[rows]
+
+        out_vals = primitive("sparse_softmax", fn, [x.values_t])
+        return SparseCsrTensor(x.crows_t, x.cols_t, out_vals, x.shape)

@@ -1,0 +1,113 @@
+"""fft/signal/sparse/linalg namespace tests (reference analogs: test/fft/,
+test/legacy_test/test_signal.py, test/legacy_test/test_sparse_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_fft_roundtrip_and_grad():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8).astype(np.float32),
+                         stop_gradient=False)
+    spec = paddle.fft.rfft(x)
+    assert spec.shape == [5]
+    back = paddle.fft.irfft(spec, n=8)
+    np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-4, atol=1e-5)
+    spec2 = paddle.fft.fft(paddle.to_tensor(np.random.randn(6).astype(np.complex64)))
+    rt = paddle.fft.ifft(spec2)
+    assert "complex" in str(rt.dtype)
+    # grad through rfft magnitude
+    mag = (paddle.fft.rfft(x).abs() ** 2).sum()
+    mag.backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+def test_fft_matches_numpy():
+    xn = np.random.RandomState(1).randn(4, 16).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.fft.fft2(paddle.to_tensor(xn)).numpy(), np.fft.fft2(xn),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        paddle.fft.fftshift(paddle.to_tensor(xn)).numpy(), np.fft.fftshift(xn),
+        rtol=1e-6,
+    )
+    freqs = paddle.fft.fftfreq(8, d=0.5)
+    np.testing.assert_allclose(freqs.numpy(), np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+
+
+def test_signal_stft_istft_roundtrip():
+    rs = np.random.RandomState(0)
+    sig = rs.randn(2, 512).astype(np.float32)
+    win = paddle.to_tensor(np.hanning(128).astype(np.float32))
+    spec = paddle.signal.stft(paddle.to_tensor(sig), n_fft=128, hop_length=32,
+                              window=win)
+    assert spec.shape[0] == 2 and spec.shape[1] == 65
+    rec = paddle.signal.istft(spec, n_fft=128, hop_length=32, window=win,
+                              length=512)
+    np.testing.assert_allclose(rec.numpy(), sig, rtol=1e-3, atol=1e-4)
+
+
+def test_signal_frame_overlap_add():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32))
+    framed = paddle.signal.frame(x, frame_length=4, hop_length=4)
+    assert framed.numpy().shape == (4, 4)
+    back = paddle.signal.overlap_add(framed, hop_length=4)
+    np.testing.assert_allclose(back.numpy(), x.numpy())
+
+
+def test_sparse_coo_roundtrip_and_matmul():
+    dense = np.array([[0, 2, 0], [3, 0, 0], [0, 0, 5]], np.float32)
+    coo = paddle.sparse.to_sparse_coo(paddle.to_tensor(dense))
+    assert coo.nnz == 3
+    np.testing.assert_allclose(coo.to_dense().numpy(), dense)
+
+    rhs = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    out = paddle.sparse.matmul(coo, paddle.to_tensor(rhs))
+    np.testing.assert_allclose(out.numpy(), dense @ rhs, rtol=1e-5)
+
+
+def test_sparse_matmul_grad():
+    dense = np.array([[0, 2.0], [3.0, 0]], np.float32)
+    coo = paddle.sparse.to_sparse_coo(paddle.to_tensor(dense))
+    coo.values_t.stop_gradient = False
+    rhs = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    paddle.sparse.matmul(coo, rhs).sum().backward()
+    np.testing.assert_allclose(coo.values_t.grad.numpy(), [2.0, 2.0])
+    assert rhs.grad is not None
+
+
+def test_sparse_csr_and_unary():
+    dense = np.array([[1, 0, -2], [0, 0, 4]], np.float32)
+    coo = paddle.sparse.to_sparse_coo(paddle.to_tensor(dense))
+    csr = coo.to_sparse_csr()
+    np.testing.assert_array_equal(csr.crows().numpy(), [0, 2, 3])
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+    np.testing.assert_allclose(
+        paddle.sparse.relu(coo).to_dense().numpy(), np.maximum(dense, 0)
+    )
+
+
+def test_sparse_nn_softmax():
+    dense = np.array([[1.0, 2.0, 0], [0, 3.0, 1.0]], np.float32)
+    coo = paddle.sparse.to_sparse_coo(paddle.to_tensor(dense))
+    csr = coo.to_sparse_csr()
+    sm = paddle.sparse.nn.Softmax()(csr)
+    out = sm.to_dense().numpy()
+    # softmax over stored values per row
+    r0 = np.exp([1.0, 2.0]) / np.exp([1.0, 2.0]).sum()
+    np.testing.assert_allclose(out[0, [0, 1]], r0, rtol=1e-5)
+    np.testing.assert_allclose(out[0, 2], 0.0)
+
+
+def test_linalg_namespace():
+    a = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    a = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.linalg.det(t).numpy(), np.linalg.det(a), rtol=1e-3)
+    L = paddle.linalg.cholesky(t)
+    np.testing.assert_allclose((L @ L.t()).numpy(), a, rtol=1e-3, atol=1e-3)
+    u, s, vh = (m.numpy() for m in paddle.linalg.svd(t))
+    np.testing.assert_allclose(u @ np.diag(s) @ vh, a, rtol=1e-3, atol=1e-3)
+    inv = paddle.linalg.inv(t)
+    np.testing.assert_allclose((t @ inv).numpy(), np.eye(4), atol=1e-4)
