@@ -113,7 +113,7 @@ class CompiledFunction:
         return self._run(entry, args, kwargs)
 
     # ------------------------------------------------------------------ build
-    def _build(self, key, args, kwargs):
+    def _discover(self, args, kwargs) -> DiscoveryContext:
         ctx = DiscoveryContext()
         arg_leaves = [
             l
@@ -130,6 +130,36 @@ class CompiledFunction:
         finally:
             hooks.discovery = prev
             ctx.rollback()
+        return ctx
+
+    def _build(self, key, args, kwargs):
+        try:
+            ctx = self._discover(args, kwargs)
+        except jax.errors.JaxRuntimeError as e:
+            if "RESOURCE_EXHAUSTED" not in str(e):
+                raise
+            # The eager discovery run holds every intermediate live at the
+            # full batch shape. The cell SET does not depend on the batch
+            # size, so retry discovery on a batch-1 probe slice; the jit
+            # below still traces/compiles at the real shape, where XLA
+            # schedules within HBM.
+            get_logger().warning(
+                "discovery OOM for %s at full shape; retrying with batch-1 probe",
+                self.name,
+            )
+            import gc
+
+            gc.collect()
+            probe_args, probe_kwargs = jax.tree_util.tree_map(
+                lambda l: (
+                    Tensor(l._value[:1], stop_gradient=l.stop_gradient)
+                    if isinstance(l, Tensor) and l.ndim >= 1 and l.shape[0] > 1
+                    else l
+                ),
+                (args, kwargs),
+                is_leaf=lambda x: isinstance(x, Tensor),
+            )
+            ctx = self._discover(probe_args, probe_kwargs)
 
         cells: List[Tensor] = list(ctx.cells.values())
         fn = self.fn

@@ -204,3 +204,38 @@ class TestSaveLoad:
         back = paddle.load(os.path.join(d, "obj.pd"))
         np.testing.assert_allclose(back["a"].numpy(), np.ones(2))
         assert back["nested"][1]["x"] == 5 and back["s"] == "text"
+
+
+def test_discovery_oom_probe_fallback(monkeypatch):
+    """Discovery OOM at full shape falls back to a batch-1 probe and still
+    compiles/updates state correctly at the real shape."""
+    import jax
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.jit.functionalize import CompiledFunction
+
+    paddle.seed(0)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=model.parameters())
+    step = TrainStep(model=model, optimizer=opt,
+                     loss_fn=lambda x: (model(x) ** 2).mean())
+
+    real_discover = CompiledFunction._discover
+    calls = {"n": 0}
+
+    def flaky(self, args, kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise jax.errors.JaxRuntimeError("RESOURCE_EXHAUSTED: fake OOM")
+        return real_discover(self, args, kwargs)
+
+    monkeypatch.setattr(CompiledFunction, "_discover", flaky)
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    first = float(step(x).numpy())
+    assert calls["n"] == 2  # full-shape attempt + probe retry
+    for _ in range(5):
+        last = float(step(x).numpy())
+    assert last < first  # optimizer state discovered via the probe persists
+    assert step.fallback_reason is None
