@@ -6,6 +6,16 @@ fleet examples built from fleet/layers/mpu/mp_layers.py. Here the language
 flagship (GPT) lives in-tree because it is the hybrid-parallel benchmark
 target (BASELINE.md: "Fleet hybrid-parallel GPT ... tokens/sec").
 """
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    bert_base,
+    bert_large,
+    bert_tiny,
+    ernie_base,
+)
 from .gpt import (  # noqa: F401
     GPTConfig,
     GPTModel,

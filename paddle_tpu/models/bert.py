@@ -1,0 +1,243 @@
+"""BERT/ERNIE encoder family — the fine-tune benchmark target.
+
+Reference capability: ERNIE-3.0/BERT-base fine-tune step time is a headline
+baseline (BASELINE.md row 2). The reference builds these from
+python/paddle/nn/layer/transformer.py (TransformerEncoder) in PaddleNLP;
+here the family is in-tree like GPT. ERNIE shares BERT's architecture
+(token+position+segment embeddings, post-LN encoder, pooler) with its own
+pretraining data/objectives, so `ernie_base` is a preset of the same trunk.
+
+TPU-first notes: [B, S, H, D] attention layout through the same flash
+attention path as GPT; `tensor_parallel=True` swaps projections for mp-axis
+sharded mpu layers; the whole fine-tune step (encoder + classifier head +
+AdamW) compiles to one XLA program via TrainStep.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.layers import Layer
+from ..ops import creation, manipulation
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    tensor_parallel: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _init(config):
+    return nn.ParamAttr(initializer=Normal(mean=0.0, std=config.initializer_range))
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        if config.tensor_parallel:
+            from ..distributed.fleet.mpu import VocabParallelEmbedding
+
+            self.word_embeddings = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size, weight_attr=_init(config))
+        else:
+            self.word_embeddings = nn.Embedding(
+                config.vocab_size, config.hidden_size, weight_attr=_init(config))
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size, weight_attr=_init(config))
+        self.token_type_embeddings = nn.Embedding(
+            config.type_vocab_size, config.hidden_size, weight_attr=_init(config))
+        self.layer_norm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape[0], input_ids.shape[-1]
+        if position_ids is None:
+            position_ids = manipulation.expand(
+                manipulation.unsqueeze(creation.arange(0, s, dtype="int64"), 0), [b, s])
+        if token_type_ids is None:
+            token_type_ids = creation.zeros([b, s], dtype="int64")
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(Layer):
+    """Fused-qkv bidirectional attention with an additive padding mask."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        if config.tensor_parallel:
+            from ..distributed.fleet.mpu import ColumnParallelLinear, RowParallelLinear
+
+            self.qkv = ColumnParallelLinear(h, 3 * h, weight_attr=_init(config),
+                                            has_bias=True, gather_output=False)
+            self.out = RowParallelLinear(h, h, weight_attr=_init(config),
+                                         has_bias=True, input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(h, 3 * h, weight_attr=_init(config))
+            self.out = nn.Linear(h, h, weight_attr=_init(config))
+
+    def forward(self, x, attention_mask=None):
+        cfg = self.config
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)
+        heads = qkv.shape[-1] // (3 * cfg.head_dim)
+        qkv = manipulation.reshape(qkv, [b, s, heads, 3, cfg.head_dim])
+        q, k, v = qkv[:, :, :, 0, :], qkv[:, :, :, 1, :], qkv[:, :, :, 2, :]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask, is_causal=False,
+            dropout_p=cfg.attention_probs_dropout_prob, training=self.training)
+        out = manipulation.reshape(out, [b, s, heads * cfg.head_dim])
+        return self.out(out)
+
+
+class BertLayer(Layer):
+    """Post-LN block (original BERT ordering)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        h, ffn = config.hidden_size, config.intermediate_size
+        if config.tensor_parallel:
+            from ..distributed.fleet.mpu import ColumnParallelLinear, RowParallelLinear
+
+            self.fc1 = ColumnParallelLinear(h, ffn, weight_attr=_init(config),
+                                            has_bias=True, gather_output=False)
+            self.fc2 = RowParallelLinear(ffn, h, weight_attr=_init(config),
+                                         has_bias=True, input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(h, ffn, weight_attr=_init(config))
+            self.fc2 = nn.Linear(ffn, h, weight_attr=_init(config))
+        self.ffn_norm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        h = self.attention(x, attention_mask)
+        x = self.attn_norm(x + self.dropout(h))
+        h = self.fc2(F.gelu(self.fc1(x)))
+        return self.ffn_norm(x + self.dropout(h))
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size,
+                               weight_attr=_init(config))
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    """Trunk: embeddings → post-LN encoder stack → pooler.
+    Returns (sequence_output, pooled_output) like the reference."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList(
+            [BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, S] 1=keep -> additive [B, 1, 1, S] broadcast over heads/queries
+            from ..ops import math as ops_math
+
+            m = manipulation.unsqueeze(attention_mask, [1, 2])
+            attention_mask = (1.0 - m.astype("float32")) * -1e4
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        return x, self.pooler(x)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes,
+                                    weight_attr=_init(config))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForPretraining(Layer):
+    """MLM head (tied decoder) + NSP head."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size,
+                                   weight_attr=_init(config))
+        self.transform_norm = nn.LayerNorm(config.hidden_size,
+                                           epsilon=config.layer_norm_eps)
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True)
+        self.nsp = nn.Linear(config.hidden_size, 2, weight_attr=_init(config))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, None, attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        w = self.bert.embeddings.word_embeddings.weight  # [V, H] tied decoder
+        logits = F.linear(h, manipulation.transpose(w, [1, 0])) + self.decoder_bias
+        return logits, self.nsp(pooled)
+
+
+# ---------------------------------------------------------------- presets
+
+def bert_tiny(**overrides) -> BertConfig:
+    base = dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=128,
+                max_position_embeddings=128,
+                hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+def bert_base(**overrides) -> BertConfig:
+    return BertConfig(**overrides)
+
+
+def bert_large(**overrides) -> BertConfig:
+    base = dict(hidden_size=1024, num_hidden_layers=24, num_attention_heads=16,
+                intermediate_size=4096)
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+def ernie_base(**overrides) -> BertConfig:
+    """ERNIE-3.0-base: BERT-base trunk with ERNIE's vocab/type sizes
+    (reference BASELINE.md ERNIE fine-tune target)."""
+    base = dict(vocab_size=40000, type_vocab_size=4)
+    base.update(overrides)
+    return BertConfig(**base)
