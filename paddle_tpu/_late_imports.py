@@ -58,6 +58,13 @@ except ImportError:
     pass
 
 try:
+    from . import inference  # noqa: F401
+
+    __all__.append("inference")
+except ImportError:
+    pass
+
+try:
     from . import models  # noqa: F401
 
     __all__.append("models")

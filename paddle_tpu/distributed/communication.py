@@ -489,3 +489,26 @@ def wait(tensor, group=None, use_calc_stream=True):
 
 def get_backend(group=None):
     return "xla"
+
+
+# ---------------------------------------------------------------- watchdog
+# reference comm_task_manager.cc: every collective launch is registered with
+# the watchdog (no-op until enable_comm_watchdog is called)
+def _with_watchdog(fn, tag):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        from .utils import watchdog as _wd
+
+        _wd.maybe_watch(tag, out if out is not None else args[:1])
+        return out
+
+    return wrapped
+
+
+for _name in ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+              "broadcast", "reduce", "scatter"):
+    globals()[_name] = _with_watchdog(globals()[_name], _name)
+del _name

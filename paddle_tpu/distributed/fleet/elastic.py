@@ -1,0 +1,129 @@
+"""Elastic training manager (reference:
+python/paddle/distributed/fleet/elastic/manager.py:125 — ElasticManager
+registers nodes in etcd, heartbeats, watches the node set, and decides
+HOLD/RESTART/EXIT on change; the launcher relaunches workers accordingly).
+
+TPU-native: the registry rides the framework's own native TCPStore instead
+of etcd (one fewer external service; the store already exists for
+rendezvous). Each node owns a heartbeat key; `watch()` scans peers'
+timestamps and reports scale-in (stale peer) or completion. The launch CLI's
+--max_restarts covers single-node relaunch; multi-node orchestration reads
+these statuses.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from enum import Enum
+from typing import Optional
+
+from ...base.log import get_logger
+
+
+class ElasticStatus(Enum):
+    COMPLETED = 0
+    ERROR = 1
+    HOLD = 2
+    RESTART = 3
+    EXIT = 4
+
+
+class ElasticManager:
+    def __init__(self, rank: Optional[int] = None, world_size: Optional[int] = None,
+                 host: str = "127.0.0.1", port: int = 0, store=None,
+                 heartbeat_interval: float = 1.0, node_timeout: float = 10.0,
+                 job_id: str = "default"):
+        from ...native import TCPStore
+
+        self.rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self.world_size = world_size if world_size is not None else int(
+            os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.heartbeat_interval = heartbeat_interval
+        self.node_timeout = node_timeout
+        self.job_id = job_id
+        if store is not None:
+            self.store = store
+        else:
+            self.store = TCPStore(host, port, is_master=(self.rank == 0),
+                                  world_size=self.world_size)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._completed_key = f"elastic/{job_id}/completed"
+
+    # ------------------------------------------------------------ lifecycle
+    def _hb_key(self, rank: int) -> str:
+        return f"elastic/{self.job_id}/hb/{rank}"
+
+    def start(self):
+        """Register + start the heartbeat thread (reference manager.start)."""
+        self._beat()
+        self.store.add(f"elastic/{self.job_id}/joined", 1)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        self.store.set(self._hb_key(self.rank), str(time.time()))
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self._beat()
+            except Exception as e:
+                get_logger().warning("elastic heartbeat failed: %s", e)
+            self._stop.wait(self.heartbeat_interval)
+
+    def wait_all_joined(self, timeout: float = 60.0):
+        """Barrier on node registration."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            joined = int.from_bytes(self.store.get(f"elastic/{self.job_id}/joined")[:8],
+                                    "little")
+            if joined >= self.world_size:
+                return True
+            time.sleep(0.1)
+        return False
+
+    # ---------------------------------------------------------------- watch
+    def watch(self) -> ElasticStatus:
+        """One scan of the node set (reference manager.watch loop body)."""
+        if self._completed():
+            return ElasticStatus.COMPLETED
+        # hb keys only exist after registration; the store's GET blocks on
+        # missing keys, so gate the scan on the join counter
+        if self.store.add(f"elastic/{self.job_id}/joined", 0) < self.world_size:
+            return ElasticStatus.HOLD
+        now = time.time()
+        stale = []
+        for r in range(self.world_size):
+            if r == self.rank:
+                continue
+            ts = float(self.store.get(self._hb_key(r)).decode())
+            if now - ts > self.node_timeout:
+                stale.append(r)
+        if stale:
+            get_logger().warning("elastic: stale nodes %s -> RESTART", stale)
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def _completed(self) -> bool:
+        try:
+            # add(0) is an atomic read-or-create: unlike get, it never blocks
+            # on a missing key
+            done = self.store.add(self._completed_key + "/count", 0)
+            return done >= self.world_size
+        except Exception:
+            return False
+
+    def mark_completed(self):
+        self.store.add(self._completed_key + "/count", 1)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def exit(self, completed=True):
+        if completed:
+            self.mark_completed()
+        self.stop()

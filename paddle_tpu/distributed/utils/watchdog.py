@@ -1,0 +1,118 @@
+"""Communication watchdog (reference: paddle/phi/core/distributed/
+comm_task_manager.cc + nccl_comm_task.cc — async error polling / timeout
+detection for hung collectives).
+
+TPU-native: collectives are XLA ops on an async stream, so a "hung
+collective" shows up as a result buffer that never becomes ready. The
+watchdog tracks each collective's output array on a worker thread
+(block_until_ready) while a monitor thread flags tasks that exceed the
+timeout — logging the op tag and firing an optional handler, matching the
+reference's CommTaskManager error report + abort hook.
+
+Enable with `enable_comm_watchdog(timeout)`; the functional collectives in
+distributed.communication register their outputs automatically.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import jax
+
+from ...base.log import get_logger
+
+
+@dataclass
+class _Task:
+    tag: str
+    start: float
+    done: bool = False
+    seq: int = 0
+
+
+class CommTaskManager:
+    def __init__(self, timeout: float = 30.0,
+                 on_timeout: Optional[Callable[[str, float], None]] = None,
+                 poll_interval: float = 0.5):
+        self.timeout = timeout
+        self.on_timeout = on_timeout
+        self.poll_interval = poll_interval
+        self._tasks: List[_Task] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._seq = 0
+        self.timeouts: List[str] = []  # tags that exceeded the deadline
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor.start()
+
+    def watch(self, tag: str, values) -> None:
+        """Track async values of one collective launch."""
+        leaves = [v for v in jax.tree_util.tree_leaves(values) if hasattr(v, "block_until_ready")]
+        if not leaves:
+            return
+        with self._lock:
+            self._seq += 1
+            task = _Task(tag=tag, start=time.time(), seq=self._seq)
+            self._tasks.append(task)
+
+        def waiter():
+            try:
+                for leaf in leaves:
+                    leaf.block_until_ready()
+            except Exception as e:
+                get_logger().error("collective %s failed: %s", tag, e)
+            finally:
+                task.done = True
+
+        threading.Thread(target=waiter, daemon=True).start()
+
+    def _monitor_loop(self):
+        while not self._stop.wait(self.poll_interval):
+            now = time.time()
+            with self._lock:
+                pending = [t for t in self._tasks if not t.done]
+                self._tasks = pending
+                overdue = [t for t in pending if now - t.start > self.timeout]
+            for t in overdue:
+                age = now - t.start
+                get_logger().error(
+                    "comm watchdog: collective '%s' (seq %d) not complete after %.1fs",
+                    t.tag, t.seq, age)
+                self.timeouts.append(t.tag)
+                if self.on_timeout is not None:
+                    self.on_timeout(t.tag, age)
+                t.done = True  # report once
+
+    def shutdown(self):
+        self._stop.set()
+        self._monitor.join(timeout=5)
+
+
+_manager: Optional[CommTaskManager] = None
+
+
+def enable_comm_watchdog(timeout: float = 30.0, on_timeout=None) -> CommTaskManager:
+    global _manager
+    if _manager is not None:
+        _manager.shutdown()
+    _manager = CommTaskManager(timeout=timeout, on_timeout=on_timeout)
+    return _manager
+
+
+def disable_comm_watchdog():
+    global _manager
+    if _manager is not None:
+        _manager.shutdown()
+        _manager = None
+
+
+def maybe_watch(tag: str, out) -> None:
+    """Called by the functional collectives after each launch."""
+    if _manager is None:
+        return
+    values = jax.tree_util.tree_map(
+        lambda x: getattr(x, "_value", x), out,
+        is_leaf=lambda x: hasattr(x, "_value"))
+    _manager.watch(tag, values)

@@ -1,0 +1,162 @@
+"""paddle.inference parity (reference: AnalysisPredictor,
+paddle/fluid/inference/api/analysis_predictor.h:105, python wrapper
+python/paddle/inference/__init__.py).
+
+TPU-native: the saved model IS a compiled program (jit.save exports
+StableHLO), so the "analysis pass pipeline + engine offload" the reference
+runs at load time collapses into deserializing the exported module; XLA is
+the engine. Config's IR/memory-optim toggles are accepted as no-ops, and
+zero-copy handles map to device arrays (copy_from_cpu = host→HBM transfer,
+copy_to_cpu = fetch).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+    TPU = 4
+
+
+class Config:
+    """reference paddle.inference.Config: model path + engine knobs."""
+
+    def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._params_file = params_file
+        self._memory_optim = True
+        self._ir_optim = True
+        self._precision = PrecisionType.Float32
+
+    def set_prog_file(self, path: str):
+        self._prefix = path[: -len(".pdmodel")] if path.endswith(".pdmodel") else path
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self.set_prog_file(prog_file)
+        self._params_file = params_file
+
+    # engine knobs — XLA already performs these; kept for API parity
+    def enable_memory_optim(self, x=True):
+        self._memory_optim = x
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = x
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0, precision=None):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_tpu(self):
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def summary(self):
+        return f"Config(prefix={self._prefix})"
+
+
+class Tensor_:
+    """Zero-copy style IO handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name_ = name
+        self._value = None
+
+    def name(self):
+        return self.name_
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        import jax.numpy as jnp
+
+        self._value = jnp.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+
+class Predictor:
+    """reference paddle.inference.Predictor over a jit-exported program."""
+
+    def __init__(self, config: Config):
+        from ..jit.serialization import load as jit_load
+
+        self.config = config
+        if config._prefix is None:
+            raise ValueError("Config needs a model path prefix")
+        self._layer = jit_load(config._prefix)
+        meta = getattr(self._layer, "_meta", {})
+        n = int(meta.get("n_inputs", 1))
+        self._input_names = [f"x{i}" for i in range(n)]
+        self._inputs: Dict[str, Tensor_] = {name: Tensor_(name) for name in self._input_names}
+        self._outputs: List[Tensor_] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor_:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Feed → execute → stash outputs. With `inputs` given, returns the
+        output arrays directly (new-style API)."""
+        import jax
+
+        if inputs is not None:
+            for name, arr in zip(self._input_names, inputs):
+                self._inputs[name].copy_from_cpu(np.asarray(arr))
+        args = [self._inputs[n]._value for n in self._input_names]
+        out = self._layer(*args)
+        leaves = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda x: hasattr(x, "shape"))
+        self._outputs = []
+        for i, leaf in enumerate(leaves):
+            h = Tensor_(f"out{i}")
+            h._value = leaf._value if hasattr(leaf, "_value") else leaf
+            self._outputs.append(h)
+        if inputs is not None:
+            return [o.copy_to_cpu() for o in self._outputs]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return [o.name_ for o in self._outputs]
+
+    def get_output_handle(self, name: str) -> Tensor_:
+        for o in self._outputs:
+            if o.name_ == name:
+                return o
+        raise KeyError(name)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

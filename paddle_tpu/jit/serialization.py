@@ -30,7 +30,22 @@ def save(layer, path, input_spec=None, **configs):
     if input_spec is not None:
         from jax import export as jax_export
 
-        leaves = [unwrap(s) if isinstance(s, Tensor) else s for s in input_spec]
+        from ..base import dtype as dtype_mod
+
+        def _as_shaped(s):
+            if isinstance(s, Tensor):
+                return unwrap(s)
+            if hasattr(s, "shape") and hasattr(s, "dtype"):  # InputSpec
+                shape = list(s.shape)
+                if any(d is None for d in shape):
+                    raise ValueError(
+                        "jit.save requires concrete dims in InputSpec "
+                        f"(got {shape}); XLA export is static-shape"
+                    )
+                return jax.ShapeDtypeStruct(tuple(shape), dtype_mod.np_dtype(s.dtype))
+            return s
+
+        leaves = [_as_shaped(s) for s in input_spec]
         params = {k: v._value for k, v in state.items()}
 
         modes = [(l, l.training) for l in layer.sublayers(include_self=True)]
