@@ -1,0 +1,3 @@
+"""paddle.audio parity (reference: python/paddle/audio/ — functional
+weighting/window helpers + feature layers over the signal stft)."""
+from . import features, functional  # noqa: F401

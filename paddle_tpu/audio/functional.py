@@ -1,0 +1,117 @@
+"""Audio functional helpers (reference: python/paddle/audio/functional/
+functional.py — hz_to_mel :30, mel_to_hz, mel_frequencies,
+compute_fbank_matrix :168, create_dct :344, power_to_db :384; window
+functions in window.py)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not hasattr(freq, "__len__") and not isinstance(freq, Tensor)
+    f = np.asarray(freq.numpy() if isinstance(freq, Tensor) else freq, np.float32)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                       mel)
+    return float(mel) if scalar else (Tensor(mel) if isinstance(freq, Tensor) else mel)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not hasattr(mel, "__len__") and not isinstance(mel, Tensor)
+    m = np.asarray(mel.numpy() if isinstance(mel, Tensor) else mel, np.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    return float(hz) if scalar else (Tensor(hz) if isinstance(mel, Tensor) else hz)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False, dtype="float32"):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return Tensor(np.asarray(mel_to_hz(mels, htk), np.float32))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(np.float32))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None, htk=False,
+                         norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(
+        mel_to_hz(np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels + 2), htk))
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(weights.astype(np.float32))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(dct.astype(np.float32))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    from ..core.dispatch import primitive
+    import jax.numpy as jnp
+
+    def fn(x):
+        db = 10.0 * jnp.log10(jnp.maximum(amin, x))
+        db = db - 10.0 * jnp.log10(max(amin, ref_value))
+        if top_db is not None:
+            db = jnp.maximum(db, jnp.max(db) - top_db)
+        return db
+
+    return primitive("power_to_db", fn, [spect])
+
+
+def get_window(window: str, win_length: int, fftbins=True, dtype="float32"):
+    """(reference window.py::get_window)"""
+    n = win_length
+    x = np.arange(n, dtype=np.float64)
+    denom = n if fftbins else n - 1
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * x / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * x / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * x / denom)
+             + 0.08 * np.cos(4 * math.pi * x / denom))
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(np.float32))

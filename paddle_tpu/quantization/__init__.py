@@ -1,0 +1,11 @@
+"""paddle.quantization parity subset (reference: python/paddle/quantization/
+— QuantConfig, QAT quantize/convert, quanter factory; fake quanters in
+quanters/abs_max.py; quanted layers in nn/qat/).
+
+TPU note: int8 matmul on TPU rides the MXU via XLA's int8 dot support; QAT
+here simulates quantization with fake quant-dequant (straight-through
+estimator) so trained scales export to any int8 runtime.
+"""
+from .config import QuantConfig  # noqa: F401
+from .qat import QAT  # noqa: F401
+from .quanters import FakeQuanterWithAbsMax, FakeQuanterWithAbsMaxObserver  # noqa: F401

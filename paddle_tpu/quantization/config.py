@@ -1,0 +1,39 @@
+"""QuantConfig (reference: python/paddle/quantization/config.py — maps layer
+types/instances to (activation, weight) quanter factories)."""
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional, Type
+
+from ..nn.layer.layers import Layer
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global_activation = activation
+        self._global_weight = weight
+        self._type_configs: Dict[Type[Layer], dict] = {}
+        self._layer_configs: Dict[int, dict] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type_configs[t] = {"activation": activation, "weight": weight}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs[id(l)] = {"activation": activation, "weight": weight}
+
+    def _config_for(self, layer: Layer) -> Optional[dict]:
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._global_activation or self._global_weight:
+            return {"activation": self._global_activation, "weight": self._global_weight}
+        return None
+
+    def copy(self):
+        return copy.copy(self)

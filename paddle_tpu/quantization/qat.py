@@ -1,0 +1,109 @@
+"""QAT driver (reference: python/paddle/quantization/qat.py — QAT(config)
+.quantize(model) swaps quantizable layers for their fake-quanted twins,
+.convert() bakes the quant-dequant into inference form)."""
+from __future__ import annotations
+
+from .. import nn
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+
+
+class QuantedLinear(Layer):
+    """nn.Linear with fake-quanted input + weight (reference nn/qat/conv and
+    linear wrappers)."""
+
+    def __init__(self, layer: nn.Linear, cfg: dict):
+        super().__init__()
+        self._inner = layer
+        self.activation_quanter = cfg["activation"]() if cfg.get("activation") else None
+        self.weight_quanter = cfg["weight"]() if cfg.get("weight") else None
+
+    @property
+    def weight(self):
+        return self._inner.weight
+
+    @property
+    def bias(self):
+        return self._inner.bias
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self._inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self._inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer: nn.Conv2D, cfg: dict):
+        super().__init__()
+        self._inner = layer
+        self.activation_quanter = cfg["activation"]() if cfg.get("activation") else None
+        self.weight_quanter = cfg["weight"]() if cfg.get("weight") else None
+
+    @property
+    def weight(self):
+        return self._inner.weight
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        inner = self._inner
+        w = inner.weight
+        if self.weight_quanter is not None:
+            wq = self.weight_quanter(w)
+            saved = w._value
+            w._replace_value(wq._value)
+            try:
+                return inner(x)
+            finally:
+                w._replace_value(saved)
+        return inner(x)
+
+
+_QAT_MAP = {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}
+
+
+class QAT:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        self._swap(model)
+        return model
+
+    def _swap(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            cfg = self.config._config_for(sub)
+            cls = _QAT_MAP.get(type(sub))
+            if cfg is not None and cls is not None:
+                layer._sub_layers[name] = cls(sub, cfg)
+            else:
+                self._swap(sub)
+
+    def convert(self, model: Layer, inplace=False) -> Layer:
+        """Bake fake quant into the weights for inference export."""
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        self._bake(model)
+        return model
+
+    def _bake(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+                inner = sub._inner
+                if sub.weight_quanter is not None:
+                    wq = sub.weight_quanter(inner.weight)
+                    inner.weight.set_value(wq._value)
+                layer._sub_layers[name] = inner
+            else:
+                self._bake(sub)

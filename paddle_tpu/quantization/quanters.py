@@ -1,0 +1,84 @@
+"""Fake quanters (reference: python/paddle/quantization/quanters/abs_max.py
+FakeQuanterWithAbsMaxObserver — moving-average abs-max scale + quant-dequant
+with a straight-through gradient)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def _fake_quant(x, scale, bits):
+    """Quant-dequant with straight-through estimator."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    # STE: forward quantized value, backward identity
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class BaseQuanter(Layer):
+    pass
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Activation quanter: moving-average abs-max observer + fake quant."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32", name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self.scale = self.create_parameter([1], is_bias=True)
+        self.scale.stop_gradient = True
+        self._initialized = False
+
+    def forward(self, x):
+        rate = self.moving_rate
+        if self.training:
+            cur = float(jnp.max(jnp.abs(x._value))) if not isinstance(
+                x._value, jax.core.Tracer) else None
+            if cur is not None:
+                old = float(self.scale._value[0])
+                new = cur if not self._initialized else rate * old + (1 - rate) * cur
+                self.scale.set_value(jnp.asarray([new], jnp.float32))
+                self._initialized = True
+        bits = self.bit_length
+        return primitive(
+            "fake_quant_act",
+            lambda v, s: _fake_quant(v, s[0], bits),
+            [x, self.scale],
+        )
+
+    def scales(self) -> Tensor:
+        return self.scale
+
+    def quant_axis(self):
+        return None
+
+    def bit_length_(self):
+        return self.bit_length
+
+
+class FakeQuanterWithAbsMax(BaseQuanter):
+    """Weight quanter: per-tensor abs-max at each forward (reference
+    FakeQuanterWithAbsMax — weights need no moving average)."""
+
+    def __init__(self, bit_length=8, dtype="float32", name=None):
+        super().__init__()
+        self.bit_length = bit_length
+        self._last_scale = None
+
+    def forward(self, x):
+        bits = self.bit_length
+
+        def fn(v):
+            s = jnp.max(jnp.abs(v))
+            return _fake_quant(v, s, bits)
+
+        return primitive("fake_quant_weight", fn, [x])
+
+    def quant_axis(self):
+        return None

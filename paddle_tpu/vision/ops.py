@@ -1,0 +1,214 @@
+"""Detection ops (reference: python/paddle/vision/ops.py — nms :1684,
+roi_align :1175, box_coder :1004, yolo_box :367, plus the phi kernels they
+call).
+
+TPU-native shapes: everything is fixed-size masked math — NMS is the
+O(N^2) pairwise-IoU matrix + a lax.fori_loop greedy sweep (no dynamic
+shapes), roi_align is gather-based bilinear sampling — so all ops jit and
+batch cleanly on the MXU/VPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+
+
+def _iou_matrix(boxes):
+    """[N, 4] x1y1x2y2 -> [N, N] IoU."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None, name=None):
+    """Greedy NMS (reference ops.py::nms). Returns kept indices sorted by
+    score. With category_idxs, suppression is per category (batched NMS via
+    the coordinate-offset trick)."""
+
+    def fn(b, *rest):
+        n = b.shape[0]
+        s = rest[0] if scores is not None else jnp.arange(n, 0, -1, dtype=jnp.float32)
+        bb = b
+        if category_idxs is not None:
+            cats = rest[-1]
+            # offset boxes per category so cross-category IoU is 0
+            span = jnp.max(b) - jnp.min(b) + 1.0
+            bb = b + (cats.astype(b.dtype) * span)[:, None]
+        order = jnp.argsort(-s)
+        iou = _iou_matrix(bb)[order][:, order]
+
+        def body(i, keep):
+            # drop i if it overlaps any kept higher-scored box
+            earlier = jnp.arange(n) < i
+            sup = jnp.sum(jnp.where(earlier, (iou[i] > iou_threshold) & keep, False))
+            return keep.at[i].set(sup == 0)
+
+        keep = jax.lax.fori_loop(1, n, body, jnp.ones(n, bool))
+        kept_sorted = order[jnp.nonzero(keep, size=n, fill_value=-1)[0]]
+        count = jnp.sum(keep)
+        return kept_sorted, count
+
+    args = [boxes] + ([scores] if scores is not None else []) + (
+        [category_idxs] if category_idxs is not None else [])
+    kept, count = primitive("nms", fn, args, n_outputs=2)
+    import numpy as np
+
+    k = int(count.numpy())
+    if top_k is not None:
+        k = min(k, top_k)
+    out = kept[:k]
+    out.stop_gradient = True
+    return out
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference ops.py::roi_align): x [N,C,H,W], boxes [R,4]
+    per-image rois (x1,y1,x2,y2), boxes_num [N]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def fn(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        r = rois.shape[0]
+        # map each roi to its image index
+        ends = jnp.cumsum(rois_num)
+        img_idx = jnp.sum(jnp.arange(r)[:, None] >= ends[None, :], axis=1)
+
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+        # sample grid: [R, ph, pw, ratio, ratio]
+        iy = (jnp.arange(ph)[None, :, None] * bin_h[:, None, None]
+              + y1[:, None, None]
+              + (jnp.arange(ratio)[None, None, :] + 0.5) * bin_h[:, None, None] / ratio)
+        ix = (jnp.arange(pw)[None, :, None] * bin_w[:, None, None]
+              + x1[:, None, None]
+              + (jnp.arange(ratio)[None, None, :] + 0.5) * bin_w[:, None, None] / ratio)
+
+        def bilinear(img, ys, xs):
+            # img [C, H, W]; ys/xs [...]: bilinear sample, zero padding
+            y0 = jnp.floor(ys)
+            x0 = jnp.floor(xs)
+            wy1 = ys - y0
+            wx1 = xs - x0
+            out = 0.0
+            for dy, wy in ((0, 1 - wy1), (1, wy1)):
+                for dx, wx in ((0, 1 - wx1), (1, wx1)):
+                    yy = (y0 + dy).astype(jnp.int32)
+                    xx = (x0 + dx).astype(jnp.int32)
+                    valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+                    yyc = jnp.clip(yy, 0, h - 1)
+                    xxc = jnp.clip(xx, 0, w - 1)
+                    out = out + jnp.where(valid, wy * wx, 0.0)[None] * img[:, yyc, xxc]
+            return out  # [C, ...]
+
+        def per_roi(ri):
+            img = feat[img_idx[ri]]
+            ys = iy[ri][:, None, :, None]  # [ph,1,ratio,1]
+            xs = ix[ri][None, :, None, :]  # [1,pw,1,ratio]
+            ys, xs = jnp.broadcast_arrays(ys, xs)
+            samp = bilinear(img, ys, xs)  # [C, ph, pw, ratio, ratio]
+            return samp.mean(axis=(-1, -2))
+
+        return jax.vmap(per_roi)(jnp.arange(r))
+
+    return primitive("roi_align", fn, [x, boxes, boxes_num])
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (reference ops.py::box_coder)."""
+
+    def fn(prior, var, target):
+        norm = 0.0 if box_normalized else 1.0
+        pw = prior[:, 2] - prior[:, 0] + norm
+        ph = prior[:, 3] - prior[:, 1] + norm
+        pcx = prior[:, 0] + pw * 0.5
+        pcy = prior[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = target[:, 2] - target[:, 0] + norm
+            th = target[:, 3] - target[:, 1] + norm
+            tcx = target[:, 0] + tw * 0.5
+            tcy = target[:, 1] + th * 0.5
+            out = jnp.stack([
+                (tcx - pcx) / pw, (tcy - pcy) / ph,
+                jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+            return out / var if var is not None else out
+        # decode_center_size; target [N, 4] deltas
+        d = target * var if var is not None else target
+        cx = d[:, 0] * pw + pcx
+        cy = d[:, 1] * ph + pcy
+        bw = jnp.exp(d[:, 2]) * pw
+        bh = jnp.exp(d[:, 3]) * ph
+        return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - norm, cy + bh * 0.5 - norm], axis=1)
+
+    args = [prior_box, prior_box_var, target_box] if prior_box_var is not None else None
+    if prior_box_var is None:
+        return primitive("box_coder", lambda p, t: fn(p, None, t), [prior_box, target_box])
+    return primitive("box_coder", fn, [prior_box, prior_box_var, target_box])
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0):
+    """Decode YOLO head predictions (reference ops.py::yolo_box).
+    x: [N, na*(5+class_num), H, W]; returns (boxes [N, H*W*na, 4],
+    scores [N, H*W*na, class_num])."""
+    na = len(anchors) // 2
+
+    def fn(pred, imgs):
+        n, _, h, w = pred.shape
+        p = pred.reshape(n, na, 5 + class_num, h, w)
+        grid_x = jnp.arange(w)[None, None, None, :]
+        grid_y = jnp.arange(h)[None, None, :, None]
+        sx = jax.nn.sigmoid(p[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+        sy = jax.nn.sigmoid(p[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        bx = (grid_x + sx) / w
+        by = (grid_y + sy) / h
+        aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        bw = jnp.exp(p[:, :, 2]) * aw / in_w
+        bh = jnp.exp(p[:, :, 3]) * ah / in_h
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        cls = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+        cls = jnp.where(conf[:, :, None] > conf_thresh, cls, 0.0)
+        img_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * img_w
+        y1 = (by - bh / 2) * img_h
+        x2 = (bx + bw / 2) * img_w
+        y2 = (by + bh / 2) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, img_w - 1)
+            y1 = jnp.clip(y1, 0, img_h - 1)
+            x2 = jnp.clip(x2, 0, img_w - 1)
+            y2 = jnp.clip(y2, 0, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+        scores = jnp.moveaxis(cls, 2, -1).reshape(n, -1, class_num)
+        return boxes, scores
+
+    return primitive("yolo_box", fn, [x, img_size], n_outputs=2)
