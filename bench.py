@@ -6,22 +6,52 @@ modes via BENCH_MODE env: `bert` (ERNIE/BERT-base fine-tune step time,
 BASELINE.md row 2), `resnet` (ResNet-50 images/sec, row 1).
 
 The reference publishes no absolute numbers (BASELINE.json `published: {}`),
-so `vs_baseline` is null until a measured reference lands.
+so `vs_baseline` is a measured pure-JAX control ratio for the GPT mode
+(framework tokens/sec ÷ hand-written pure-JAX tokens/sec on the same chip,
+same config) and null elsewhere.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Measured context (same chip, same config): a hand-written pure-JAX GPT-2
-step reaches ~69.6k tokens/sec vs this framework's ~67.9k (within ~3%).
+Robustness contract (VERDICT r1 item 1): the orchestrator ALWAYS prints one
+JSON line. The measurement runs in a subprocess; TPU backend-init failures
+are retried with backoff, then fall back to a CPU run, and only if that also
+fails does the line carry value=null plus a diagnostic.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
+where extras include achieved tflops_per_sec and mfu (vs the chip's bf16
+peak) for each mode.
 """
 from __future__ import annotations
 
 import json
+import math
 import os
+import subprocess
 import sys
 import time
+
+# bf16 peak TFLOP/s per chip, by device_kind substring (public specs).
+_PEAK_TFLOPS = [
+    ("v5litepod", 197.0), ("v5e", 197.0), ("v5p", 459.0),
+    ("v6e", 918.0), ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+]
+
+
+def _peak_tflops(device_kind: str):
+    dk = device_kind.lower()
+    for key, val in _PEAK_TFLOPS:
+        if key in dk:
+            return val
+    return None
 
 
 def _sync(loss):
     return float(loss.numpy() if hasattr(loss, "numpy") else loss)
+
+
+def _gpt_flops_per_step(batch, seq, layers, hidden, vocab):
+    """Megatron-LM training-step FLOPs (fwd+bwd, no recompute):
+    96*B*s*l*h^2 * (1 + s/(6h) + V/(16 l h))."""
+    return (96.0 * batch * seq * layers * hidden * hidden
+            * (1.0 + seq / (6.0 * hidden) + vocab / (16.0 * layers * hidden)))
 
 
 def bench_gpt(on_tpu):
@@ -64,7 +94,99 @@ def bench_gpt(on_tpu):
     _sync(loss)
     dt = time.perf_counter() - t0
     name = "gpt2_small" if on_tpu else "gpt_tiny"
-    return f"{name}_train_tokens_per_sec", batch * seq * steps / dt, "tokens/sec"
+    tok_s = batch * seq * steps / dt
+    flops = _gpt_flops_per_step(batch, seq, cfg.num_hidden_layers,
+                                cfg.hidden_size, cfg.vocab_size)
+    extras = {"tflops_per_sec": round(flops * steps / dt / 1e12, 2)}
+    if on_tpu:
+        extras["control"] = _pure_jax_gpt_control(cfg, batch, seq, steps)
+    return f"{name}_train_tokens_per_sec", tok_s, "tokens/sec", extras
+
+
+def _pure_jax_gpt_control(cfg, batch, seq, steps):
+    """Hand-written pure-JAX GPT-2 train step on the same config — the
+    'perfect framework overhead = 0' control the README ratio is based on.
+    Measured here so the number lands in the driver-captured JSON."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    L, H, V, NH = (cfg.num_hidden_layers, cfg.hidden_size, cfg.vocab_size,
+                   cfg.num_attention_heads)
+    D = H // NH
+    k = jax.random.PRNGKey(0)
+
+    def init():
+        ks = jax.random.split(k, 4 + 4 * L)
+        p = {
+            "wte": jax.random.normal(ks[0], (V, H), jnp.float32) * 0.02,
+            "wpe": jax.random.normal(ks[1], (cfg.max_position_embeddings, H)) * 0.02,
+            "lnf": (jnp.ones(H), jnp.zeros(H)),
+            "blocks": [],
+        }
+        for i in range(L):
+            b = {
+                "ln1": (jnp.ones(H), jnp.zeros(H)),
+                "qkv": (jax.random.normal(ks[4 + 4 * i], (H, 3 * H)) * 0.02, jnp.zeros(3 * H)),
+                "out": (jax.random.normal(ks[5 + 4 * i], (H, H)) * 0.02, jnp.zeros(H)),
+                "ln2": (jnp.ones(H), jnp.zeros(H)),
+                "fc1": (jax.random.normal(ks[6 + 4 * i], (H, 4 * H)) * 0.02, jnp.zeros(4 * H)),
+                "fc2": (jax.random.normal(ks[7 + 4 * i], (4 * H, H)) * 0.02, jnp.zeros(H)),
+            }
+            p["blocks"].append(b)
+        return p
+
+    def ln(x, g, b):
+        m = x.mean(-1, keepdims=True)
+        v = ((x - m) ** 2).mean(-1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(v + 1e-5) * g + b
+
+    def fwd(p, ids):
+        x = p["wte"][ids] + p["wpe"][: ids.shape[1]][None]
+        x = x.astype(jnp.bfloat16)
+        for b in p["blocks"]:
+            h = ln(x, b["ln1"][0], b["ln1"][1]).astype(jnp.bfloat16)
+            qkv = h @ b["qkv"][0].astype(jnp.bfloat16) + b["qkv"][1].astype(jnp.bfloat16)
+            q, kk, v = jnp.split(qkv.reshape(ids.shape[0], seq, NH, 3 * D), 3, -1)
+            att = jnp.einsum("bsnd,btnd->bnst", q, kk) / math.sqrt(D)
+            mask = jnp.tril(jnp.ones((seq, seq), bool))
+            att = jnp.where(mask, att, -1e9)
+            att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(jnp.bfloat16)
+            o = jnp.einsum("bnst,btnd->bsnd", att, v).reshape(ids.shape[0], seq, H)
+            x = x + o @ b["out"][0].astype(jnp.bfloat16) + b["out"][1].astype(jnp.bfloat16)
+            h = ln(x, b["ln2"][0], b["ln2"][1]).astype(jnp.bfloat16)
+            h = jax.nn.gelu(h @ b["fc1"][0].astype(jnp.bfloat16) + b["fc1"][1].astype(jnp.bfloat16))
+            x = x + h @ b["fc2"][0].astype(jnp.bfloat16) + b["fc2"][1].astype(jnp.bfloat16)
+        x = ln(x.astype(jnp.float32), p["lnf"][0], p["lnf"][1])
+        return x.astype(jnp.bfloat16) @ p["wte"].T.astype(jnp.bfloat16)
+
+    def loss_fn(p, ids):
+        logits = fwd(p, ids).astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits[:, :-1], -1)
+        tgt = ids[:, 1:]
+        return -jnp.take_along_axis(lp, tgt[..., None], -1).mean()
+
+    params = init()
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(p, s, ids):
+        l, g = jax.value_and_grad(loss_fn)(p, ids)
+        up, s = tx.update(g, s, p)
+        return jax.tree_util.tree_map(lambda a, u: a + u, p, up), s, l
+
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, V, (batch, seq)))
+    params, opt_state, l = train_step(params, opt_state, ids)
+    l.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, l = train_step(params, opt_state, ids)
+    l.block_until_ready()
+    dt = time.perf_counter() - t0
+    return {"pure_jax_tokens_per_sec": round(batch * seq * steps / dt, 2)}
 
 
 def bench_bert(on_tpu):
@@ -109,7 +231,10 @@ def bench_bert(on_tpu):
     _sync(loss)
     dt = time.perf_counter() - t0
     name = "ernie_base" if on_tpu else "bert_tiny"
-    return f"{name}_finetune_step_ms", dt / steps * 1000, "ms/step"
+    flops = _gpt_flops_per_step(batch, seq, cfg.num_hidden_layers,
+                                cfg.hidden_size, cfg.vocab_size)
+    extras = {"tflops_per_sec": round(flops * steps / dt / 1e12, 2)}
+    return f"{name}_finetune_step_ms", dt / steps * 1000, "ms/step", extras
 
 
 def bench_resnet(on_tpu):
@@ -152,27 +277,110 @@ def bench_resnet(on_tpu):
     _sync(loss)
     dt = time.perf_counter() - t0
     name = "resnet50" if on_tpu else "resnet18_smoke"
-    return f"{name}_train_images_per_sec", batch * steps / dt, "images/sec"
+    # ResNet-50 fwd = ~4.09 GFLOPs/image at 224²; train ≈ 3× fwd.
+    fwd_gf = 4.089 if on_tpu else 0.15
+    extras = {"tflops_per_sec": round(3 * fwd_gf * 1e9 * batch * steps / dt / 1e12, 3)}
+    return f"{name}_train_images_per_sec", batch * steps / dt, "images/sec", extras
 
 
-def main():
+def _worker():
+    """Runs in a subprocess: measure and print the JSON line."""
     import jax
 
-    platform = jax.devices()[0].platform
+    dev = jax.devices()[0]
+    platform = dev.platform
     on_tpu = platform == "tpu"
     mode = os.environ.get("BENCH_MODE", "gpt")
-    metric, value, unit = {
+    metric, value, unit, extras = {
         "gpt": bench_gpt, "bert": bench_bert, "resnet": bench_resnet,
     }[mode](on_tpu)
-    print(json.dumps({
+    peak = _peak_tflops(getattr(dev, "device_kind", "")) if on_tpu else None
+    mfu = (round(extras["tflops_per_sec"] / peak, 4)
+           if peak and "tflops_per_sec" in extras else None)
+    vs_baseline = None
+    ctrl = extras.get("control", {})
+    if "pure_jax_tokens_per_sec" in ctrl and ctrl["pure_jax_tokens_per_sec"]:
+        vs_baseline = round(value / ctrl["pure_jax_tokens_per_sec"], 4)
+    out = {
         "metric": f"{metric}_{platform}",
         "value": round(value, 2),
         "unit": unit,
-        "vs_baseline": None,
+        "vs_baseline": vs_baseline,
+        "device_kind": getattr(dev, "device_kind", platform),
+        "mfu": mfu,
+        **extras,
+    }
+    print(json.dumps(out), flush=True)
+
+
+def _spawn(env, timeout):
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    for line in reversed(res.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                return parsed, res
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return None, res
+
+
+def main():
+    """Orchestrator: run the worker in a subprocess, retry TPU init failures
+    with backoff, fall back to CPU, ALWAYS print exactly one JSON line."""
+    errors = []
+    base_env = dict(os.environ)
+    base_env["BENCH_WORKER"] = "1"
+
+    for attempt in range(3):
+        try:
+            parsed, res = _spawn(base_env, timeout=1800)
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {attempt}: timeout")
+            continue
+        if parsed is not None:
+            print(json.dumps(parsed))
+            return
+        errors.append(
+            f"attempt {attempt}: rc={res.returncode} "
+            f"stderr_tail={res.stderr.strip()[-300:]!r}")
+        time.sleep(5 * (attempt + 1))
+
+    # TPU path failed repeatedly — fall back to a real CPU measurement.
+    cpu_env = dict(base_env)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    cpu_env["PYTHONPATH"] = ":".join(
+        p for p in cpu_env.get("PYTHONPATH", "").split(":")
+        if p and ".axon_site" not in p)
+    try:
+        parsed, res = _spawn(cpu_env, timeout=900)
+        if parsed is not None:
+            parsed["note"] = "cpu_fallback_after_tpu_init_failure"
+            parsed["tpu_errors"] = errors[-2:]
+            print(json.dumps(parsed))
+            return
+        errors.append(f"cpu fallback: rc={res.returncode} "
+                      f"stderr_tail={res.stderr.strip()[-300:]!r}")
+    except subprocess.TimeoutExpired:
+        errors.append("cpu fallback: timeout")
+
+    print(json.dumps({
+        "metric": os.environ.get("BENCH_MODE", "gpt") + "_bench_failed",
+        "value": None, "unit": "n/a", "vs_baseline": None,
+        "errors": errors,
     }))
 
 
 if __name__ == "__main__":
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
-    main()
+    if os.environ.get("BENCH_WORKER") == "1":
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        _worker()
+    else:
+        main()

@@ -35,6 +35,11 @@ from .mpu import (  # noqa: F401
 from .context_parallel import ring_attention, ulysses_attention  # noqa: F401
 from .recompute import no_recompute, recompute, recompute_sequential  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .pipeline_schedules import (  # noqa: F401
+    PipelinedStack,
+    forward_backward_pipeline_1f1b,
+    forward_backward_pipeline_interleave,
+)
 
 meta_parallel = mpu  # submodule alias: fleet.meta_parallel.* layer surface
 

@@ -11,10 +11,11 @@ its stage's slice of the `pp` axis (NamedSharding over a stage-indexed
 dimension when weights stack homogeneously, or per-stage device_put
 otherwise). The schedule below runs the microbatch loop at the python level:
 losses/grads accumulate across microbatches inside one compiled step, giving
-1F1B's arithmetic (grad accumulation + sequential stage graph). XLA's
-latency-hiding scheduler overlaps the inter-stage transfers it inserts; an
-explicit ppermute ring schedule (zero-bubble analog for stacked homogeneous
-stages) is provided by paddle_tpu.distributed.fleet.pipeline_schedules.
+1F1B's arithmetic for heterogeneous stage graphs. For homogeneous stacks the
+REAL stage-parallel schedules (SPMD rotation 1F1B + interleaved VPP over
+shard_map + ppermute) live in
+paddle_tpu.distributed.fleet.pipeline_schedules.PipelinedStack — models
+embed it directly (e.g. GPTConfig.pipeline_parallel=True).
 """
 from __future__ import annotations
 

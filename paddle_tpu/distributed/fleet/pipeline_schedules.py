@@ -1,0 +1,291 @@
+"""Pipeline-parallel schedules: SPMD rotation (1F1B) + interleaved VPP.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py — 1F1B
+`forward_backward_pipeline` (:575), interleaved virtual-pipeline variant
+(:1174), FthenB (:2256) — multi-process schedules exchanging activations
+over P2pHelper batched isend/irecv (pp_utils/p2p_communication.py:651).
+
+TPU-native design — one compiled program, not N processes:
+
+The decoder stack's weights live stacked along a leading layer dim that is
+sharded over the `pp` mesh axis, so stage s's chunk of layers physically
+resides on stage s's devices. Inside a `shard_map` over `pp`, a tick loop
+(`lax.scan`) runs the classic rotation schedule: at tick t every stage
+applies its chunk to the activation it received last tick, then `ppermute`s
+the result one hop around the pp ring while stage 0 injects microbatch
+t and the last stage emits finished microbatches. All p stages compute
+simultaneously on different microbatches — real stage parallelism with the
+canonical bubble fraction (p-1)/(m·v + p - 1):
+
+- `num_chunks=1` — each device owns one contiguous chunk; the tick loop is
+  the 1F1B/FthenB pipeline (they differ only in memory policy here, which
+  `remat` controls: backward recomputes each chunk from its saved input
+  instead of storing per-layer activations — 1F1B's O(in-flight) activation
+  recipe).
+- `num_chunks=v>1` — Megatron interleaved VPP: device d owns chunks
+  {d, d+p, …, d+(v-1)p}; microbatches rotate around the ring v times,
+  entering in groups of p, which cuts the bubble from (p-1)/(m+p-1) to
+  (p-1)/(m·v+p-1).
+
+Backward is jax AD through the scan+ppermute: the cotangent pipeline runs
+the same rotation in reverse (ppermute transposes to the inverted ring),
+so the backward pass is stage-parallel too.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from .. import env as env_mod
+
+
+def chunk_permutation(num_layers: int, num_stages: int, num_chunks: int) -> List[int]:
+    """Layer order for stacking so a contiguous `pp` shard of the leading dim
+    holds device d's chunks {d, d+p, …, d+(v-1)p} in local slot order.
+
+    Returns perm with perm[new_position] = original_layer_index.
+    """
+    p, v = num_stages, num_chunks
+    k = num_layers // (p * v)
+    order = []
+    for d in range(p):
+        for j in range(v):
+            c = j * p + d
+            order.extend(range(c * k, (c + 1) * k))
+    return order
+
+
+def _solve_tick(t, d, *, p: int, v: int, m: int):
+    """Which (local chunk slot j, microbatch i) is active on device d at tick
+    t. Microbatch i enters chunk 0 at tick inj_i = (i//p)·v·p + i%p and moves
+    one chunk per tick; chunk c lives on device c % p. At most one (j, i) is
+    active per device per tick (groups of p microbatches are spaced v·p ticks
+    = exactly one group's worth of per-device work)."""
+    L = v * p
+    cs = d + p * jnp.arange(v)  # global chunk ids of my local slots
+    inj = t - cs  # required injection tick per slot
+    r = inj % L
+    q = inj // L
+    i_cand = q * p + r
+    valid = (inj >= 0) & (r < p) & (i_cand < m)
+    j = jnp.argmax(valid)  # the (at most one) active slot
+    c = cs[j]
+    i = jnp.clip(i_cand[j], 0, m - 1)
+    return j, c, i, jnp.any(valid)
+
+
+def pipeline_spmd(
+    apply_layer: Callable,
+    stacked_leaves: Sequence,
+    x,
+    *,
+    num_stages: int,
+    num_microbatches: int,
+    num_chunks: int = 1,
+    mesh=None,
+    axis: str = "pp",
+    batch_axis: Optional[str] = None,
+    remat: bool = True,
+):
+    """Run x [B, ...] through the pipelined layer stack; returns [B, ...].
+
+    apply_layer(leaves, x_local) -> y_local applies ONE layer given its
+    parameter leaves; stacked_leaves are arrays with leading dim num_layers
+    in `chunk_permutation` order, sharded over `axis`.
+    """
+    mesh = mesh or env_mod.get_mesh()
+    p, v, m = num_stages, num_chunks, num_microbatches
+    if p <= 1:
+        def body(xc, leaves):
+            return apply_layer(leaves, xc), None
+
+        return jax.lax.scan(body, x, stacked_leaves)[0]
+    if m % p != 0:
+        raise ValueError(f"num_microbatches {m} must divide by pp degree {p}")
+    b = x.shape[0]
+    if b % m != 0:
+        raise ValueError(f"batch {b} must divide into {m} microbatches")
+
+    def shard_body(x_mb, *leaves):
+        d = jax.lax.axis_index(axis)
+        n_local = leaves[0].shape[0]  # v·k layers on this device
+        k = n_local // v
+        local = [a.reshape((v, k) + a.shape[1:]) for a in leaves]
+
+        def apply_chunk(chunk_leaves, xc):
+            def one(xin, layer_leaves):
+                return apply_layer(layer_leaves, xin), None
+
+            return jax.lax.scan(one, xc, chunk_leaves)[0]
+
+        if remat:
+            apply_chunk = jax.checkpoint(
+                apply_chunk, policy=jax.checkpoint_policies.nothing_saveable)
+
+        T = m * v + p - 1
+        out0 = jnp.zeros(x_mb.shape, x_mb.dtype)
+        cur0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+
+        def tick(carry, t):
+            cur, out = carry
+            j, c, i, active = _solve_tick(t, d, p=p, v=v, m=m)
+            chunk = [jax.lax.dynamic_index_in_dim(a, j, 0, keepdims=False)
+                     for a in local]
+            x_in = jnp.where(
+                c == 0, jax.lax.dynamic_index_in_dim(x_mb, i, 0, keepdims=False), cur)
+            y = apply_chunk(chunk, x_in)
+            # emit finished microbatch (only ever true on the last stage)
+            done = active & (c == v * p - 1)
+            slot = jax.lax.dynamic_index_in_dim(out, i, 0, keepdims=False)
+            out = jax.lax.dynamic_update_index_in_dim(
+                out, jnp.where(done, y, slot), i, 0)
+            # one hop around the ring; receivers only read slots their
+            # schedule marks active, so inactive ticks carry harmless zeros
+            nxt = jax.lax.ppermute(
+                y, axis, [(s, (s + 1) % p) for s in range(p)])
+            return (nxt, out), None
+
+        (_, out), _ = jax.lax.scan(tick, (cur0, out0), jnp.arange(T))
+        # outputs were written on the last stage only; psum replicates them
+        # across the ring (the reference's "send outputs downstream" step)
+        return jax.lax.psum(out, axis)
+
+    mb_shape = (m, b // m) + tuple(x.shape[1:])
+    x_mb = x.reshape(mb_shape)
+    x_spec = P(None, batch_axis, *([None] * (len(mb_shape) - 2)))
+    leaf_specs = tuple(P(axis, *([None] * (a.ndim - 1))) for a in stacked_leaves)
+    shmap = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(x_spec,) + leaf_specs,
+        out_specs=x_spec,
+        check_vma=False,
+    )
+    if not isinstance(x_mb, jax.core.Tracer):
+        x_mb = jax.device_put(x_mb, NamedSharding(mesh, x_spec))
+    # the remat'd scan inside shard_map requires a jit scope (harmless when
+    # we are already under an outer trace — it inlines)
+    out = jax.jit(shmap)(x_mb, *stacked_leaves)
+    return out.reshape(x.shape)
+
+
+class PipelinedStack(Layer):
+    """A stack of homogeneous layers executed with the SPMD pipeline schedule
+    (the TPU analog of PipelineLayer's segment-per-stage + the reference's
+    1F1B/interleave runtime, pipeline_parallel.py:575/:1174).
+
+    Parameters are stored STACKED: one Parameter per template weight with a
+    leading num_layers dim in `chunk_permutation` order, sharded over `pp`.
+    The template layer instance is used purely as a tracing shell (its
+    forward defines the per-layer computation; dropout/stateful buffers are
+    not supported inside the stack — matches the reference's constraint that
+    pp stage boundaries carry activations only).
+    """
+
+    def __init__(self, layer_factory: Callable[[], Layer], num_layers: int,
+                 num_stages: Optional[int] = None, num_chunks: int = 1,
+                 num_microbatches: Optional[int] = None, remat: bool = True):
+        super().__init__()
+        degrees = env_mod.instance().axis_degrees or {}
+        self.num_stages = num_stages or max(degrees.get("pp", 1), 1)
+        self.num_chunks = num_chunks
+        self.num_layers = num_layers
+        self.remat = remat
+        if num_layers % (self.num_stages * num_chunks) != 0:
+            raise ValueError(
+                f"num_layers {num_layers} must divide by "
+                f"num_stages*num_chunks {self.num_stages * num_chunks}")
+        self.num_microbatches = num_microbatches or 2 * self.num_stages
+
+        self.template = layer_factory()
+        self._param_names = [n for n, _ in self.template.named_parameters()]
+        perm = chunk_permutation(num_layers, self.num_stages, self.num_chunks)
+        # independent per-layer inits, stacked in permuted order → exact
+        # numeric parity with a serial LayerList of the same factory
+        inits = [self.template] + [layer_factory() for _ in range(num_layers - 1)]
+        mesh = env_mod.get_mesh()
+        for name in self._param_names:
+            vals = [dict(l.named_parameters())[name]._value for l in inits]
+            stacked = jnp.stack([vals[orig] for orig in perm], 0)
+            if self.num_stages > 1 and mesh is not None and mesh.shape.get("pp", 1) == self.num_stages:
+                spec = P("pp", *([None] * (stacked.ndim - 1)))
+                stacked = jax.device_put(stacked, NamedSharding(mesh, spec))
+            pname = "stack_" + name.replace(".", "__")
+            param = self.create_parameter(
+                shape=list(stacked.shape), dtype=str(stacked.dtype))
+            param._replace_value(stacked)
+            setattr(self, pname, param)
+        self._stacked_names = ["stack_" + n.replace(".", "__") for n in self._param_names]
+
+    def _template_params(self):
+        named = dict(self.template.named_parameters())
+        return [named[n] for n in self._param_names]
+
+    def _apply_layer(self, leaves, xv):
+        """Functional application of the template with given leaf values —
+        runs the eager layer on tracers with the framework tape off (jax AD
+        differentiates through it; the tape sees only the outer primitive)."""
+        from ...base import global_state
+
+        tparams = self._template_params()
+        saved = [tp._value for tp in tparams]
+        for tp, lv in zip(tparams, leaves):
+            tp._value = lv
+        try:
+            with global_state.no_grad_guard():
+                out = self.template(Tensor(xv, stop_gradient=True))
+            return out._value if hasattr(out, "_value") else out
+        finally:
+            for tp, sv in zip(tparams, saved):
+                tp._value = sv
+
+    def forward(self, x):
+        stacked = [getattr(self, n) for n in self._stacked_names]
+        mesh = env_mod.get_mesh()
+        xv0 = x._value if hasattr(x, "_value") else x
+        dp = mesh.shape.get("dp", 1) if mesh is not None else 1
+        mb = xv0.shape[0] // self.num_microbatches if xv0.shape[0] % self.num_microbatches == 0 else 0
+        batch_axis = "dp" if (dp > 1 and mb and mb % dp == 0) else None
+
+        def fn(xv, *leaf_vals):
+            return pipeline_spmd(
+                self._apply_layer, list(leaf_vals), xv,
+                num_stages=self.num_stages,
+                num_microbatches=self.num_microbatches,
+                num_chunks=self.num_chunks,
+                batch_axis=batch_axis,
+                remat=self.remat,
+            )
+
+        return primitive("pipelined_stack", fn, [x, *stacked])
+
+    def layer_state_dict(self, idx: int):
+        """Un-permuted single-layer weights (for export / parity checks)."""
+        perm = chunk_permutation(self.num_layers, self.num_stages, self.num_chunks)
+        pos = perm.index(idx)
+        return {
+            n: getattr(self, sn)._value[pos]
+            for n, sn in zip(self._param_names, self._stacked_names)
+        }
+
+
+def forward_backward_pipeline_1f1b(stack: PipelinedStack, x):
+    """Reference-named entry (pipeline_parallel.py:575): rotation schedule,
+    one chunk per stage."""
+    assert stack.num_chunks == 1
+    return stack(x)
+
+
+def forward_backward_pipeline_interleave(stack: PipelinedStack, x):
+    """Reference-named entry (pipeline_parallel.py:1174): interleaved VPP."""
+    assert stack.num_chunks > 1
+    return stack(x)

@@ -16,11 +16,15 @@ from .....nn.initializer import XavierUniform
 
 
 def _gate_stats(probs, idx, num_experts):
-    """Load-balance loss terms from router probabilities + top-1 choices."""
+    """Load-balance loss terms from router probabilities + top-1 choices.
+
+    ce uses only the top-1 assignment (idx[:, 0]) so the per-expert token
+    fractions sum to 1 — the GShard/Switch formulation; summing over all k
+    routing slots would inflate the aux loss ~k×."""
     me = jnp.mean(probs, axis=0)  # [E] mean router prob
     ce = jnp.mean(
-        jnp.sum(jnp.eye(num_experts, dtype=probs.dtype)[idx], axis=1), axis=0
-    )  # [E] fraction of tokens routed (over all k slots)
+        jnp.eye(num_experts, dtype=probs.dtype)[idx[:, 0]], axis=0
+    )  # [E] fraction of tokens whose top-1 choice is e
     return num_experts * jnp.sum(me * ce)
 
 

@@ -50,6 +50,11 @@ class GPTConfig:
     # long-context: shard the sequence over the `sep` mesh axis and attend
     # via "ring" (ppermute blockwise) or "ulysses" (all_to_all head swap)
     context_parallel: str = ""
+    # pipeline parallel: run decoder blocks as a PipelinedStack (SPMD 1F1B
+    # rotation over the pp mesh axis; virtual_pp_degree>1 = interleaved VPP)
+    pipeline_parallel: bool = False
+    virtual_pp_degree: int = 1
+    pp_num_microbatches: int = 0  # 0 → 2 * pp degree
 
     def __post_init__(self):
         if self.intermediate_size == 0:
@@ -203,14 +208,32 @@ class GPTModel(Layer):
         super().__init__()
         self.config = config
         self.embeddings = GPTEmbeddings(config)
-        self.h = nn.LayerList([GPTDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        if config.pipeline_parallel:
+            from ..distributed.fleet.pipeline_schedules import PipelinedStack
+
+            if config.hidden_dropout_prob or config.attention_dropout_prob:
+                raise ValueError(
+                    "pipeline_parallel stack requires dropout=0 (stage "
+                    "boundaries carry activations only)")
+            self.h = PipelinedStack(
+                lambda: GPTDecoderLayer(config),
+                num_layers=config.num_hidden_layers,
+                num_chunks=max(config.virtual_pp_degree, 1),
+                num_microbatches=config.pp_num_microbatches or None,
+            )
+        else:
+            self.h = nn.LayerList(
+                [GPTDecoderLayer(config) for _ in range(config.num_hidden_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None):
         x = self.embeddings(input_ids, position_ids)
         x = _seq_constrain(x, self.config)
-        for block in self.h:
-            x = block(x)
+        if self.config.pipeline_parallel:
+            x = self.h(x)
+        else:
+            for block in self.h:
+                x = block(x)
         return self.ln_f(x)
 
 
@@ -250,13 +273,20 @@ class GPTPretrainingCriterion(Layer):
             self._parallel_ce = None
 
     def forward(self, logits, labels):
-        from ..ops import math as ops_math
-
+        # Next-token shift: logits at position i predict token i+1. Callers
+        # pass the raw token ids as labels; the shift happens here so the
+        # objective is a real causal-LM loss, not a copy task.
         v = logits.shape[-1]
+        logits = logits[:, :-1, :]
+        labels = labels[:, 1:]
         flat = manipulation.reshape(logits, [-1, v])
         flat_labels = manipulation.reshape(labels, [-1])
-        loss = F.cross_entropy(flat, flat_labels, reduction="mean")
-        return loss
+        if self._parallel_ce is not None:
+            loss = self._parallel_ce(flat, flat_labels)
+            from ..ops import math as ops_math
+
+            return ops_math.mean(loss)
+        return F.cross_entropy(flat, flat_labels, reduction="mean")
 
 
 # ---------------------------------------------------------------- presets

@@ -30,11 +30,15 @@ def _load():
         lib.pt_store_client_connect.restype = ctypes.c_void_p
         lib.pt_store_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
         lib.pt_store_client_close.argtypes = [ctypes.c_void_p]
+        lib.pt_store_client_set_timeout.restype = None
+        lib.pt_store_client_set_timeout.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         for fn, args in [
             ("pt_store_set", [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64]),
-            ("pt_store_get", [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64]),
+            ("pt_store_get", [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                              ctypes.c_void_p, ctypes.c_int64]),
+            ("pt_store_last_payload", [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]),
             ("pt_store_add", [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]),
-            ("pt_store_wait", [ctypes.c_void_p, ctypes.c_char_p]),
+            ("pt_store_wait", [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]),
             ("pt_store_delete", [ctypes.c_void_p, ctypes.c_char_p]),
             ("pt_store_num_keys", [ctypes.c_void_p]),
         ]:
@@ -56,11 +60,18 @@ def _load():
     return _lib
 
 
+class StoreTimeoutError(TimeoutError):
+    """A blocking store op (get/wait) exceeded its deadline."""
+
+
 class TCPStore:
     """Reference-parity store API: TCPStore(host, port, is_master, world_size).
 
     The master rank hosts the server in-process; every rank (master included)
-    talks through a client connection.
+    talks through a client connection. Every blocking op carries a deadline
+    (`timeout` default, overridable per call): the server answers a timed-out
+    GET/WAIT with a distinct status so the stream stays in sync, and the
+    client socket carries an SO_RCVTIMEO backstop for a dead server.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, is_master: bool = False,
@@ -68,6 +79,7 @@ class TCPStore:
         lib = _load()
         self._server = None
         self.host = host
+        self.timeout = float(timeout)
         if is_master:
             self._server = lib.pt_store_server_start(port)
             if not self._server:
@@ -80,34 +92,66 @@ class TCPStore:
             if self._server:
                 lib.pt_store_server_stop(self._server)
             raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+        # socket backstop: a little beyond the op deadline so the server-side
+        # timed wait normally answers first
+        lib.pt_store_client_set_timeout(self._client, int((timeout + 10.0) * 1000))
+
+    def _check(self, op: str, st: int) -> int:
+        if st == -5:
+            raise StoreTimeoutError(f"TCPStore.{op} timed out after {self.timeout}s")
+        if st == -3:
+            # socket-level failure: the stream may be desynced — drop the
+            # connection so the next op reconnects cleanly
+            self._reconnect()
+            raise StoreTimeoutError(f"TCPStore.{op}: connection error/timeout")
+        if st < 0:
+            raise RuntimeError(f"TCPStore.{op} failed ({st})")
+        return st
+
+    def _reconnect(self):
+        lib = _load()
+        if self._client:
+            lib.pt_store_client_close(self._client)
+        self._client = lib.pt_store_client_connect(
+            self.host.encode(), self.port, int(self.timeout * 1000))
+        if self._client:
+            lib.pt_store_client_set_timeout(
+                self._client, int((self.timeout + 10.0) * 1000))
+
+    def _ms(self, timeout: Optional[float]) -> int:
+        return int((self.timeout if timeout is None else timeout) * 1000)
 
     def set(self, key: str, value) -> None:
         data = value if isinstance(value, bytes) else str(value).encode()
-        st = _load().pt_store_set(self._client, key.encode(), data, len(data))
-        if st < 0:
-            raise RuntimeError(f"TCPStore.set failed ({st})")
+        self._check("set", _load().pt_store_set(self._client, key.encode(), data, len(data)))
 
-    def get(self, key: str) -> bytes:
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
         lib = _load()
         buf = ctypes.create_string_buffer(1 << 20)
-        n = lib.pt_store_get(self._client, key.encode(), buf, len(buf))
-        if n < 0:
-            raise RuntimeError(f"TCPStore.get({key!r}) failed ({n})")
-        return buf.raw[:n]
+        n = lib.pt_store_get(self._client, key.encode(), self._ms(timeout),
+                             buf, len(buf))
+        self._check(f"get({key!r})", n)
+        if n <= len(buf):
+            return buf.raw[:n]
+        # value larger than the first buffer: refetch the stashed payload
+        big = ctypes.create_string_buffer(n)
+        m = lib.pt_store_last_payload(self._client, big, n)
+        if m != n:
+            raise RuntimeError(f"TCPStore.get({key!r}): payload refetch failed ({m} != {n})")
+        return big.raw[:n]
 
     def add(self, key: str, amount: int) -> int:
         n = _load().pt_store_add(self._client, key.encode(), int(amount))
         if n < 0 and n != int(amount):
-            raise RuntimeError(f"TCPStore.add failed ({n})")
+            self._check("add", n)
         return int(n)
 
-    def wait(self, keys) -> None:
+    def wait(self, keys, timeout: Optional[float] = None) -> None:
         if isinstance(keys, str):
             keys = [keys]
         for k in keys:
-            st = _load().pt_store_wait(self._client, k.encode())
-            if st < 0:
-                raise RuntimeError(f"TCPStore.wait({k!r}) failed ({st})")
+            st = _load().pt_store_wait(self._client, k.encode(), self._ms(timeout))
+            self._check(f"wait({k!r})", st)
 
     def delete_key(self, key: str) -> bool:
         return _load().pt_store_delete(self._client, key.encode()) > 0

@@ -14,7 +14,10 @@
 //   response: i64 status (<0 error) | u32 payload_len | payload
 // Commands: 1 SET, 2 GET (blocks until key exists or timeout), 3 ADD
 // (val = i64 delta; creates key at 0), 4 WAIT (key exists), 5 DELETE,
-// 6 NUMKEYS.
+// 6 NUMKEYS.  GET/WAIT carry an i64 timeout_ms in val (<=0 = wait forever);
+// a timed-out wait answers status -5 so the stream stays in sync.
+// Every blocking client op has a deadline: server-side timed waits plus a
+// client-socket SO_RCVTIMEO backstop for a dead server.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -22,6 +25,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -39,6 +43,7 @@ struct Server {
   int listen_fd = -1;
   std::thread accept_thread;
   std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;  // open handler sockets, guarded by mu
   std::mutex mu;
   std::condition_variable cv;
   std::map<std::string, std::vector<uint8_t>> data;
@@ -50,7 +55,7 @@ bool read_full(int fd, void* buf, size_t n) {
   auto* p = static_cast<uint8_t*>(buf);
   while (n > 0) {
     ssize_t r = ::recv(fd, p, n, 0);
-    if (r <= 0) return false;
+    if (r <= 0) return false;  // EOF, error, or SO_RCVTIMEO expiry
     p += r;
     n -= static_cast<size_t>(r);
   }
@@ -83,6 +88,19 @@ void handle_conn(Server* s, int fd) {
 
     int64_t status = 0;
     std::vector<uint8_t> payload;
+    auto wait_deadline = [&](std::unique_lock<std::mutex>& lk) -> bool {
+      // true = key present; false = timed out or stopping
+      int64_t timeout_ms = 0;
+      if (vlen == 8) std::memcpy(&timeout_ms, val.data(), 8);
+      auto pred = [&] { return s->stopping.load() || s->data.count(key); };
+      if (timeout_ms <= 0) {
+        s->cv.wait(lk, pred);
+      } else if (!s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                 pred)) {
+        return false;
+      }
+      return !s->stopping.load() && s->data.count(key) > 0;
+    };
     switch (cmd) {
       case 1: {  // SET
         std::lock_guard<std::mutex> lk(s->mu);
@@ -90,14 +108,13 @@ void handle_conn(Server* s, int fd) {
         s->cv.notify_all();
         break;
       }
-      case 2: {  // GET — block until present
+      case 2: {  // GET — block until present, timeout, or stop
         std::unique_lock<std::mutex> lk(s->mu);
-        s->cv.wait(lk, [&] { return s->stopping.load() || s->data.count(key); });
-        if (s->stopping.load()) {
-          status = -2;
-        } else {
+        if (wait_deadline(lk)) {
           payload = s->data[key];
           status = static_cast<int64_t>(payload.size());
+        } else {
+          status = s->stopping.load() ? -2 : -5;
         }
         break;
       }
@@ -120,8 +137,11 @@ void handle_conn(Server* s, int fd) {
       }
       case 4: {  // WAIT
         std::unique_lock<std::mutex> lk(s->mu);
-        s->cv.wait(lk, [&] { return s->stopping.load() || s->data.count(key); });
-        status = s->stopping.load() ? -2 : 0;
+        if (wait_deadline(lk)) {
+          status = 0;
+        } else {
+          status = s->stopping.load() ? -2 : -5;
+        }
         break;
       }
       case 5: {  // DELETE
@@ -141,11 +161,17 @@ void handle_conn(Server* s, int fd) {
     if (!write_full(fd, &status, 8) || !write_full(fd, &plen, 4)) break;
     if (plen && !write_full(fd, payload.data(), plen)) break;
   }
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    auto it = std::find(s->conn_fds.begin(), s->conn_fds.end(), fd);
+    if (it != s->conn_fds.end()) s->conn_fds.erase(it);
+  }
   ::close(fd);
 }
 
 struct Client {
   int fd = -1;
+  std::vector<uint8_t> pending;  // last response payload (for >cap refetch)
 };
 
 }  // namespace
@@ -179,6 +205,11 @@ void* pt_store_server_start(int port) {
       int fd = ::accept(s->listen_fd, nullptr, nullptr);
       if (fd < 0) break;  // listen_fd closed on stop
       std::lock_guard<std::mutex> lk(s->mu);
+      if (s->stopping.load()) {
+        ::close(fd);
+        break;
+      }
+      s->conn_fds.push_back(fd);
       s->conn_threads.emplace_back(handle_conn, s, fd);
     }
   });
@@ -197,13 +228,15 @@ void pt_store_server_stop(void* handle) {
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
-  std::vector<std::thread> conns;
+  // Wake handlers blocked in recv() by shutting their sockets down, then
+  // JOIN them (a detach here is a use-after-free: the handler still touches
+  // s->mu / s->data after `delete s`).
   {
     std::lock_guard<std::mutex> lk(s->mu);
-    conns.swap(s->conn_threads);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
   }
-  for (auto& t : conns)
-    if (t.joinable()) t.detach();  // blocked clients hold these; sockets are dead
+  for (auto& t : s->conn_threads)
+    if (t.joinable()) t.join();
   delete s;
 }
 
@@ -229,6 +262,17 @@ void* pt_store_client_connect(const char* host, int port, int timeout_ms) {
   }
 }
 
+// Socket-level deadline backstop: if the server process is gone mid-request,
+// recv() returns after this instead of blocking forever. 0 disables.
+void pt_store_client_set_timeout(void* h, int64_t timeout_ms) {
+  auto* c = static_cast<Client*>(h);
+  timeval tv{};
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  ::setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(c->fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
 static int64_t request(Client* c, uint8_t cmd, const char* key, const void* val,
                        uint32_t vlen, void* out, int64_t out_cap) {
   uint32_t klen = static_cast<uint32_t>(std::strlen(key));
@@ -239,11 +283,13 @@ static int64_t request(Client* c, uint8_t cmd, const char* key, const void* val,
   int64_t status;
   uint32_t plen;
   if (!read_full(c->fd, &status, 8) || !read_full(c->fd, &plen, 4)) return -3;
+  c->pending.clear();
   if (plen) {
-    std::vector<uint8_t> payload(plen);
-    if (!read_full(c->fd, payload.data(), plen)) return -3;
-    if (out && out_cap >= static_cast<int64_t>(plen))
-      std::memcpy(out, payload.data(), plen);
+    c->pending.resize(plen);
+    if (!read_full(c->fd, c->pending.data(), plen)) return -3;
+    if (out && out_cap > 0)
+      std::memcpy(out, c->pending.data(),
+                  std::min<int64_t>(out_cap, static_cast<int64_t>(plen)));
   }
   return status;
 }
@@ -253,8 +299,20 @@ int64_t pt_store_set(void* h, const char* key, const void* data, int64_t len) {
                  nullptr, 0);
 }
 
-int64_t pt_store_get(void* h, const char* key, void* out, int64_t cap) {
-  return request(static_cast<Client*>(h), 2, key, nullptr, 0, out, cap);
+// Returns the FULL value size (may exceed cap — then call
+// pt_store_last_payload with a bigger buffer), or <0 on error
+// (-5 timeout, -2 server stopping, -3 socket error).
+int64_t pt_store_get(void* h, const char* key, int64_t timeout_ms, void* out,
+                     int64_t cap) {
+  return request(static_cast<Client*>(h), 2, key, &timeout_ms, 8, out, cap);
+}
+
+// Copy the last response payload (use after a truncated get).
+int64_t pt_store_last_payload(void* h, void* out, int64_t cap) {
+  auto* c = static_cast<Client*>(h);
+  int64_t n = static_cast<int64_t>(c->pending.size());
+  if (out && cap >= n && n > 0) std::memcpy(out, c->pending.data(), n);
+  return n;
 }
 
 int64_t pt_store_add(void* h, const char* key, int64_t delta) {
@@ -263,8 +321,8 @@ int64_t pt_store_add(void* h, const char* key, int64_t delta) {
   return st == 8 ? result : st < 0 ? st : -1;
 }
 
-int64_t pt_store_wait(void* h, const char* key) {
-  return request(static_cast<Client*>(h), 4, key, nullptr, 0, nullptr, 0);
+int64_t pt_store_wait(void* h, const char* key, int64_t timeout_ms) {
+  return request(static_cast<Client*>(h), 4, key, &timeout_ms, 8, nullptr, 0);
 }
 
 int64_t pt_store_delete(void* h, const char* key) {

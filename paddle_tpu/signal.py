@@ -11,15 +11,16 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
     """Slice into overlapping frames (reference signal.py::frame)."""
 
     def fn(v):
-        n = v.shape[axis]
+        a = axis % v.ndim  # normalize so destination math works for axis>=0
+        n = v.shape[a]
         n_frames = 1 + (n - frame_length) // hop_length
         starts = jnp.arange(n_frames) * hop_length
         idx = starts[:, None] + jnp.arange(frame_length)[None, :]
-        moved = jnp.moveaxis(v, axis, -1)
+        moved = jnp.moveaxis(v, a, -1)
         framed = moved[..., idx]  # [..., n_frames, frame_length]
         # reference layout: frame_length before n_frames on the chosen axis
         framed = jnp.swapaxes(framed, -1, -2)
-        return jnp.moveaxis(framed, (-2, -1), (axis - 1, axis) if axis != -1 else (-2, -1))
+        return jnp.moveaxis(framed, (-2, -1), (a, a + 1))
 
     return primitive("frame", fn, [x])
 

@@ -56,6 +56,15 @@ def test_signal_frame_overlap_add():
     np.testing.assert_allclose(back.numpy(), x.numpy())
 
 
+def test_signal_frame_axis0():
+    # non-negative axis: (frame_length, n_frames) pair lands AT the axis
+    x = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(16, 2))
+    framed = paddle.signal.frame(x, frame_length=4, hop_length=4, axis=0)
+    assert framed.numpy().shape == (4, 4, 2)
+    # frame i along n_frames = x[i*hop : i*hop+fl]
+    np.testing.assert_allclose(framed.numpy()[:, 1, :], x.numpy()[4:8, :])
+
+
 def test_sparse_coo_roundtrip_and_matmul():
     dense = np.array([[0, 2, 0], [3, 0, 0], [0, 0, 5]], np.float32)
     coo = paddle.sparse.to_sparse_coo(paddle.to_tensor(dense))

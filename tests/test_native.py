@@ -32,6 +32,35 @@ def test_tcp_store_set_get_add_wait():
         master.close()
 
 
+def test_tcp_store_large_value_roundtrip():
+    # values past the client's 1MB first buffer must survive (refetch path)
+    master = TCPStore(is_master=True, timeout=10.0)
+    try:
+        big = os.urandom((1 << 20) + 12345)
+        master.set("big", big)
+        assert master.get("big") == big
+    finally:
+        master.close()
+
+
+def test_tcp_store_get_wait_timeout():
+    from paddle_tpu.native import StoreTimeoutError
+
+    master = TCPStore(is_master=True, timeout=10.0)
+    try:
+        t0 = time.time()
+        with pytest.raises(StoreTimeoutError):
+            master.get("never-set", timeout=0.3)
+        with pytest.raises(StoreTimeoutError):
+            master.wait("never-set", timeout=0.3)
+        assert time.time() - t0 < 5.0
+        # the connection stays usable after a timed-out wait
+        master.set("k", b"v")
+        assert master.get("k") == b"v"
+    finally:
+        master.close()
+
+
 def test_tcp_store_blocking_get_across_threads():
     master = TCPStore(is_master=True, timeout=10.0)
     client = TCPStore(port=master.port, timeout=10.0)
