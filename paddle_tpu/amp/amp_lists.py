@@ -24,8 +24,15 @@ BLACK_LIST = {
 
 
 def white_list():
-    return set(WHITE_LIST)
+    """Hand-curated core list ∪ registry-derived classification over the
+    full YAML op table (ops/registry.py::amp_white) — the rebuild of the
+    reference's per-op AMP attributes in ops.yaml."""
+    from ..ops import registry
+
+    return set(WHITE_LIST) | registry.amp_white()
 
 
 def black_list():
-    return set(BLACK_LIST)
+    from ..ops import registry
+
+    return set(BLACK_LIST) | (registry.amp_black() - set(WHITE_LIST))

@@ -135,3 +135,48 @@ class GradScaler:
             if key in state:
                 v = state[key]
                 cell.set_value(v.numpy() if isinstance(v, Tensor) else np.asarray(v))
+
+
+def check_finite_and_unscale(xs, scale, name=None):
+    """Unscale grads by 1/scale; report whether any is non-finite
+    (reference op: check_finite_and_unscale_)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor, unwrap
+
+    s = jnp.asarray(unwrap(scale)).reshape(())
+    inv = 1.0 / s
+    found = jnp.zeros((), jnp.bool_)
+    outs = []
+    for x in xs:
+        v = jnp.asarray(unwrap(x)) * inv
+        found = found | ~jnp.all(jnp.isfinite(v))
+        outs.append(Tensor(v))
+    return outs, Tensor(found.reshape(1))
+
+
+def update_loss_scaling(xs, found_infinite, prev_loss_scaling, in_good_steps,
+                        in_bad_steps, incr_every_n_steps=1000,
+                        decr_every_n_nan_or_inf=2, incr_ratio=2.0,
+                        decr_ratio=0.5, stop_update=False, name=None):
+    """Dynamic loss-scale state machine (reference op: update_loss_scaling_)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor, unwrap
+
+    found = jnp.asarray(unwrap(found_infinite)).reshape(()).astype(jnp.bool_)
+    scale = jnp.asarray(unwrap(prev_loss_scaling)).reshape(())
+    good = jnp.asarray(unwrap(in_good_steps)).reshape(()).astype(jnp.int32)
+    bad = jnp.asarray(unwrap(in_bad_steps)).reshape(()).astype(jnp.int32)
+
+    bad_n = jnp.where(found, bad + 1, 0)
+    good_n = jnp.where(found, 0, good + 1)
+    scale_n = jnp.where(found & (bad_n >= decr_every_n_nan_or_inf),
+                        jnp.maximum(scale * decr_ratio, 1.0), scale)
+    bad_n = jnp.where(bad_n >= decr_every_n_nan_or_inf, 0, bad_n)
+    scale_n = jnp.where(~found & (good_n >= incr_every_n_steps),
+                        scale_n * incr_ratio, scale_n)
+    good_n = jnp.where(good_n >= incr_every_n_steps, 0, good_n)
+    outs = [Tensor(jnp.where(found, jnp.zeros_like(jnp.asarray(unwrap(x))),
+                             jnp.asarray(unwrap(x)))) for x in xs]
+    return outs, Tensor(scale_n.reshape(1)), Tensor(good_n.reshape(1)), Tensor(bad_n.reshape(1))

@@ -78,3 +78,17 @@ define_flag("log_level", 1, "framework log verbosity (higher = chattier)")
 define_flag("allocator_strategy", "xla", "memory allocator strategy (informational on TPU; XLA owns HBM)")
 define_flag("embedding_deterministic", False, "deterministic embedding grad accumulation")
 define_flag("cudnn_deterministic", False, "accepted for compat; XLA is deterministic by default")
+
+
+def enable_check_model_nan_inf():
+    """(reference op: enable_check_model_nan_inf)."""
+    set_flags({"check_nan_inf": True})
+
+
+def disable_check_model_nan_inf():
+    """(reference op: disable_check_model_nan_inf)."""
+    set_flags({"check_nan_inf": False})
+
+
+enable_check_nan_inf = enable_check_model_nan_inf
+disable_check_nan_inf = disable_check_model_nan_inf

@@ -512,3 +512,17 @@ for _name in ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
               "broadcast", "reduce", "scatter"):
     globals()[_name] = _with_watchdog(globals()[_name], _name)
 del _name
+
+
+def barrier(group: Optional[Group] = None):
+    """Synchronization barrier (reference: paddle.distributed.barrier).
+    Inside pjit a barrier is a no-op (SPMD programs are lockstep); in eager
+    multi-process mode it all-reduces a scalar and blocks on the result."""
+    t = Tensor(jnp.zeros((), jnp.float32))
+    out = all_reduce(t)
+    v = out._value if hasattr(out, "_value") else t._value
+    try:
+        jax.block_until_ready(v)
+    except Exception:
+        pass
+    return None

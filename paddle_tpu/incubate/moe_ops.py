@@ -1,0 +1,79 @@
+"""MoE routing utility ops (reference ops: limit_by_capacity,
+prune_gate_by_capacity, random_routing, assign_pos, number_count in
+/root/reference/paddle/phi/ops/yaml/ops.yaml; CUDA kernels under
+paddle/phi/kernels/gpu/*capacity*). TPU versions are sort/scan-based —
+static shapes, no atomics: capacity accounting uses a cumulative count per
+expert, which XLA lowers to an efficient segmented scan.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import passthrough
+from ..core.tensor import Tensor, unwrap
+
+
+def number_count(numbers, upper_range, name=None):
+    """Histogram of expert assignments (reference op: number_count)."""
+
+    def fn(v):
+        return jnp.bincount(v.reshape(-1), length=int(upper_range))
+
+    return passthrough("number_count", fn, [numbers])
+
+
+def limit_by_capacity(expert_count, capacity, n_worker=1, name=None):
+    """Clip per-(worker, expert) counts by per-expert capacity (reference op:
+    limit_by_capacity). expert_count (n_worker*n_expert,), capacity (n_expert,)."""
+
+    def fn(ec, cap):
+        ecw = ec.reshape(n_worker, -1)
+        # workers consume capacity in rank order: prefix sums per expert
+        prefix = jnp.cumsum(ecw, axis=0) - ecw
+        left = jnp.maximum(cap[None, :] - prefix, 0)
+        out = jnp.minimum(ecw, left)
+        return out.reshape(ec.shape)
+
+    return passthrough("limit_by_capacity", fn, [expert_count, capacity])
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert=None, n_worker=1,
+                           name=None):
+    """Mark tokens over expert capacity with -1 (reference op:
+    prune_gate_by_capacity)."""
+
+    def fn(gi, ec):
+        flat = gi.reshape(-1)
+        ne = int(ec.shape[0]) if n_expert is None else int(n_expert)
+        onehot = jax.nn.one_hot(flat, ne, dtype=jnp.int32)
+        order = jnp.cumsum(onehot, axis=0) - onehot  # tokens before me, same expert
+        my_rank = jnp.take_along_axis(order, flat[:, None], 1)[:, 0]
+        cap = ec.reshape(-1)[:ne]
+        keep = my_rank < cap[flat]
+        return jnp.where(keep, flat, -1).reshape(gi.shape)
+
+    return passthrough("prune_gate_by_capacity", fn, [gate_idx, expert_count])
+
+
+def random_routing(topk_idx, topk_value, prob, topk=2, name=None):
+    """Second-expert random drop (reference op: random_routing): keep the
+    2nd expert only when prob < 2*topk_value[..., 1]."""
+
+    def fn(idx, val, pr):
+        keep = pr < (2.0 * val[..., -1])
+        new_last = jnp.where(keep, idx[..., -1], -1)
+        return jnp.concatenate([idx[..., :-1], new_last[..., None]], -1)
+
+    return passthrough("random_routing", fn, [topk_idx, topk_value, prob])
+
+
+def assign_pos(x, cum_count, eff_num_len=None, name=None):
+    """Positions of tokens grouped by expert (reference op: assign_pos):
+    stable argsort by expert id, matching the cum_count layout."""
+
+    def fn(v, cc):
+        order = jnp.argsort(v.reshape(-1), stable=True)
+        return order
+
+    return passthrough("assign_pos", fn, [x, cum_count])

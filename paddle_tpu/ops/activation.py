@@ -160,3 +160,30 @@ def glu(x, axis=-1, name=None):
 
 def temperature_scaled_softmax(x, temperature=1.0, axis=-1, name=None):
     return primitive("temperature_scaled_softmax", lambda v: jax.nn.softmax(v / temperature, axis=axis), [x])
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU gate (reference op: swiglu in fused_ops.yaml — silu(x) * y,
+    or split-in-half when y is None). The LLaMA-family MLP gate."""
+
+    def fn_xy(xv, yv):
+        return jax.nn.silu(xv) * yv
+
+    def fn_x(xv):
+        a, b = jnp.split(xv, 2, axis=-1)
+        return jax.nn.silu(a) * b
+
+    if y is None:
+        return primitive("swiglu", fn_x, [x])
+    return primitive("swiglu", fn_xy, [x, y])
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    """x where x > threshold else value (reference op: thresholded_relu)."""
+    return primitive(
+        "thresholded_relu", lambda v: jnp.where(v > threshold, v, value), [x]
+    )
+
+
+def celu(x, alpha=1.0, name=None):
+    return primitive("celu", lambda v: jax.nn.celu(v, alpha), [x])

@@ -190,3 +190,13 @@ def one_hot(x, num_classes, name=None):
     import jax.nn as jnn
 
     return Tensor(jnn.one_hot(unwrap(x), num_classes, dtype=_dt(None)))
+
+
+def full_batch_size_like(input, shape, dtype, value, input_dim_idx=0, output_dim_idx=0, name=None):
+    """full() with one dim copied from a runtime tensor (reference op:
+    full_batch_size_like)."""
+    from ..core.tensor import unwrap as _unwrap
+
+    shape = list(shape)
+    shape[output_dim_idx] = _unwrap(input).shape[input_dim_idx]
+    return full(shape, value, dtype=dtype)

@@ -1,0 +1,192 @@
+"""Spatial-resample kernel tier (reference ops: bilinear_interp,
+bicubic_interp, nearest_interp, linear_interp, trilinear_interp, grid_sample,
+affine_grid, pad3d, temporal_shift, shuffle_channel, affine_channel in
+/root/reference/paddle/phi/ops/yaml/ops.yaml). The *_interp kernels share
+nn.functional.interpolate; grid_sample is a gather + bilinear blend that XLA
+vectorizes; all are static-shape so they tile cleanly on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import unwrap
+from ..nn.functional.common import channel_shuffle, interpolate
+
+
+def _interp(mode):
+    def op(x, output_size=None, size=None, scale_factor=None, scale=None,
+           align_corners=False, align_mode=1, data_format=None, name=None):
+        sz = output_size if output_size is not None else size
+        sf = scale_factor if scale_factor is not None else scale
+        return interpolate(x, size=sz, scale_factor=sf, mode=mode,
+                           align_corners=align_corners, align_mode=align_mode)
+
+    op.__name__ = f"{mode}_interp"
+    return op
+
+
+bilinear_interp = _interp("bilinear")
+nearest_interp = _interp("nearest")
+bicubic_interp = _interp("bicubic")
+linear_interp = _interp("linear")
+trilinear_interp = _interp("trilinear")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D/3D affine sampling grid from transform matrices (reference op:
+    affine_grid)."""
+    shape = [int(s) for s in (unwrap(out_shape) if not isinstance(out_shape, (list, tuple)) else out_shape)]
+
+    def base(n, align):
+        if align:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    def fn(th):
+        if len(shape) == 4:  # (N, C, H, W) -> grid (N, H, W, 2)
+            _, _, H, W = shape
+            xs = base(W, align_corners)
+            ys = base(H, align_corners)
+            gx, gy = jnp.meshgrid(xs, ys)
+            ones = jnp.ones_like(gx)
+            coords = jnp.stack([gx, gy, ones], -1).reshape(-1, 3)  # (HW, 3)
+            out = jnp.einsum("nij,pj->npi", th, coords)  # (N, HW, 2)
+            return out.reshape(th.shape[0], H, W, 2)
+        _, _, D, H, W = shape
+        xs, ys, zs = base(W, align_corners), base(H, align_corners), base(D, align_corners)
+        gz, gy, gx = jnp.meshgrid(zs, ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, gz, ones], -1).reshape(-1, 4)
+        out = jnp.einsum("nij,pj->npi", th, coords)
+        return out.reshape(th.shape[0], D, H, W, 3)
+
+    return primitive("affine_grid", fn, [theta])
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x at normalized grid locations (reference op: grid_sample).
+    2D NCHW inputs with (N, Hout, Wout, 2) grids."""
+
+    def unnormalize(coord, n):
+        if align_corners:
+            return (coord + 1.0) * 0.5 * (n - 1)
+        return ((coord + 1.0) * n - 1.0) * 0.5
+
+    def reflect(idx, n):
+        if n <= 1:
+            return jnp.zeros_like(idx)
+        period = 2.0 * (n - 1)
+        idx = jnp.abs(jnp.mod(idx, period))
+        return jnp.where(idx > (n - 1), period - idx, idx)
+
+    def fn(v, g):
+        N, C, H, W = v.shape
+        gx = unnormalize(g[..., 0], W)
+        gy = unnormalize(g[..., 1], H)
+        if padding_mode == "reflection":
+            gx, gy = reflect(gx, W), reflect(gy, H)
+        elif padding_mode == "border":
+            gx = jnp.clip(gx, 0, W - 1)
+            gy = jnp.clip(gy, 0, H - 1)
+
+        if mode == "nearest":
+            ix = jnp.round(gx).astype(jnp.int32)
+            iy = jnp.round(gy).astype(jnp.int32)
+            valid = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+            ixc, iyc = jnp.clip(ix, 0, W - 1), jnp.clip(iy, 0, H - 1)
+            out = jax.vmap(lambda vb, yb, xb: vb[:, yb, xb])(v, iyc, ixc)
+            return jnp.where(valid[:, None], out, 0.0)
+
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = gx - x0
+        wy = gy - y0
+
+        def gather(ix, iy):
+            valid = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+            ixc = jnp.clip(ix.astype(jnp.int32), 0, W - 1)
+            iyc = jnp.clip(iy.astype(jnp.int32), 0, H - 1)
+            val = jax.vmap(lambda vb, yb, xb: vb[:, yb, xb])(v, iyc, ixc)
+            return jnp.where(valid[:, None], val, 0.0)
+
+        v00 = gather(x0, y0)
+        v01 = gather(x0 + 1, y0)
+        v10 = gather(x0, y0 + 1)
+        v11 = gather(x0 + 1, y0 + 1)
+        wx = wx[:, None]
+        wy = wy[:, None]
+        return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+                + v10 * (1 - wx) * wy + v11 * wx * wy)
+
+    return primitive("grid_sample", fn, [x, grid])
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW", name=None):
+    """5-D padding (reference op: pad3d). paddings = [l, r, t, b, f, bk]."""
+    p = [int(i) for i in (paddings if isinstance(paddings, (list, tuple)) else unwrap(paddings))]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def fn(v):
+        if data_format == "NCDHW":
+            width = ((0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]))
+        else:
+            width = ((0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]), (0, 0))
+        if jmode == "constant":
+            return jnp.pad(v, width, mode="constant", constant_values=value)
+        return jnp.pad(v, width, mode=jmode)
+
+    return primitive("pad3d", fn, [x])
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM temporal channel shift (reference op: temporal_shift)."""
+
+    def fn(v):
+        NT, C, H, W = v.shape
+        n = NT // seg_num
+        v5 = v.reshape(n, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        fwd = jnp.roll(v5[:, :, :c1], 1, axis=1).at[:, 0, :].set(0.0)
+        bwd = jnp.roll(v5[:, :, c1:c2], -1, axis=1).at[:, -1, :].set(0.0)
+        rest = v5[:, :, c2:]
+        return jnp.concatenate([fwd, bwd, rest], 2).reshape(NT, C, H, W)
+
+    return primitive("temporal_shift", fn, [x])
+
+
+def shuffle_channel(x, group=1, name=None):
+    """Channel shuffle kernel (reference op: shuffle_channel)."""
+    return channel_shuffle(x, group)
+
+
+def affine_channel(x, scale, bias, data_format="NCHW", name=None):
+    """Per-channel affine (reference op: affine_channel)."""
+
+    def fn(v, s, b):
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        return v * s.reshape(shape) + b.reshape(shape)
+
+    return primitive("affine_channel", fn, [x, scale, bias])
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
+    """Sinusoidal position encoding add (reference op: add_position_encoding)."""
+
+    def fn(v):
+        B, T, D = v.shape
+        half = D // 2
+        pos = jnp.arange(T, dtype=v.dtype)[:, None]
+        freq = jnp.power(10000.0, -jnp.arange(half, dtype=v.dtype) / half)[None, :]
+        ang = pos * freq
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], -1)
+        if pe.shape[-1] < D:
+            pe = jnp.pad(pe, ((0, 0), (0, D - pe.shape[-1])))
+        return alpha * v + beta * pe[None]
+
+    return primitive("add_position_encoding", fn, [x])

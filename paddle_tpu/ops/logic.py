@@ -68,3 +68,19 @@ def in1d(x, test_x, assume_unique=False, invert=False, name=None):
 
 
 isin = in1d
+
+
+def check_numerics(x, op_type="", var_name="", message="", stack_height_limit=-1,
+                   check_nan_inf_level=0, name=None):
+    """Raise on NaN/Inf (reference op: check_numerics)."""
+    import numpy as np
+
+    from ..base.enforce import enforce
+    from ..core.tensor import unwrap as _unwrap
+
+    arr = np.asarray(_unwrap(x))
+    enforce(
+        bool(np.isfinite(arr).all()),
+        f"check_numerics failed for {var_name or 'tensor'} {message}: NaN/Inf found",
+    )
+    return x

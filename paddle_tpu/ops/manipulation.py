@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..base import dtype as dtype_mod
@@ -539,3 +540,183 @@ def strided_slice(x, axes, starts, ends, strides, name=None):
         idx[int(ax)] = _builtin_slice(int(unwrap(s)), int(unwrap(e)), int(unwrap(st)))
     t = tuple(idx)
     return primitive("strided_slice", lambda v: v[t], [x])
+
+
+def assign(x, output=None, name=None):
+    """Identity copy (reference ops: assign / assign_out_ / share_data /
+    memcpy_* — all identity semantics under XLA's functional arrays)."""
+    out = primitive("assign", lambda v: v + 0 if jnp.issubdtype(jnp.asarray(v).dtype, jnp.number) else v, [x]) \
+        if not isinstance(unwrap(x), (bool,)) else passthrough("assign", lambda v: v, [x])
+    if output is not None and isinstance(output, Tensor):
+        output._value = out._value
+        return output
+    return out
+
+
+def fill(x, value):
+    """Whole-tensor fill (reference: paddle.Tensor.fill_)."""
+    return primitive("fill", lambda v: jnp.full_like(v, value), [x])
+
+
+def fill_(x, value):
+    out = fill(x, value)
+    x._value = out._value
+    return x
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Fill the main diagonal (reference op: fill_diagonal_)."""
+
+    def fn(v):
+        n, m = v.shape[-2], v.shape[-1]
+        rows = jnp.arange(n)
+        cols = rows + offset
+        ok = (cols >= 0) & (cols < m)
+        r = jnp.where(ok, rows, 0)
+        c = jnp.where(ok, cols, 0)
+        diag_mask = jnp.zeros(v.shape[-2:], bool).at[r, c].set(ok)
+        return jnp.where(diag_mask, jnp.asarray(value, v.dtype), v)
+
+    return primitive("fill_diagonal", fn, [x])
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Fill diagonal of (dim1, dim2) planes from tensor y (reference op:
+    fill_diagonal_tensor). y's trailing dim is the diagonal length
+    min(n - max(-offset, 0), m - max(offset, 0))."""
+
+    def fn(v, yv):
+        vt = jnp.moveaxis(v, (dim1, dim2), (-2, -1))
+        n, m = vt.shape[-2], vt.shape[-1]
+        diag_len = min(n - max(-offset, 0), m - max(offset, 0))
+        rows = jnp.arange(n)
+        cols = rows + offset
+        ok = (cols >= 0) & (cols < m)
+        # position of row i along the diagonal (offset<0 starts lower)
+        didx = jnp.clip(rows - max(-offset, 0), 0, max(diag_len - 1, 0))
+        yb = jnp.broadcast_to(yv, vt.shape[:-2] + (diag_len,)).astype(v.dtype)
+        vals = jnp.take(yb, didx, axis=-1)  # (..., n)
+        # invalid entries scatter out of bounds and drop — clamping them
+        # into range would overwrite valid diagonal writes
+        r = jnp.where(ok, rows, n)
+        c = jnp.where(ok, cols, m)
+        mask = jnp.zeros((n, m), bool).at[r, c].set(True, mode="drop")
+        filled = jnp.zeros_like(vt).at[..., r, c].set(vals, mode="drop")
+        return jnp.moveaxis(jnp.where(mask, filled, vt), (-2, -1), (dim1, dim2))
+
+    return primitive("fill_diagonal_tensor", fn, [x, y])
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Unpack along axis into a list (reference op: unstack)."""
+    v = unwrap(x)
+    n = num if num is not None else v.shape[axis]
+    outs = primitive(
+        "unstack",
+        lambda v: tuple(jnp.squeeze(s, axis) for s in jnp.split(v, n, axis)),
+        [x],
+    )
+    return list(outs)
+
+
+def reverse(x, axis, name=None):
+    """Reverse along axes (reference op: reverse; alias of flip)."""
+    return flip(x, axis)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (reference op: as_strided). XLA has no raw pointers, so
+    the view is materialized with a gather over the flat buffer."""
+
+    def fn(v):
+        flat = v.reshape(-1)
+        idx = jnp.full((), offset, jnp.int32)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+        lin = sum((g * st for g, st in zip(grids, stride)), idx)
+        return flat[lin.reshape(-1)].reshape(shape)
+
+    return primitive("as_strided", fn, [x])
+
+
+def unfold_axis(x, axis, size, step, name=None):
+    """Sliding windows along one axis (reference op: tensor_unfold /
+    Tensor.unfold): window count replaces `axis`, window elements land in a
+    NEW LAST dim (paddle layout)."""
+
+    def fn(v):
+        n = v.shape[axis]
+        windows = jnp.stack(
+            [jnp.take(v, s + jnp.arange(size), axis=axis)
+             for s in range(0, n - size + 1, step)], axis=axis)
+        # window elements currently sit at axis+1; paddle appends them last
+        return jnp.moveaxis(windows, axis % v.ndim + 1, -1)
+
+    return primitive("tensor_unfold", fn, [x])
+
+
+def view_dtype(x, dtype, name=None):
+    """Bit-level reinterpret view (reference op: view_dtype / Tensor.view):
+    the last dim scales by the element-width ratio, matching paddle's
+    flat-buffer reinterpret semantics."""
+    jdt = np.dtype(dtype_mod.np_dtype(dtype))
+
+    def fn(v):
+        src = np.dtype(v.dtype)
+        if src.itemsize == jdt.itemsize:
+            return jax.lax.bitcast_convert_type(v, jdt)
+        if src.itemsize > jdt.itemsize:
+            # narrowing: bitcast appends a ratio axis; merge it into last dim
+            out = jax.lax.bitcast_convert_type(v, jdt)
+            return out.reshape(v.shape[:-1] + (-1,))
+        # widening: fold the ratio out of the last dim first
+        ratio = jdt.itemsize // src.itemsize
+        folded = v.reshape(v.shape[:-1] + (v.shape[-1] // ratio, ratio))
+        return jax.lax.bitcast_convert_type(folded, jdt)
+
+    return primitive("view_dtype", fn, [x])
+
+
+def view_shape(x, shape, name=None):
+    """Reshape view (reference op: view_shape)."""
+    return reshape(x, shape)
+
+
+def view_slice(x, begin_idx, end_idx, name=None):
+    """Leading-axis slice view (reference op: view_slice)."""
+    b, e = int(begin_idx), int(end_idx)
+    return primitive("view_slice", lambda v: v[b:e], [x])
+
+
+def set_value(x, value, name=None):
+    """Replace payload wholesale (reference op: set_value_with_tensor)."""
+
+    def fn(v, val):
+        return jnp.broadcast_to(jnp.asarray(val, v.dtype), v.shape)
+
+    return primitive("set_value", fn, [x, value])
+
+
+def coalesce_tensor(inputs, dtype=None, name=None):
+    """Pack a list of tensors into one flat fused buffer + return views
+    (reference op: coalesce_tensor, used by DDP fusion). On TPU, XLA already
+    fuses allreduce buffers; this provides the API: returns (fused, outs)."""
+    vs = [unwrap(t) for t in inputs]
+    flat = jnp.concatenate([v.reshape(-1) for v in vs])
+    outs = []
+    off = 0
+    for v in vs:
+        outs.append(Tensor(flat[off:off + v.size].reshape(v.shape)))
+        off += v.size
+    return Tensor(flat), outs
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Length→mask (reference op: sequence_mask)."""
+    jdt = dtype_mod.np_dtype(dtype)
+    v = unwrap(x)
+    m = int(maxlen) if maxlen is not None else int(np.asarray(v).max())
+    return passthrough(
+        "sequence_mask",
+        lambda v: (jnp.arange(m)[None, :] < v[..., None]).astype(jdt),
+        [x],
+    )

@@ -448,3 +448,62 @@ neg_ = _make_inplace(neg)
 abs_ = _make_inplace(abs)
 sin_ = _make_inplace(sin)
 cos_ = _make_inplace(cos)
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x down to target's shape (reference op: reduce_as)."""
+    tv = unwrap(target)
+
+    def fn(v):
+        tshape = tv.shape
+        ndiff = v.ndim - len(tshape)
+        axes = tuple(range(ndiff)) + tuple(
+            ndiff + i for i, (a, b) in enumerate(zip(v.shape[ndiff:], tshape)) if b == 1 and a != 1
+        )
+        out = jnp.sum(v, axis=axes, keepdims=False) if axes else v
+        return out.reshape(tshape)
+
+    from ..core.dispatch import primitive
+
+    return primitive("reduce_as", fn, [x])
+
+
+def mv(x, vec, name=None):
+    """Matrix–vector product (reference op: mv)."""
+    from ..core.dispatch import primitive
+
+    return primitive("mv", lambda a, b: a @ b, [x, vec])
+
+
+def inverse(x, name=None):
+    """Matrix inverse (reference op: inverse)."""
+    from ..core.dispatch import primitive
+
+    return primitive("inverse", jnp.linalg.inv, [x])
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clip per-slice p-norms along axis to max_norm (reference op: renorm)."""
+    from ..core.dispatch import primitive
+
+    def fn(v):
+        red = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=red, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+
+    return primitive("renorm", fn, [x])
+
+
+def squared_l2_norm(x, name=None):
+    """Sum of squares (reference op: squared_l2_norm, used by grad clip)."""
+    from ..core.dispatch import primitive
+
+    return primitive("squared_l2_norm", lambda v: jnp.sum(jnp.square(v)), [x])
+
+
+def l1_norm(x, name=None):
+    """Sum of absolute values (reference op: l1_norm)."""
+    from ..core.dispatch import primitive
+
+    return primitive("l1_norm", lambda v: jnp.sum(jnp.abs(v)), [x])

@@ -1,0 +1,431 @@
+"""Long-tail parity ops (reference ops: partial_concat, partial_sum,
+lu_unpack, spectral_norm, shuffle_batch, chunk_eval, class_center_sample,
+cvm, batch_fc, rank_attention, masked_multihead_attention_,
+lookup_table_dequant, merge_selected_rows, match_matrix_tensor, tdm_child,
+tdm_sampler, pyramid_hash, dgc, dgc_momentum, dgc_clip_by_norm, read_file,
+decode_jpeg in /root/reference/paddle/phi/ops/yaml/ops.yaml). Rare-path ops
+kept simple; device-friendly where the op is numeric, host-side numpy where
+the reference kernel is CPU-only (IO, sampling)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import passthrough, primitive
+from ..core.tensor import Tensor, unwrap
+
+
+def partial_concat(x, start_index=0, length=-1, name=None):
+    """Concat a column slice of each input (reference op: partial_concat)."""
+    tensors = x if isinstance(x, (list, tuple)) else [x]
+
+    def fn(*vs):
+        cols = []
+        for v in vs:
+            end = v.shape[1] if length < 0 else start_index + length
+            cols.append(v[:, start_index:end])
+        return jnp.concatenate(cols, -1)
+
+    return primitive("partial_concat", fn, list(tensors))
+
+
+def partial_sum(x, start_index=0, length=-1, name=None):
+    """Sum a column slice across inputs (reference op: partial_sum)."""
+    tensors = x if isinstance(x, (list, tuple)) else [x]
+
+    def fn(*vs):
+        out = None
+        for v in vs:
+            end = v.shape[1] if length < 0 else start_index + length
+            sl = v[:, start_index:end]
+            out = sl if out is None else out + sl
+        return out
+
+    return primitive("partial_sum", fn, list(tensors))
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack combined LU factors + pivots into P, L, U (reference op:
+    lu_unpack; y is the pivot vector from paddle.linalg.lu)."""
+
+    def fn(lu, piv):
+        m, n = lu.shape[-2], lu.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+        U = jnp.triu(lu[..., :k, :])
+        # pivots (1-indexed swap sequence) → permutation matrix
+        perm = jnp.arange(m)
+        pv = piv.astype(jnp.int32) - 1
+
+        def swap(p, i):
+            a, b = p[i], p[pv[i]]
+            return p.at[i].set(b).at[pv[i]].set(a), None
+
+        perm, _ = jax.lax.scan(swap, perm, jnp.arange(pv.shape[-1]))
+        P = jax.nn.one_hot(perm, m, dtype=lu.dtype).T
+        return P, L, U
+
+    return primitive("lu_unpack", fn, [x, y], n_outputs=3)
+
+
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization with power iteration (reference op:
+    spectral_norm)."""
+
+    def fn(w, uu, vv):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        for _ in range(max(power_iters, 0)):
+            vv = wm.T @ uu
+            vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+            uu = wm @ vv
+            uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+        sigma = uu @ wm @ vv
+        return w / jnp.maximum(sigma, eps)
+
+    return primitive("spectral_norm", fn, [weight, u, v])
+
+
+def shuffle_batch(x, seed=0, name=None):
+    """Random batch-dim permutation (reference op: shuffle_batch)."""
+
+    def fn(v):
+        perm = jax.random.permutation(jax.random.PRNGKey(int(seed)), v.shape[0])
+        return v[perm]
+
+    return passthrough("shuffle_batch", fn, [x])
+
+
+def chunk_eval(inference, label, seq_length=None, num_chunk_types=1,
+               chunk_scheme="IOB", excluded_chunk_types=(), name=None):
+    """Chunking precision/recall/F1 (reference op: chunk_eval) — host-side
+    numpy, mirroring the reference's CPU-only metric kernel."""
+    import numpy as np
+
+    def extract(tags):
+        # IOB: tag = chunk_type * 2 (+1 for I); -1/other = outside
+        chunks = []
+        start, ctype = None, None
+        for i, t in enumerate(list(tags) + [-1]):
+            t = int(t)
+            if t < 0 or t % 2 == 0:  # B or outside closes previous
+                if start is not None:
+                    chunks.append((start, i, ctype))
+                    start, ctype = None, None
+                if t >= 0 and t % 2 == 0:
+                    start, ctype = i, t // 2
+            else:  # I tag
+                if start is None or t // 2 != ctype:
+                    if start is not None:
+                        chunks.append((start, i, ctype))
+                    start, ctype = i, t // 2
+        return {c for c in chunks if c[2] not in excluded_chunk_types}
+
+    inf = np.asarray(unwrap(inference)).reshape(-1)
+    lab = np.asarray(unwrap(label)).reshape(-1)
+    pred, gold = extract(inf), extract(lab)
+    correct = len(pred & gold)
+    p = correct / max(len(pred), 1)
+    r = correct / max(len(gold), 1)
+    f1 = 2 * p * r / max(p + r, 1e-12)
+    mk = lambda a: Tensor(np.asarray([a], np.float32))
+    return (mk(p), mk(r), mk(f1), mk(float(len(pred))), mk(float(len(gold))),
+            mk(float(correct)))
+
+
+def class_center_sample(label, num_classes, num_samples, ring_id=0, rank=0,
+                        nranks=1, fix_seed=False, seed=0, name=None):
+    """Sample negative class centers + remap labels (reference op:
+    class_center_sample, used by PartialFC)."""
+    import numpy as np
+
+    lab = np.asarray(unwrap(label)).reshape(-1)
+    pos = np.unique(lab)
+    rs = np.random.RandomState(seed if fix_seed else None)
+    neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+    n_extra = max(0, min(num_samples, num_classes) - len(pos))
+    extra = rs.choice(neg_pool, n_extra, replace=False) if n_extra else np.zeros(0, lab.dtype)
+    sampled = np.concatenate([pos, extra]).astype(lab.dtype)
+    remap = {int(c): i for i, c in enumerate(sampled)}
+    new_lab = np.asarray([remap[int(c)] for c in lab], lab.dtype)
+    return Tensor(new_lab), Tensor(sampled)
+
+
+def cvm(x, cvm_in, use_cvm=True, name=None):
+    """Click-value-model feature op (reference op: cvm): first two columns
+    are show/click; log-transform or strip them."""
+
+    def fn(v, c):
+        show = jnp.log(jnp.maximum(c[:, 0:1], 0.0) + 1.0)
+        ctr = jnp.log(jnp.maximum(c[:, 1:2], 0.0) + 1.0) - jnp.log(
+            jnp.maximum(c[:, 0:1], 0.0) + 1.0)
+        if use_cvm:
+            return jnp.concatenate([show, ctr, v[:, 2:]], -1)
+        return v[:, 2:]
+
+    return primitive("cvm", fn, [x, cvm_in])
+
+
+def batch_fc(input, w, bias=None, name=None):
+    """Batched per-slot FC (reference op: batch_fc): input (slot, B, I),
+    w (slot, I, O)."""
+
+    def fn(v, wv, *b):
+        out = jnp.einsum("sbi,sio->sbo", v, wv)
+        return out + b[0] if b else out
+
+    args = [input, w] + ([bias] if bias is not None else [])
+    return primitive("batch_fc", fn, args)
+
+
+def rank_attention(x, rank_offset, rank_param, max_rank=3, max_size=0, name=None):
+    """Rank-aware attention for ranking models (reference op:
+    rank_attention): per-row rank selects a parameter block."""
+
+    def fn(v, ro, rp):
+        B, I = v.shape
+        # rank_offset[:, 0] is the row's rank id; parameter blocks stacked on axis 0
+        ranks = jnp.clip(ro[:, 0].astype(jnp.int32), 0, max_rank - 1)
+        blocks = rp.reshape(max_rank, I, -1)
+        sel = blocks[ranks]  # (B, I, O)
+        return jnp.einsum("bi,bio->bo", v, sel)
+
+    return primitive("rank_attention", fn, [x, rank_offset, rank_param])
+
+
+def masked_multihead_attention_(x, cache_kv, bias=None, src_mask=None,
+                                sequence_lengths=None, rotary_tensor=None,
+                                beam_cache_offset=None, seq_len=1,
+                                rotary_emb_dims=0, use_neox_rotary_style=False,
+                                compute_dtype="default", out_scale=-1.0,
+                                quant_round_type=1, quant_max_bound=127.0,
+                                quant_min_bound=-127.0, name=None):
+    """Single-token decoder attention with KV cache update (reference fused
+    op: masked_multihead_attention_). x (B, 3*H*D) packed qkv for the new
+    token; cache_kv (2, B, H, T, D)."""
+
+    def fn(xv, cache):
+        B = xv.shape[0]
+        _, _, H, T, D = cache.shape
+        qkv = xv.reshape(B, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        # append new kv at the first empty slot = current step (use T-1 roll)
+        new_k = jnp.concatenate([cache[0, :, :, 1:], k[:, :, None]], 2)
+        new_v = jnp.concatenate([cache[1, :, :, 1:], v[:, :, None]], 2)
+        logits = jnp.einsum("bhd,bhtd->bht", q, new_k) / jnp.sqrt(
+            jnp.asarray(D, xv.dtype))
+        probs = jax.nn.softmax(logits, -1)
+        out = jnp.einsum("bht,bhtd->bhd", probs, new_v)
+        return out.reshape(B, H * D), jnp.stack([new_k, new_v])
+
+    return primitive("masked_multihead_attention_", fn, [x, cache_kv],
+                     n_outputs=2)
+
+
+def lookup_table_dequant(w, ids, padding_idx=-1, name=None):
+    """Embedding lookup from an int8-quantized table whose first two floats
+    per row are (scale, shift) (reference op: lookup_table_dequant)."""
+
+    def fn(wv, idv):
+        meta = jax.lax.bitcast_convert_type(
+            wv[:, :8].reshape(wv.shape[0], 2, 4), jnp.float32).reshape(wv.shape[0], 2) \
+            if wv.dtype == jnp.uint8 else None
+        if meta is None:
+            # float table fallback: plain lookup
+            return wv[idv]
+        scale, shift = meta[:, 0], meta[:, 1]
+        q = wv[:, 8:].astype(jnp.float32)
+        deq = q * scale[:, None] / 255.0 + shift[:, None]
+        return deq[idv]
+
+    return primitive("lookup_table_dequant", fn, [w, ids])
+
+
+def merge_selected_rows(x, name=None):
+    """Deduplicate a (rows, values) sparse-gradient pair by summing
+    duplicate rows (reference op: merge_selected_rows over SelectedRows).
+    Input here is a tuple (indices, values, height)."""
+    idx, vals, height = x
+
+    def fn(iv, vv):
+        return jax.ops.segment_sum(vv, iv, int(height))
+
+    dense = primitive("merge_selected_rows", fn, [idx, vals])
+    nz = jnp.any(jnp.asarray(unwrap(dense)) != 0, axis=tuple(range(1, unwrap(dense).ndim)))
+    rows = jnp.nonzero(nz, size=nz.shape[0], fill_value=-1)[0]
+    return rows, dense
+
+
+def match_matrix_tensor(x, y, w, dim_t=1, name=None):
+    """Bilinear sequence-match tensor (reference op: match_matrix_tensor):
+    out[t, i, j] = x_i^T W_t y_j."""
+
+    def fn(xv, yv, wv):
+        return jnp.einsum("bld,tde,bre->btlr", xv, wv, yv)
+
+    return primitive("match_matrix_tensor", fn, [x, y, w])
+
+
+def tdm_child(x, tree_info, child_nums=2, name=None):
+    """Tree child lookup (reference op: tdm_child): tree_info rows =
+    [item_id, layer, parent, child0, child1, ...]."""
+
+    def fn(ids, info):
+        children = info[ids.reshape(-1), 3:3 + child_nums]
+        leaf_mask = (children == 0).astype(jnp.int32)
+        return children.reshape(ids.shape + (child_nums,)), \
+            (1 - leaf_mask).reshape(ids.shape + (child_nums,))
+
+    return passthrough("tdm_child", fn, [x, tree_info], attrs=None)
+
+
+def tdm_sampler(x, travel, layer, neg_samples_num_list=(1,), layer_offset=(0, 1),
+                output_positive=True, name=None):
+    """TDM layered negative sampling (reference op: tdm_sampler) — host-side
+    numpy (data-dependent sampling)."""
+    import numpy as np
+
+    ids = np.asarray(unwrap(x)).reshape(-1)
+    trav = np.asarray(unwrap(travel))
+    lay = np.asarray(unwrap(layer)).reshape(-1)
+    rs = np.random.RandomState(0)
+    outs, labels = [], []
+    for i, nneg in enumerate(neg_samples_num_list):
+        lo, hi = layer_offset[i], layer_offset[i + 1] if i + 1 < len(layer_offset) else len(lay)
+        layer_nodes = lay[lo:hi]
+        for item in ids:
+            pos = trav[item, i] if trav.ndim == 2 else trav[item]
+            row = [pos] if output_positive else []
+            lbl = [1] if output_positive else []
+            pool = layer_nodes[layer_nodes != pos]
+            neg = rs.choice(pool, min(nneg, len(pool)), replace=False) if len(pool) else []
+            row.extend(neg)
+            lbl.extend([0] * len(neg))
+            outs.append(row)
+            labels.append(lbl)
+    o = np.asarray(outs, np.int64)
+    l = np.asarray(labels, np.int64)
+    return Tensor(o), Tensor(l), Tensor(np.ones_like(o))
+
+
+def pyramid_hash(x, w, white_list=None, black_list=None, num_emb=8, space_len=None,
+                 pyramid_layer=2, rand_len=16, drop_out_percent=0, is_training=False,
+                 use_filter=False, white_list_len=0, black_list_len=0, seed=0,
+                 lr=1.0, distribute_update_vars="", name=None):
+    """Pyramid hash text embedding (reference op: pyramid_hash): hash each
+    n-gram (n=1..pyramid_layer) into the embedding table and sum."""
+    import numpy as np
+
+    ids = np.asarray(unwrap(x)).reshape(-1)
+    wv = unwrap(w)
+    space = wv.shape[0]
+
+    def ngram_hash(gram):
+        h = 0
+        for t in gram:
+            h = (h * 1000003 + int(t)) & 0x7FFFFFFF
+        return h % space
+
+    rows = []
+    for n in range(1, pyramid_layer + 1):
+        for i in range(len(ids) - n + 1):
+            rows.append(ngram_hash(ids[i:i + n]))
+    if not rows:
+        rows = [0]
+    idx = jnp.asarray(np.asarray(rows, np.int32))
+
+    def fn(wv_):
+        return jnp.sum(wv_[idx, :num_emb], 0, keepdims=True)
+
+    return primitive("pyramid_hash", fn, [w])
+
+
+# ---- deep gradient compression tier ----------------------------------------
+
+def dgc(u, v, grad, param, current_step, nranks=1, m=0.9, use_nesterov=False,
+        sparsity=(0.75,), rampup_begin_step=0.0, rampup_step=1.0,
+        regular_coeff=0.0, regular_type=0, name=None):
+    """Deep gradient compression (reference op: dgc): momentum correction +
+    top-k sparsification; returns (new_u, new_v, encoded_grad, k)."""
+
+    def fn(uv, vv, g, p):
+        if regular_type == 1:
+            g = g + regular_coeff * p
+        un = m * uv + g
+        vn = vv + un
+        flat = vn.reshape(-1)
+        step = float(jnp.asarray(unwrap(current_step)).reshape(()))
+        s = sparsity[min(len(sparsity) - 1,
+                         max(0, int((step - rampup_begin_step) / max(rampup_step, 1.0))))]
+        k = max(1, int(flat.shape[0] * (1.0 - s)))
+        topv, topi = jax.lax.top_k(jnp.abs(flat), k)
+        mask = jnp.zeros_like(flat, jnp.bool_).at[topi].set(True)
+        enc = jnp.where(mask, flat, 0.0).reshape(vn.shape)
+        vn_left = jnp.where(mask.reshape(vn.shape), 0.0, vn)
+        un_left = jnp.where(mask.reshape(vn.shape), 0.0, un)
+        return un_left, vn_left, enc
+
+    return passthrough("dgc", fn, [u, v, grad, param])
+
+
+def dgc_clip_by_norm(x, current_step, max_norm=1.0, rampup_begin_step=-1.0,
+                     name=None):
+    """Gradient clip that only activates after rampup (reference op:
+    dgc_clip_by_norm)."""
+
+    def fn(v, step):
+        norm = jnp.linalg.norm(v)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+        active = step.reshape(()) >= rampup_begin_step
+        return jnp.where(active, v * scale, v)
+
+    return primitive("dgc_clip_by_norm", fn, [x, current_step])
+
+
+def dgc_momentum(param, grad, velocity, learning_rate, master_param=None,
+                 current_step_tensor=None, nranks_tensor=None, mu=0.9,
+                 use_nesterov=False, rampup_begin_step=0.0, name=None):
+    """Momentum that switches to plain SGD before DGC rampup (reference op:
+    dgc_momentum)."""
+    from .optim_kernels import momentum_, sgd_
+
+    step = float(jnp.asarray(unwrap(current_step_tensor)).reshape(())) \
+        if current_step_tensor is not None else rampup_begin_step
+    if step < rampup_begin_step:
+        return sgd_(param, learning_rate, grad), velocity
+    return momentum_(param, grad, velocity, learning_rate, mu=mu,
+                     use_nesterov=use_nesterov)
+
+
+# ---- host IO ----------------------------------------------------------------
+
+def read_file(filename, name=None):
+    """Read raw bytes as a uint8 tensor (reference op: read_file)."""
+    import numpy as np
+
+    data = np.fromfile(filename, dtype=np.uint8)
+    return Tensor(data)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor (reference op: decode_jpeg). Uses Pillow
+    when present; raises a clear error otherwise (TPU images arrive via the
+    data pipeline in practice)."""
+    import io
+
+    import numpy as np
+
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg requires Pillow") from e
+
+    raw = bytes(np.asarray(unwrap(x)).astype(np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode not in ("unchanged", ""):
+        img = img.convert(mode.upper() if mode != "gray" else "L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)

@@ -1,0 +1,311 @@
+"""Functional optimizer-update kernels (reference ops: sgd_, momentum_,
+adam_, adamw_, adamax_, adagrad_, adadelta_, rmsprop_, lamb_, ftrl, nadam_,
+radam_, asgd_, rprop_, dpsgd, decayed_adagrad, merged_adam_, merged_momentum_,
+average_accumulates_ in /root/reference/paddle/phi/ops/yaml/ops.yaml).
+
+Each returns the updated state as new functional arrays (XLA donates buffers
+under jit, so "inplace" falls out of compilation rather than mutation).
+paddle_tpu.optimizer classes are the stateful wrappers over this tier.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import passthrough
+from ..core.tensor import unwrap
+
+
+def _v(x):
+    return None if x is None else jnp.asarray(unwrap(x))
+
+
+def _scalar(x, default=None):
+    if x is None:
+        return default
+    v = unwrap(x)
+    return jnp.asarray(v).reshape(()) if hasattr(v, "shape") else jnp.asarray(v)
+
+
+def sgd_(param, learning_rate, grad, master_param=None, multi_precision=False):
+    p, lr, g = _v(param), _scalar(learning_rate), _v(grad)
+    out = (p - lr * g).astype(p.dtype)
+    return passthrough("sgd_", lambda *_: out, [param])
+
+
+def momentum_(param, grad, velocity, learning_rate, master_param=None,
+              mu=0.9, use_nesterov=False, regularization_method="",
+              regularization_coeff=0.0, multi_precision=False, rescale_grad=1.0):
+    p, g, v, lr = _v(param), _v(grad), _v(velocity), _scalar(learning_rate)
+    g = g * rescale_grad
+    if regularization_method == "l2_decay":
+        g = g + regularization_coeff * p
+    v_new = mu * v + g
+    if use_nesterov:
+        p_new = p - lr * (g + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    return passthrough("momentum_", lambda *_: (p_new.astype(p.dtype), v_new), [param])
+
+
+def adam_(param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow,
+          master_param=None, skip_update=None, beta1=0.9, beta2=0.999,
+          epsilon=1e-8, lazy_mode=False, min_row_size_to_use_multithread=1000,
+          multi_precision=False, use_global_beta_pow=False, amsgrad=False,
+          moment2_max=None):
+    p, g, lr = _v(param), _v(grad), _scalar(learning_rate)
+    m1, m2 = _v(moment1), _v(moment2)
+    b1p, b2p = _v(beta1_pow), _v(beta2_pow)
+    m1n = beta1 * m1 + (1 - beta1) * g
+    m2n = beta2 * m2 + (1 - beta2) * g * g
+    b1n, b2n = b1p * beta1, b2p * beta2
+    mhat = m1n / (1 - b1n)
+    denom_m2 = m2n
+    extra = ()
+    if amsgrad and moment2_max is not None:
+        m2mx = jnp.maximum(_v(moment2_max), m2n)
+        denom_m2 = m2mx
+        extra = (m2mx,)
+    vhat = denom_m2 / (1 - b2n)
+    pn = p - lr * mhat / (jnp.sqrt(vhat) + epsilon)
+    outs = (pn.astype(p.dtype), m1n, m2n, b1n, b2n) + extra
+    return passthrough("adam_", lambda *_: outs, [param])
+
+
+def adamw_(param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow,
+           master_param=None, skip_update=None, beta1=0.9, beta2=0.999,
+           epsilon=1e-8, lr_ratio=1.0, coeff=0.01, with_decay=True,
+           lazy_mode=False, min_row_size_to_use_multithread=1000,
+           multi_precision=False, use_global_beta_pow=False):
+    p, g, lr = _v(param), _v(grad), _scalar(learning_rate)
+    m1, m2 = _v(moment1), _v(moment2)
+    b1p, b2p = _v(beta1_pow), _v(beta2_pow)
+    lr_eff = lr * lr_ratio
+    if with_decay:
+        p = p * (1.0 - lr_eff * coeff)
+    m1n = beta1 * m1 + (1 - beta1) * g
+    m2n = beta2 * m2 + (1 - beta2) * g * g
+    b1n, b2n = b1p * beta1, b2p * beta2
+    mhat = m1n / (1 - b1n)
+    vhat = m2n / (1 - b2n)
+    pn = p - lr_eff * mhat / (jnp.sqrt(vhat) + epsilon)
+    outs = (pn.astype(_v(param).dtype), m1n, m2n, b1n, b2n)
+    return passthrough("adamw_", lambda *_: outs, [param])
+
+
+def adamax_(param, grad, learning_rate, moment, inf_norm, beta1_pow,
+            master_param=None, beta1=0.9, beta2=0.999, epsilon=1e-8,
+            multi_precision=False):
+    p, g, lr = _v(param), _v(grad), _scalar(learning_rate)
+    m, u, b1p = _v(moment), _v(inf_norm), _v(beta1_pow)
+    mn = beta1 * m + (1 - beta1) * g
+    un = jnp.maximum(beta2 * u, jnp.abs(g))
+    pn = p - (lr / (1 - b1p * beta1)) * mn / (un + epsilon)
+    return passthrough("adamax_", lambda *_: (pn.astype(p.dtype), mn, un), [param])
+
+
+def adagrad_(param, grad, moment, learning_rate, master_param=None,
+             epsilon=1e-6, multi_precision=False):
+    p, g, m, lr = _v(param), _v(grad), _v(moment), _scalar(learning_rate)
+    mn = m + g * g
+    pn = p - lr * g / (jnp.sqrt(mn) + epsilon)
+    return passthrough("adagrad_", lambda *_: (pn.astype(p.dtype), mn), [param])
+
+
+def adadelta_(param, grad, avg_squared_grad, avg_squared_update,
+              learning_rate=None, master_param=None, rho=0.95, epsilon=1e-6,
+              multi_precision=False):
+    p, g = _v(param), _v(grad)
+    asg, asu = _v(avg_squared_grad), _v(avg_squared_update)
+    lr = _scalar(learning_rate, 1.0)
+    asgn = rho * asg + (1 - rho) * g * g
+    update = -jnp.sqrt((asu + epsilon) / (asgn + epsilon)) * g
+    asun = rho * asu + (1 - rho) * update * update
+    pn = p + lr * update
+    return passthrough("adadelta_", lambda *_: (pn.astype(p.dtype), asgn, asun), [param])
+
+
+def rmsprop_(param, mean_square, grad, moment, learning_rate,
+             mean_grad=None, master_param=None, epsilon=1e-10, decay=0.9,
+             momentum=0.0, centered=False, multi_precision=False):
+    p, ms, g, mom, lr = (_v(param), _v(mean_square), _v(grad), _v(moment),
+                         _scalar(learning_rate))
+    msn = decay * ms + (1 - decay) * g * g
+    if centered:
+        mg = _v(mean_grad)
+        mgn = decay * mg + (1 - decay) * g
+        denom = jnp.sqrt(msn - mgn * mgn + epsilon)
+    else:
+        mgn = None
+        denom = jnp.sqrt(msn + epsilon)
+    momn = momentum * mom + lr * g / denom
+    pn = p - momn
+    outs = (pn.astype(p.dtype), msn, momn) + ((mgn,) if centered else ())
+    return passthrough("rmsprop_", lambda *_: outs, [param])
+
+
+def lamb_(param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow,
+          master_param=None, skip_update=None, weight_decay=0.01, beta1=0.9,
+          beta2=0.999, epsilon=1e-6, always_adapt=False, multi_precision=False):
+    p, g, lr = _v(param), _v(grad), _scalar(learning_rate)
+    m1, m2 = _v(moment1), _v(moment2)
+    b1p, b2p = _v(beta1_pow), _v(beta2_pow)
+    m1n = beta1 * m1 + (1 - beta1) * g
+    m2n = beta2 * m2 + (1 - beta2) * g * g
+    b1n, b2n = b1p * beta1, b2p * beta2
+    mhat = m1n / (1 - b1n)
+    vhat = m2n / (1 - b2n)
+    r = mhat / (jnp.sqrt(vhat) + epsilon) + weight_decay * p
+    w_norm = jnp.linalg.norm(p)
+    r_norm = jnp.linalg.norm(r)
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    pn = p - lr * trust * r
+    return passthrough("lamb_", lambda *_: (pn.astype(p.dtype), m1n, m2n, b1n, b2n), [param])
+
+
+def ftrl(param, squared_accumulator, linear_accumulator, grad, learning_rate,
+         l1=0.0, l2=0.0, lr_power=-0.5):
+    p, sq, lin, g, lr = (_v(param), _v(squared_accumulator),
+                         _v(linear_accumulator), _v(grad), _scalar(learning_rate))
+    new_sq = sq + g * g
+    sigma = (new_sq ** -lr_power - sq ** -lr_power) / lr
+    new_lin = lin + g - sigma * p
+    quad = new_sq ** -lr_power / lr + 2 * l2
+    pn = jnp.where(jnp.abs(new_lin) > l1,
+                   (jnp.sign(new_lin) * l1 - new_lin) / quad, 0.0)
+    return passthrough("ftrl", lambda *_: (pn.astype(p.dtype), new_sq, new_lin), [param])
+
+
+def nadam_(param, grad, learning_rate, momentum_decay_pow, beta2_pow, mu_product,
+           moment1, moment2, master_param=None, beta1=0.9, beta2=0.999,
+           epsilon=1e-8, momentum_decay=0.004, multi_precision=False):
+    p, g, lr = _v(param), _v(grad), _scalar(learning_rate)
+    mdp, b2p, mup = _v(momentum_decay_pow), _v(beta2_pow), _v(mu_product)
+    m1, m2 = _v(moment1), _v(moment2)
+    mdpn = mdp * 0.96
+    mu_t = beta1 * (1 - 0.5 * mdpn)
+    mu_t1 = beta1 * (1 - 0.5 * mdpn * 0.96)
+    mupn = mup * mu_t
+    b2n = b2p * beta2
+    m1n = beta1 * m1 + (1 - beta1) * g
+    m2n = beta2 * m2 + (1 - beta2) * g * g
+    mhat = mu_t1 * m1n / (1 - mupn * mu_t1) + (1 - mu_t) * g / (1 - mupn)
+    vhat = m2n / (1 - b2n)
+    pn = p - lr * mhat / (jnp.sqrt(vhat) + epsilon)
+    return passthrough(
+        "nadam_", lambda *_: (pn.astype(p.dtype), mdpn, b2n, mupn, m1n, m2n), [param])
+
+
+def radam_(param, grad, learning_rate, beta1_pow, beta2_pow, rho,
+           moment1, moment2, master_param=None, beta1=0.9, beta2=0.999,
+           epsilon=1e-8, multi_precision=False):
+    p, g, lr = _v(param), _v(grad), _scalar(learning_rate)
+    b1p, b2p = _v(beta1_pow), _v(beta2_pow)
+    rho_acc = _v(rho)
+    m1, m2 = _v(moment1), _v(moment2)
+    rho_inf = 2.0 / (1 - beta2) - 1
+    b1n, b2n = b1p * beta1, b2p * beta2
+    # track step through rho accumulator: rho_out = rho + 1 (step counter)
+    step = rho_acc + 1.0
+    rho_t = rho_inf - 2.0 * step * b2n / (1 - b2n)
+    m1n = beta1 * m1 + (1 - beta1) * g
+    m2n = beta2 * m2 + (1 - beta2) * g * g
+    mhat = m1n / (1 - b1n)
+    rect = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                    / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-12))
+    adaptive = rect * mhat / (jnp.sqrt(m2n / (1 - b2n)) + epsilon)
+    sgd_like = mhat
+    pn = p - lr * jnp.where(rho_t > 5.0, adaptive, sgd_like)
+    return passthrough(
+        "radam_", lambda *_: (pn.astype(p.dtype), b1n, b2n, step, m1n, m2n), [param])
+
+
+def asgd_(param, grad, learning_rate, d, y, n, master_param=None,
+          multi_precision=False):
+    p, g, lr = _v(param), _v(grad), _scalar(learning_rate)
+    dv, yv, nv = _v(d), _v(y), _v(n)
+    dn = dv - yv + g
+    yn = g
+    pn = p - lr * dn / jnp.maximum(nv, 1.0)
+    return passthrough("asgd_", lambda *_: (pn.astype(p.dtype), dn, yn), [param])
+
+
+def rprop_(param, grad, prev, learning_rate, master_param=None,
+           learning_rate_range=(1e-5, 50.0), etas=(0.5, 1.2),
+           multi_precision=False):
+    p, g, pv, lr = _v(param), _v(grad), _v(prev), _v(learning_rate)
+    eta_n, eta_p = etas
+    lo, hi = learning_rate_range
+    sign = jnp.sign(g * pv)
+    lrn = jnp.clip(jnp.where(sign > 0, lr * eta_p, jnp.where(sign < 0, lr * eta_n, lr)),
+                   lo, hi)
+    g_eff = jnp.where(sign < 0, 0.0, g)
+    pn = p - lrn * jnp.sign(g_eff)
+    return passthrough("rprop_", lambda *_: (pn.astype(p.dtype), g_eff, lrn), [param])
+
+
+def dpsgd(param, grad, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0,
+          seed=0):
+    """Differentially-private SGD kernel (reference op: dpsgd): clip the grad
+    2-norm and add calibrated gaussian noise."""
+    import jax.random as jr
+
+    p, g, lr = _v(param), _v(grad), _scalar(learning_rate)
+    norm = jnp.linalg.norm(g)
+    g = g / jnp.maximum(1.0, norm / clip)
+    noise = jr.normal(jr.PRNGKey(seed), g.shape) * (sigma * clip / batch_size)
+    pn = p - lr * (g + noise)
+    return passthrough("dpsgd", lambda *_: pn.astype(p.dtype), [param])
+
+
+def decayed_adagrad(param, grad, moment, learning_rate, decay=0.95,
+                    epsilon=1e-6):
+    p, g, m, lr = _v(param), _v(grad), _v(moment), _scalar(learning_rate)
+    mn = decay * m + (1 - decay) * g * g
+    pn = p - lr * g / (jnp.sqrt(mn) + epsilon)
+    return passthrough("decayed_adagrad", lambda *_: (pn.astype(p.dtype), mn), [param])
+
+
+def average_accumulates_(param, in_sum_1, in_sum_2, in_sum_3, in_num_accumulates,
+                         in_old_num_accumulates, in_num_updates,
+                         average_window=10.0, max_average_window=10000,
+                         min_average_window=10000):
+    """Sliding-window parameter averaging accumulators (reference op:
+    average_accumulates_, used by ModelAverage)."""
+    p = _v(param)
+    s1, s2, s3 = _v(in_sum_1), _v(in_sum_2), _v(in_sum_3)
+    na = _v(in_num_accumulates) + 1
+    ona = _v(in_old_num_accumulates)
+    nu = _v(in_num_updates) + 1
+    s1n = s1 + p
+    roll = na >= min_average_window
+    s2n = jnp.where(roll, s2 + s1n, s2)
+    s1n = jnp.where(roll, jnp.zeros_like(s1n), s1n)
+    onan = jnp.where(roll, ona + na, ona)
+    nan_ = jnp.where(roll, jnp.zeros_like(na), na)
+    return passthrough(
+        "average_accumulates_",
+        lambda *_: (s1n, s2n, s3, nan_, onan, nu), [param])
+
+
+def merged_adam_(params, grads, learning_rates, moments1, moments2,
+                 beta1_pows, beta2_pows, master_params=None, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, multi_precision=False,
+                 use_global_beta_pow=False):
+    """Vectorized multi-tensor adam (reference op: merged_adam_): one fused
+    update over a param group — on TPU this compiles into one XLA program."""
+    outs = [adam_(p, g, lr, m1, m2, b1, b2, beta1=beta1, beta2=beta2,
+                  epsilon=epsilon)
+            for p, g, lr, m1, m2, b1, b2 in zip(
+                params, grads, learning_rates, moments1, moments2,
+                beta1_pows, beta2_pows)]
+    return tuple(zip(*outs))
+
+
+def merged_momentum_(params, grads, velocitys, learning_rates,
+                     master_params=None, mu=0.9, use_nesterov=False,
+                     regularization_method=None, regularization_coeff=None,
+                     multi_precision=False, rescale_grad=1.0):
+    outs = [momentum_(p, g, v, lr, mu=mu, use_nesterov=use_nesterov,
+                      rescale_grad=rescale_grad)
+            for p, g, v, lr in zip(params, grads, velocitys, learning_rates)]
+    return tuple(zip(*outs))

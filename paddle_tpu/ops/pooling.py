@@ -1,0 +1,244 @@
+"""Pooling kernel tier completing the reference YAML (reference ops: pool2d,
+pool3d, lp_pool2d, max_pool2d_with_index, max_pool3d_with_index,
+fractional_max_pool2d/3d, unpool, unpool3d, segment_pool, sequence_pool in
+/root/reference/paddle/phi/ops/yaml/ops.yaml). The generic window reductions
+delegate to nn.functional's lax.reduce_window pools; the index-carrying
+variants compute argmax indices with a one-hot window trick that XLA fuses.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import passthrough, primitive
+from ..core.tensor import Tensor, unwrap
+from ..nn.functional import pooling as fp
+
+
+def pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+           exclusive=True, data_format="NCHW", pooling_type="max",
+           global_pooling=False, adaptive=False, padding_algorithm="EXPLICIT",
+           name=None):
+    """Unified pool2d kernel (reference op: pool2d with pooling_type attr)."""
+    if global_pooling:
+        v = unwrap(x)
+        axes = (2, 3) if data_format == "NCHW" else (1, 2)
+        red = jnp.max if pooling_type == "max" else jnp.mean
+        return primitive("pool2d", lambda v: red(v, axis=axes, keepdims=True), [x])
+    if adaptive:
+        f = (fp.adaptive_max_pool2d if pooling_type == "max"
+             else fp.adaptive_avg_pool2d)
+        return f(x, kernel_size)
+    if pooling_type == "max":
+        return fp.max_pool2d(x, kernel_size, stride, padding,
+                             ceil_mode=ceil_mode, data_format=data_format)
+    return fp.avg_pool2d(x, kernel_size, stride, padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive, data_format=data_format)
+
+
+def pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+           exclusive=True, data_format="NCDHW", pooling_type="max",
+           global_pooling=False, adaptive=False, padding_algorithm="EXPLICIT",
+           name=None):
+    """Unified pool3d kernel (reference op: pool3d)."""
+    if global_pooling:
+        axes = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+        red = jnp.max if pooling_type == "max" else jnp.mean
+        return primitive("pool3d", lambda v: red(v, axis=axes, keepdims=True), [x])
+    if adaptive:
+        f = (fp.adaptive_max_pool3d if pooling_type == "max"
+             else fp.adaptive_avg_pool3d)
+        return f(x, kernel_size)
+    if pooling_type == "max":
+        return fp.max_pool3d(x, kernel_size, stride, padding,
+                             ceil_mode=ceil_mode, data_format=data_format)
+    return fp.avg_pool3d(x, kernel_size, stride, padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive, data_format=data_format)
+
+
+def lp_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", norm_type=2.0, name=None):
+    """L_p window pooling (reference op: lp_pool2d):
+    (sum_w |x|^p)^(1/p) via an avg-pool on |x|^p."""
+    p = float(norm_type)
+
+    def fn(v):
+        vp = jnp.abs(v) ** p
+        return vp
+
+    powered = primitive("lp_pow", fn, [x])
+    pooled = fp.avg_pool2d(powered, kernel_size, stride, padding,
+                           ceil_mode=ceil_mode, exclusive=False,
+                           data_format=data_format)
+    k = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size, kernel_size)
+    count = float(k[0] * k[1])
+    return primitive("lp_root", lambda v: (v * count) ** (1.0 / p), [pooled])
+
+
+def _pool_with_index(name, x, kernel_size, stride, padding, nd):
+    """Max pool + flat argmax index per window. Index = row-major position in
+    the input spatial plane, matching the reference kernel's mask output."""
+    k = (kernel_size,) * nd if isinstance(kernel_size, int) else tuple(kernel_size)
+    s = k if stride is None else ((stride,) * nd if isinstance(stride, int) else tuple(stride))
+    p = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+
+    def fn(v):
+        spatial = v.shape[2:]
+        flat_idx = jnp.arange(int(jnp.prod(jnp.asarray(spatial))),
+                              dtype=jnp.int32).reshape(spatial)
+        flat_idx = jnp.broadcast_to(flat_idx, v.shape)
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+        neg = jnp.asarray(-jnp.inf, v.dtype)
+        out = lax.reduce_window(v, neg, lax.max, window, strides, pads)
+        # argmax: reduce (value, index) pairs
+        def select(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+        vals, idx = lax.reduce_window(
+            (v, flat_idx), (neg, jnp.int32(-1)), select, window, strides, pads)
+        del vals
+        return out, idx
+
+    out, idx = primitive(name, fn, [x], n_outputs=2)
+    return out, idx
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False, name=None):
+    """(reference op: max_pool2d_with_index)."""
+    return _pool_with_index("max_pool2d_with_index", x, kernel_size, stride, padding, 2)
+
+
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False, name=None):
+    """(reference op: max_pool3d_with_index)."""
+    return _pool_with_index("max_pool3d_with_index", x, kernel_size, stride, padding, 3)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (reference op: fractional_max_pool2d) with the
+    deterministic pseudo-random sequence of Graham'14: window boundaries from
+    a single uniform u."""
+    return _fractional(x, output_size, random_u, return_mask, nd=2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """(reference op: fractional_max_pool3d)."""
+    return _fractional(x, output_size, random_u, return_mask, nd=3)
+
+
+def _fractional(x, output_size, random_u, return_mask, nd):
+    import numpy as np
+
+    v = unwrap(x)
+    spatial = v.shape[2:]
+    outs = (output_size,) * nd if isinstance(output_size, int) else tuple(output_size)
+    u = float(random_u) if random_u is not None else 0.5
+
+    sections = []
+    for dim, (n_in, n_out) in enumerate(zip(spatial, outs)):
+        alpha = n_in / n_out
+        # boundary sequence: ceil(alpha*(i+u)) - ceil(alpha*u), clipped
+        edges = [int(np.ceil(alpha * (i + u))) - int(np.ceil(alpha * u)) for i in range(n_out + 1)]
+        edges[0], edges[-1] = 0, n_in
+        sections.append(edges)
+
+    def fn(v):
+        out = v
+        for dim, edges in enumerate(sections):
+            axis = 2 + dim
+            slabs = [jnp.max(jnp.take(out, jnp.arange(a, max(a + 1, b)), axis=axis),
+                             axis=axis, keepdims=True)
+                     for a, b in zip(edges[:-1], edges[1:])]
+            out = jnp.concatenate(slabs, axis=axis)
+        return out
+
+    out = primitive("fractional_max_pool%dd" % nd, fn, [x])
+    if return_mask:
+        return out, None
+    return out
+
+
+def unpool(x, indices, kernel_size=2, stride=None, padding=0, data_format="NCHW",
+           output_size=None, name=None):
+    """Inverse of max_pool2d_with_index: scatter values to their argmax
+    positions (reference op: unpool)."""
+    return _unpool(x, indices, output_size, kernel_size, stride, nd=2)
+
+
+def unpool3d(x, indices, kernel_size=2, stride=None, padding=0,
+             data_format="NCDHW", output_size=None, name=None):
+    """(reference op: unpool3d)."""
+    return _unpool(x, indices, output_size, kernel_size, stride, nd=3)
+
+
+def _unpool(x, indices, output_size, kernel_size, stride, nd):
+    v = unwrap(x)
+    if output_size is None:
+        k = (kernel_size,) * nd if isinstance(kernel_size, int) else tuple(kernel_size)
+        s = k if stride is None else ((stride,) * nd if isinstance(stride, int) else tuple(stride))
+        output_size = tuple(int(dim * si) for dim, si in zip(v.shape[2:], s))
+    else:
+        output_size = tuple(output_size)[-nd:]
+
+    def fn(v, idx):
+        B, C = v.shape[:2]
+        flat_out = jnp.zeros((B, C, int(jnp.prod(jnp.asarray(output_size)))), v.dtype)
+        flat_v = v.reshape(B, C, -1)
+        flat_i = idx.reshape(B, C, -1)
+        out = jax.vmap(jax.vmap(lambda o, val, ii: o.at[ii].set(val)))(flat_out, flat_v, flat_i)
+        return out.reshape((B, C) + output_size)
+
+    return primitive("unpool%dd" % nd, fn, [x, indices])
+
+
+def segment_pool(x, segment_ids, pooltype="SUM", name=None):
+    """Segment reduction (reference op: segment_pool; paddle.geometric
+    segment_sum/mean/max/min) via jax.ops.segment_* — the TPU-friendly
+    sorted-scatter path."""
+    sid = unwrap(segment_ids)
+    num = int(jax.device_get(sid.max())) + 1 if sid.size else 0
+
+    def fn(v, ids):
+        if pooltype == "SUM":
+            return jax.ops.segment_sum(v, ids, num)
+        if pooltype == "MEAN":
+            s = jax.ops.segment_sum(v, ids, num)
+            c = jax.ops.segment_sum(jnp.ones_like(v), ids, num)
+            return s / jnp.maximum(c, 1)
+        if pooltype == "MAX":
+            return jax.ops.segment_max(v, ids, num)
+        return jax.ops.segment_min(v, ids, num)
+
+    return primitive("segment_pool", fn, [x, segment_ids])
+
+
+def sequence_pool(x, lengths, pooltype="SUM", pad_value=0.0, name=None):
+    """Pool padded (B, T, D) sequences by length mask (reference op:
+    sequence_pool over LoD; here lengths replace LoD on TPU)."""
+
+    def fn(v, ln):
+        t = v.shape[1]
+        mask = (jnp.arange(t)[None, :] < ln[:, None])[..., None]
+        if pooltype == "SUM":
+            return jnp.sum(jnp.where(mask, v, 0), 1)
+        if pooltype in ("MEAN", "AVERAGE"):
+            return jnp.sum(jnp.where(mask, v, 0), 1) / jnp.maximum(ln[:, None], 1)
+        if pooltype == "MAX":
+            return jnp.max(jnp.where(mask, v, -jnp.inf), 1)
+        if pooltype == "LAST":
+            return jnp.take_along_axis(v, (ln[:, None, None] - 1), 1)[:, 0]
+        if pooltype == "FIRST":
+            return v[:, 0]
+        return jnp.sqrt(jnp.maximum(ln[:, None], 1).astype(v.dtype)) ** -1 * jnp.sum(
+            jnp.where(mask, v, 0), 1)
+
+    return primitive("sequence_pool", fn, [x, lengths])

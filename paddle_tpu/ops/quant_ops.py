@@ -1,0 +1,296 @@
+"""Quantization kernel tier (reference ops: fake_quantize_* /
+fake_channel_wise_* / dequantize_abs_max / dequantize_log /
+weight_quantize / weight_dequantize / weight_only_linear / llm_int8_linear /
+apply_per_channel_scale in /root/reference/paddle/phi/ops/yaml/{ops,fused_ops}.yaml
+and /root/reference/paddle/phi/kernels/fusion/*weight_only*).
+
+TPU notes: int8 weights are stored as int8 arrays; the int8xbf16 matmul path
+dequantizes into bf16 right at the dot so XLA fuses scale-multiply into the
+MXU epilogue. There is no cutlass-style kernel to call — the fusion IS the
+kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import passthrough, primitive
+from ..core.tensor import Tensor, unwrap
+
+
+def _qmax(bit_length=8):
+    return float((1 << (bit_length - 1)) - 1)
+
+
+def fake_quantize_abs_max(x, bit_length=8, round_type=1, name=None):
+    """Quantize-to-int-range by tensor abs-max (reference op:
+    fake_quantize_abs_max → (out, scale))."""
+    qmax = _qmax(bit_length)
+
+    def fn(v):
+        scale = jnp.max(jnp.abs(v))
+        s = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+        return q, scale.reshape(1)
+
+    return primitive("fake_quantize_abs_max", fn, [x], n_outputs=2)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8, round_type=1, name=None):
+    """QAT sim: quantize then dequantize (reference op:
+    fake_quantize_dequantize_abs_max). Straight-through gradient."""
+    qmax = _qmax(bit_length)
+
+    def fn(v):
+        scale = jnp.max(jnp.abs(v))
+        s = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+        # straight-through: out = v + stop_grad(dq - v)
+        dq = q * s / qmax
+        return v + jax.lax.stop_gradient(dq - v), scale.reshape(1)
+
+    return primitive("fake_quantize_dequantize_abs_max", fn, [x], n_outputs=2)
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, round_type=1,
+                                       quant_axis=0, name=None):
+    """(reference op: fake_channel_wise_quantize_abs_max)."""
+    qmax = _qmax(bit_length)
+
+    def fn(v):
+        red = tuple(i for i in range(v.ndim) if i != quant_axis)
+        scale = jnp.max(jnp.abs(v), axis=red)
+        shape = [1] * v.ndim
+        shape[quant_axis] = -1
+        s = jnp.maximum(scale, 1e-8).reshape(shape)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+        return q, scale
+
+    return primitive("fake_channel_wise_quantize_abs_max", fn, [x], n_outputs=2)
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  round_type=1, quant_axis=0,
+                                                  name=None):
+    qmax = _qmax(bit_length)
+
+    def fn(v):
+        red = tuple(i for i in range(v.ndim) if i != quant_axis)
+        scale = jnp.max(jnp.abs(v), axis=red)
+        shape = [1] * v.ndim
+        shape[quant_axis] = -1
+        s = jnp.maximum(scale, 1e-8).reshape(shape)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+        dq = q * s / qmax
+        return v + jax.lax.stop_gradient(dq - v), scale
+
+    return primitive("fake_channel_wise_quantize_dequantize_abs_max", fn, [x],
+                     n_outputs=2)
+
+
+def fake_channel_wise_dequantize_max_abs(x, scales, quant_bits=(8,),
+                                         quant_axis=0, x_num_col_dims=1,
+                                         name=None):
+    """(reference op: fake_channel_wise_dequantize_max_abs)."""
+    qmax = _qmax(quant_bits[0] if isinstance(quant_bits, (list, tuple)) else quant_bits)
+
+    def fn(v, s):
+        shape = [1] * v.ndim
+        shape[quant_axis] = -1
+        return v * s.reshape(shape) / qmax
+
+    scales0 = scales[0] if isinstance(scales, (list, tuple)) else scales
+    return primitive("fake_channel_wise_dequantize_max_abs", fn, [x, scales0])
+
+
+def fake_dequantize_max_abs(x, scale, max_range=127.0, name=None):
+    """(reference op: fake_dequantize_max_abs)."""
+    return primitive("fake_dequantize_max_abs",
+                     lambda v, s: v * s / max_range, [x, scale])
+
+
+def dequantize_abs_max(x, scale, max_range=127.0, name=None):
+    """(reference op: dequantize_abs_max)."""
+    return primitive("dequantize_abs_max",
+                     lambda v, s: v.astype(jnp.float32) * s / max_range, [x, scale])
+
+
+def dequantize_log(x, dict_data, name=None):
+    """Log-quantization table lookup (reference op: dequantize_log)."""
+
+    def fn(v, table):
+        idx = v.astype(jnp.int32)
+        neg = idx < 0
+        mag = table[jnp.where(neg, idx + 128, idx)]
+        return jnp.where(neg, -mag, mag)
+
+    return primitive("dequantize_log", fn, [x, dict_data])
+
+
+def _moving_average(state, accum, scale, rate):
+    new_accum = rate * accum + scale
+    new_state = rate * state + 1.0
+    return new_accum / new_state, new_state, new_accum
+
+
+def fake_quantize_moving_average_abs_max(x, in_scale, in_accum=None,
+                                         in_state=None, moving_rate=0.9,
+                                         bit_length=8, is_test=False,
+                                         round_type=1, name=None):
+    """(reference op: fake_quantize_moving_average_abs_max)."""
+    qmax = _qmax(bit_length)
+    accum = in_accum if in_accum is not None else in_scale
+    state = in_state if in_state is not None else in_scale
+
+    def fn(v, sc, ac, st):
+        cur = jnp.max(jnp.abs(v))
+        if is_test:
+            scale = jnp.maximum(sc.reshape(()), 1e-8)
+            new_st, new_ac = st, ac
+        else:
+            scale, new_st, new_ac = _moving_average(st.reshape(()), ac.reshape(()),
+                                                    cur, moving_rate)
+            scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(v / scale * qmax), -qmax, qmax)
+        return q, scale.reshape(1), new_st.reshape(-1), new_ac.reshape(-1)
+
+    return primitive("fake_quantize_moving_average_abs_max", fn,
+                     [x, in_scale, accum, state], n_outputs=4)
+
+
+def fake_quantize_dequantize_moving_average_abs_max(x, in_scale, in_accum=None,
+                                                    in_state=None,
+                                                    moving_rate=0.9,
+                                                    bit_length=8, is_test=False,
+                                                    round_type=1, name=None):
+    """(reference op: fake_quantize_dequantize_moving_average_abs_max)."""
+    qmax = _qmax(bit_length)
+    accum = in_accum if in_accum is not None else in_scale
+    state = in_state if in_state is not None else in_scale
+
+    def fn(v, sc, ac, st):
+        cur = jnp.max(jnp.abs(v))
+        if is_test:
+            scale = jnp.maximum(sc.reshape(()), 1e-8)
+            new_st, new_ac = st, ac
+        else:
+            scale, new_st, new_ac = _moving_average(st.reshape(()), ac.reshape(()),
+                                                    cur, moving_rate)
+            scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(v / scale * qmax), -qmax, qmax)
+        dq = q * scale / qmax
+        return (v + jax.lax.stop_gradient(dq - v), scale.reshape(1),
+                new_st.reshape(-1), new_ac.reshape(-1))
+
+    return primitive("fake_quantize_dequantize_moving_average_abs_max", fn,
+                     [x, in_scale, accum, state], n_outputs=4)
+
+
+def fake_quantize_range_abs_max(x, in_scale, iter=None, window_size=10000,
+                                bit_length=8, is_test=False, round_type=1,
+                                name=None):
+    """(reference op: fake_quantize_range_abs_max) — running-window max
+    scale; the window history collapses to a running max on TPU."""
+    qmax = _qmax(bit_length)
+
+    def fn(v, sc):
+        cur = jnp.max(jnp.abs(v))
+        scale = jnp.maximum(sc.reshape(()) if is_test else jnp.maximum(sc.reshape(()), cur), 1e-8)
+        q = jnp.clip(jnp.round(v / scale * qmax), -qmax, qmax)
+        return q, scale.reshape(1)
+
+    return primitive("fake_quantize_range_abs_max", fn, [x, in_scale], n_outputs=2)
+
+
+# ---- weight-only / int8 inference tier -------------------------------------
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
+                    name=None):
+    """Quantize a (in, out) weight matrix for weight-only inference
+    (reference op: weight_quantize → (int8 weight, per-out-channel scale)).
+    Layout stays row-major — XLA picks its own tiling; no GPU-specific
+    layout shuffling is needed on TPU."""
+
+    def fn(w):
+        if algo in ("weight_only_int8", "llm.int8"):
+            scale = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-8)
+            q = jnp.clip(jnp.round(w / scale[None, :] * 127.0), -127, 127).astype(jnp.int8)
+            return q, scale
+        if algo == "weight_only_int4":
+            scale = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-8)
+            q = jnp.clip(jnp.round(w / scale[None, :] * 7.0), -7, 7).astype(jnp.int8)
+            return q, scale
+        raise NotImplementedError(f"weight_quantize algo={algo}")
+
+    return passthrough("weight_quantize", fn, [x])
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16",
+                      group_size=-1, name=None):
+    """(reference op: weight_dequantize)."""
+    qmax = 7.0 if algo == "weight_only_int4" else 127.0
+    return primitive(
+        "weight_dequantize",
+        lambda q, s: q.astype(jnp.float32) * s[None, :] / qmax, [x, scale])
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1,
+                       name=None):
+    """y = x @ dequant(Wq) + b with the dequant fused into the matmul
+    (reference fused op: weight_only_linear). The int8→bf16 convert+scale
+    sits between HBM load and MXU feed; XLA fuses it, halving weight
+    bandwidth vs bf16 weights."""
+    qmax = 7.0 if weight_dtype == "int4" else 127.0
+    args = [x, weight] + ([weight_scale] if weight_scale is not None else []) \
+        + ([bias] if bias is not None else [])
+    has_scale = weight_scale is not None
+    has_bias = bias is not None
+
+    def fn(xv, wq, *rest):
+        i = 0
+        scale = rest[i] if has_scale else jnp.ones(wq.shape[-1], xv.dtype)
+        i += 1 if has_scale else 0
+        b = rest[i] if has_bias else None
+        wf = wq.astype(xv.dtype) * (scale.astype(xv.dtype) / qmax)[None, :]
+        y = xv @ wf
+        return y + b if b is not None else y
+
+    return primitive("weight_only_linear", fn, [*args])
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0, name=None):
+    """LLM.int8() mixed decomposition (reference fused op: llm_int8_linear):
+    outlier activation columns run in bf16, the rest in int8."""
+    args = [x, weight] + ([weight_scale] if weight_scale is not None else []) \
+        + ([bias] if bias is not None else [])
+    has_scale = weight_scale is not None
+    has_bias = bias is not None
+
+    def fn(xv, wq, *rest):
+        i = 0
+        scale = rest[i] if has_scale else jnp.ones(wq.shape[-1], xv.dtype)
+        i += 1 if has_scale else 0
+        b = rest[i] if has_bias else None
+        col_max = jnp.max(jnp.abs(xv), axis=tuple(range(xv.ndim - 1)))
+        outlier = col_max > threshold
+        wf = wq.astype(xv.dtype) * (scale.astype(xv.dtype) / 127.0)[None, :]
+        x_in = jnp.where(outlier[None, :], 0.0, xv) if xv.ndim == 2 else jnp.where(outlier, 0.0, xv)
+        x_out = xv - x_in
+        # int8 path: quantize the inlier activations per-row
+        row_scale = jnp.maximum(jnp.max(jnp.abs(x_in), axis=-1, keepdims=True), 1e-8)
+        xq = jnp.round(x_in / row_scale * 127.0)
+        y_int = (xq @ wq.astype(xv.dtype)) * row_scale / 127.0 * (scale / 127.0)[None, :]
+        y_fp = x_out @ wf
+        y = y_int + y_fp
+        return y + b if b is not None else y
+
+    return primitive("llm_int8_linear", fn, [*args])
+
+
+def apply_per_channel_scale(x, scales, name=None):
+    """Scale activations per input-channel before a quantized matmul
+    (reference op: apply_per_channel_scale)."""
+    return primitive("apply_per_channel_scale",
+                     lambda v, s: v * s[None, :], [x, scales])

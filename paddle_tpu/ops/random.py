@@ -152,3 +152,30 @@ def normal_(x, mean=0.0, std=1.0, name=None):
     v = unwrap(x)
     x._replace_value(mean + std * jax.random.normal(_key(), v.shape, v.dtype))
     return x
+
+
+def truncated_normal(shape, mean=0.0, std=1.0, a=-2.0, b=2.0, dtype=None, name=None):
+    """Two-sided truncated normal (reference op: truncated_gaussian_random)."""
+    import jax.random as jr
+
+    lo, hi = (a - mean) / std, (b - mean) / std
+    v = jr.truncated_normal(_key(), lo, hi, _shape(shape)) * std + mean
+    return Tensor(v.astype(_dt(dtype)))
+
+
+def standard_gamma(x, name=None):
+    """Gamma(alpha=x, scale=1) sampler (reference op: standard_gamma)."""
+    import jax.random as jr
+
+    from ..core.dispatch import passthrough
+
+    return passthrough("standard_gamma", lambda a: jr.gamma(_key(), a), [x])
+
+
+def dirichlet(alpha, name=None):
+    """Dirichlet(alpha) sampler over the last axis (reference op: dirichlet)."""
+    import jax.random as jr
+
+    from ..core.dispatch import passthrough
+
+    return passthrough("dirichlet", lambda a: jr.dirichlet(_key(), a), [alpha])

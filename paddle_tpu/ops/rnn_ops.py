@@ -1,0 +1,120 @@
+"""Functional RNN kernels (reference ops: rnn, lstm, gru, gru_unit,
+cudnn_lstm in /root/reference/paddle/phi/ops/yaml/ops.yaml). The layer
+classes in nn.layer.rnn are the stateful API; these are the kernel-level
+entries operating on weight lists, all driven by lax.scan so the time loop
+compiles to a single XLA While with MXU-batched gate matmuls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import primitive
+from ..core.tensor import unwrap
+
+
+def _scan_time(step, x, init, time_major):
+    xs = x if time_major else jnp.swapaxes(x, 0, 1)
+    final, ys = lax.scan(step, init, xs)
+    return final, ys if time_major else jnp.swapaxes(ys, 0, 1)
+
+
+def lstm(x, wx, wh, b, init_h=None, init_c=None, time_major=False, name=None):
+    """Single-layer LSTM kernel: x (B, T, I), wx (I, 4H), wh (H, 4H), b (4H,).
+    Returns (out, last_h, last_c) (reference op: lstm / cudnn_lstm packed-
+    weight form unpacked into per-gate matrices)."""
+
+    def fn(xv, wxv, whv, bv, *hc):
+        B = xv.shape[1] if time_major else xv.shape[0]
+        H = whv.shape[0]
+        h0 = hc[0] if hc else jnp.zeros((B, H), xv.dtype)
+        c0 = hc[1] if len(hc) > 1 else jnp.zeros((B, H), xv.dtype)
+
+        def step(carry, xt):
+            h, c = carry
+            gates = xt @ wxv + h @ whv + bv
+            i, f, g, o = jnp.split(gates, 4, -1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        (hT, cT), ys = _scan_time(step, xv, (h0, c0), time_major)
+        return ys, hT, cT
+
+    args = [x, wx, wh, b] + ([init_h] if init_h is not None else []) \
+        + ([init_c] if init_c is not None else [])
+    return primitive("lstm", fn, args, n_outputs=3)
+
+
+def gru(x, wx, wh, b, init_h=None, time_major=False, name=None):
+    """Single-layer GRU kernel: wx (I, 3H), wh (H, 3H), b (3H,)
+    (reference op: gru)."""
+
+    def fn(xv, wxv, whv, bv, *h):
+        B = xv.shape[1] if time_major else xv.shape[0]
+        H = whv.shape[0]
+        h0 = h[0] if h else jnp.zeros((B, H), xv.dtype)
+
+        def step(hprev, xt):
+            xg = xt @ wxv + bv
+            hg = hprev @ whv
+            xr, xz, xn = jnp.split(xg, 3, -1)
+            hr, hz, hn = jnp.split(hg, 3, -1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * n + z * hprev
+            return h_new, h_new
+
+        hT, ys = _scan_time(step, xv, h0, time_major)
+        return ys, hT
+
+    args = [x, wx, wh, b] + ([init_h] if init_h is not None else [])
+    return primitive("gru", fn, args, n_outputs=2)
+
+
+def gru_unit(input, hidden_prev, weight, bias=None, activation="tanh",
+             gate_activation="sigmoid", name=None):
+    """One GRU step in the reference's gru_unit layout: input (B, 3H) is the
+    pre-computed x-projection, weight (H, 3H) packs [update|reset; candidate]
+    (reference op: gru_unit)."""
+
+    act = {"tanh": jnp.tanh, "relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+           "identity": lambda a: a}
+    g_act = act[gate_activation]
+    c_act = act[activation]
+
+    def fn(xg, hprev, w, *b):
+        H = hprev.shape[-1]
+        xg = xg + b[0] if b else xg
+        w_rz = w[:, : 2 * H]
+        w_c = w[:, 2 * H:]
+        rz = g_act(xg[:, : 2 * H] + hprev @ w_rz)
+        r, z = rz[:, :H], rz[:, H:]
+        c = c_act(xg[:, 2 * H:] + (r * hprev) @ w_c)
+        h_new = z * hprev + (1 - z) * c
+        return h_new, rz, c
+
+    args = [input, hidden_prev, weight] + ([bias] if bias is not None else [])
+    return primitive("gru_unit", fn, args, n_outputs=3)
+
+
+def rnn(x, wx, wh, b, init_h=None, activation="tanh", time_major=False, name=None):
+    """Vanilla RNN kernel (reference op: rnn single-layer form)."""
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def fn(xv, wxv, whv, bv, *h):
+        B = xv.shape[1] if time_major else xv.shape[0]
+        H = whv.shape[0]
+        h0 = h[0] if h else jnp.zeros((B, H), xv.dtype)
+
+        def step(hprev, xt):
+            h_new = act(xt @ wxv + hprev @ whv + bv)
+            return h_new, h_new
+
+        hT, ys = _scan_time(step, xv, h0, time_major)
+        return ys, hT
+
+    args = [x, wx, wh, b] + ([init_h] if init_h is not None else [])
+    return primitive("rnn", fn, args, n_outputs=2)

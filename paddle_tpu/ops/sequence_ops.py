@@ -1,0 +1,448 @@
+"""Sequence, decoding and graph ops (reference ops: gather_tree,
+edit_distance, viterbi_decode, crf_decoding, ctc_align, beam_search,
+sequence_conv, im2sequence, top_p_sampling, accuracy, auc, send_u_recv,
+send_ue_recv, send_uv, reindex_graph, graph_sample_neighbors in
+/root/reference/paddle/phi/ops/yaml/ops.yaml). Decoders use lax.scan (static
+trip counts) so they stay compiled on TPU; graph ops use segment reductions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import passthrough, primitive
+from ..core.tensor import Tensor, unwrap
+
+
+def gather_tree(ids, parents, name=None):
+    """Reconstruct full beams from per-step parent pointers (reference op:
+    gather_tree; shape (T, B, W))."""
+
+    def fn(idv, par):
+        T = idv.shape[0]
+
+        def step(carry, t):
+            beam = carry  # (B, W) current beam index at t+1
+            tt = T - 1 - t
+            out = jnp.take_along_axis(idv[tt], beam, axis=-1)
+            nxt = jnp.take_along_axis(par[tt], beam, axis=-1)
+            return nxt, out
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2]), idv.shape[1:])
+        _, outs = lax.scan(step, init, jnp.arange(T))
+        return outs[::-1]
+
+    return passthrough("gather_tree", fn, [ids, parents])
+
+
+def edit_distance(hyps, refs, hyps_length=None, refs_length=None,
+                  normalized=True, name=None):
+    """Levenshtein distance batch kernel (reference op: edit_distance).
+    Dense (B, T) int inputs + lengths; DP over lax.scan."""
+
+    def fn(h, r, hl, rl):
+        B, Th = h.shape
+        Tr = r.shape[1]
+
+        def per_pair(hb, rb, hlb, rlb):
+            row0 = jnp.arange(Tr + 1, dtype=jnp.float32)
+
+            def step(row, i):
+                ch = hb[i]
+                valid_i = i < hlb
+
+                def inner(carry, j):
+                    prev_diag, newrow = carry
+                    cost = jnp.where(rb[j] == ch, 0.0, 1.0)
+                    val = jnp.minimum(jnp.minimum(newrow[j] + 1.0, row[j + 1] + 1.0),
+                                      prev_diag + cost)
+                    val = jnp.where(j < rlb, val, newrow[j])
+                    return (row[j + 1], newrow.at[j + 1].set(val)), None
+
+                init_row = row.at[0].add(1.0)
+                (_, newrow), _ = lax.scan(inner, (row[0], init_row), jnp.arange(Tr))
+                return jnp.where(valid_i, newrow, row), None
+
+            final, _ = lax.scan(step, row0, jnp.arange(Th))
+            d = final[rlb]
+            return jnp.where(normalized, d / jnp.maximum(rlb.astype(jnp.float32), 1.0), d)
+
+        hl = hl if hl is not None else jnp.full((B,), Th)
+        rl = rl if rl is not None else jnp.full((B,), Tr)
+        dists = jax.vmap(per_pair)(h, r, hl, rl)
+        return dists.reshape(B, 1), jnp.asarray([B], jnp.int32)
+
+    args = [hyps, refs,
+            hyps_length if hyps_length is not None else Tensor(jnp.full((unwrap(hyps).shape[0],), unwrap(hyps).shape[1])),
+            refs_length if refs_length is not None else Tensor(jnp.full((unwrap(refs).shape[0],), unwrap(refs).shape[1]))]
+    return passthrough("edit_distance", fn, args)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Viterbi decoding (reference op: viterbi_decode). potentials
+    (B, T, N), transition (N, N) [+2 rows/cols for BOS/EOS when tagged]."""
+
+    def fn(emis, trans, lens):
+        B, T, N = emis.shape
+        if include_bos_eos_tag:
+            start = trans[-2][:N]
+            stop = trans[:, -1][:N] if trans.shape[1] > N else trans[:N, -1]
+            tr = trans[:N, :N]
+        else:
+            start = jnp.zeros(N)
+            stop = jnp.zeros(N)
+            tr = trans
+
+        def per_seq(em, ln):
+            alpha0 = em[0] + start
+
+            def step(alpha, t):
+                scores = alpha[:, None] + tr + em[t][None, :]
+                best = jnp.max(scores, 0)
+                back = jnp.argmax(scores, 0)
+                new_alpha = jnp.where(t < ln, best, alpha)
+                back = jnp.where(t < ln, back, jnp.arange(N)[None, :].repeat(1, 0).squeeze(0))
+                return new_alpha, back
+
+            alpha, backs = lax.scan(step, alpha0, jnp.arange(1, T))
+            alpha = alpha + stop
+            last = jnp.argmax(alpha)
+            score = jnp.max(alpha)
+
+            def walk(state, t):
+                tt = T - 2 - t
+                prev = backs[tt][state]
+                take = tt + 1 < ln
+                prev = jnp.where(take, prev, state)
+                return prev, prev
+
+            _, path_rev = lax.scan(walk, last, jnp.arange(T - 1))
+            path = jnp.concatenate([path_rev[::-1], last[None]])
+            return score, path
+
+        scores, paths = jax.vmap(per_seq)(emis, lens)
+        return scores, paths
+
+    return passthrough("viterbi_decode", fn, [potentials, transition_params, lengths])
+
+
+def crf_decoding(emission, transition, label=None, length=None, name=None):
+    """CRF argmax decoding (reference op: crf_decoding) — the transition
+    matrix carries start/stop weights in its first two rows, matching the
+    reference's linear_chain_crf layout."""
+
+    def fn(em, tr, lens):
+        B, T, N = em.shape
+        start, stop, body = tr[0], tr[1], tr[2:]
+
+        def per_seq(e, ln):
+            alpha0 = e[0] + start
+
+            def step(alpha, t):
+                scores = alpha[:, None] + body + e[t][None, :]
+                new_alpha = jnp.where(t < ln, jnp.max(scores, 0), alpha)
+                back = jnp.argmax(scores, 0)
+                return new_alpha, back
+
+            alpha, backs = lax.scan(step, alpha0, jnp.arange(1, T))
+            alpha = alpha + stop
+            last = jnp.argmax(alpha)
+
+            def walk(state, t):
+                tt = T - 2 - t
+                prev = backs[tt][state]
+                prev = jnp.where(tt + 1 < ln, prev, state)
+                return prev, prev
+
+            _, rev = lax.scan(walk, last, jnp.arange(T - 1))
+            return jnp.concatenate([rev[::-1], last[None]])
+
+        lens = lens if lens is not None else jnp.full((B,), T)
+        return jax.vmap(per_seq)(em, lens)
+
+    ln = length if length is not None else Tensor(jnp.full((unwrap(emission).shape[0],), unwrap(emission).shape[1]))
+    return passthrough("crf_decoding", fn, [emission, transition, ln])
+
+
+def ctc_align(input, input_length=None, blank=0, merge_repeated=True, padding_value=0, name=None):
+    """CTC greedy alignment: collapse repeats then drop blanks (reference op:
+    ctc_align). Output stays (B, T) padded with padding_value."""
+
+    def fn(v, ln):
+        B, T = v.shape
+
+        def per_seq(row, n):
+            prev = jnp.concatenate([jnp.array([-1], row.dtype), row[:-1]])
+            keep = (row != blank) & ((row != prev) | (not merge_repeated)) \
+                & (jnp.arange(T) < n)
+            idx = jnp.cumsum(keep.astype(jnp.int32)) - 1
+            out = jnp.full((T,), padding_value, row.dtype)
+            out = out.at[jnp.where(keep, idx, T - 1)].set(
+                jnp.where(keep, row, out[-1]), mode="drop")
+            # ensure dropped writes don't clobber: rebuild with where
+            safe_idx = jnp.where(keep, idx, T + 1)
+            out = jnp.full((T,), padding_value, row.dtype).at[safe_idx].set(row, mode="drop")
+            return out
+
+        ln = ln if ln is not None else jnp.full((B,), T)
+        return jax.vmap(per_seq)(v, ln)
+
+    ln = input_length if input_length is not None else Tensor(jnp.full((unwrap(input).shape[0],), unwrap(input).shape[1]))
+    return passthrough("ctc_align", fn, [input, ln])
+
+
+def beam_search_step(log_probs, prev_scores, beam_size, end_id=0, name=None):
+    """One beam-search expansion step (reference op: beam_search, flattened
+    to the TPU-friendly dense form): scores (B, W, V) → top beam_size
+    (score, token, parent) per batch."""
+
+    def fn(lp, ps):
+        B, W, V = lp.shape
+        total = ps[..., None] + lp
+        flat = total.reshape(B, W * V)
+        scores, idx = lax.top_k(flat, beam_size)
+        parent = idx // V
+        token = idx % V
+        return scores, token, parent
+
+    return passthrough("beam_search", fn, [log_probs, prev_scores])
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling (reference op: top_p_sampling): zero out the tail
+    beyond cumulative prob p, renormalize, sample."""
+    import numpy as np
+
+    from ..base import global_state
+
+    key = jax.random.PRNGKey(int(np.random.randint(0, 2**31)) if seed in (None, -1) else int(seed))
+
+    def fn(logits, p):
+        sorted_logits = jnp.sort(logits, -1)[..., ::-1]
+        sorted_probs = jax.nn.softmax(sorted_logits, -1)
+        cum = jnp.cumsum(sorted_probs, -1)
+        cutoff_idx = jnp.sum(cum < p[..., None], -1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, -1)
+        masked = jnp.where(logits < cutoff, -jnp.inf, logits)
+        sample = jax.random.categorical(key, masked, -1)
+        probs = jax.nn.softmax(masked, -1)
+        score = jnp.take_along_axis(probs, sample[..., None], -1)
+        return score, sample[..., None]
+
+    return passthrough("top_p_sampling", fn, [x, ps])
+
+
+def sequence_conv(x, filter, lengths=None, context_length=3, context_start=None,
+                  context_stride=1, name=None):
+    """Context-window sequence convolution (reference op: sequence_conv).
+    Dense (B, T, D) input; filter ((context_length*D), M)."""
+    start = -(context_length // 2) if context_start is None else context_start
+
+    def fn(v, w):
+        B, T, D = v.shape
+        cols = []
+        for o in range(context_length):
+            off = start + o
+            shifted = jnp.roll(v, -off, axis=1)
+            if off < 0:
+                mask = (jnp.arange(T) >= -off)[None, :, None]
+            else:
+                mask = (jnp.arange(T) < T - off)[None, :, None]
+            cols.append(jnp.where(mask, shifted, 0.0))
+        ctx = jnp.concatenate(cols, -1)  # (B, T, C*D)
+        return ctx @ w
+
+    return primitive("sequence_conv", fn, [x, filter])
+
+
+def im2sequence(x, kernels, strides=(1, 1), paddings=(0, 0, 0, 0),
+                out_stride=(1, 1), name=None):
+    """Image → patch sequence (reference op: im2sequence)."""
+    from ..nn.functional.common import unfold
+
+    out = unfold(x, list(kernels), list(strides), list(paddings[:2]))
+    v = unwrap(out)
+    return Tensor(jnp.transpose(unwrap(out), (0, 2, 1)).reshape(-1, v.shape[1]))
+
+
+# ---- metric ops -------------------------------------------------------------
+
+def accuracy(x, indices, label, name=None):
+    """Top-k accuracy from pre-computed top-k indices (reference op:
+    accuracy → (accuracy, correct, total))."""
+
+    def fn(xv, idx, lb):
+        hit = jnp.any(idx == lb.reshape(-1, 1), -1)
+        correct = jnp.sum(hit.astype(jnp.float32))
+        total = jnp.asarray(hit.shape[0], jnp.float32)
+        return correct / total, correct, total
+
+    return passthrough("accuracy", fn, [x, indices, label])
+
+
+def auc(x, label, stat_pos=None, stat_neg=None, curve="ROC",
+        num_thresholds=4095, slide_steps=1, name=None):
+    """Streaming AUC (reference op: auc): histogram pos/neg scores into
+    threshold buckets, trapezoid-integrate."""
+
+    def fn(xv, lb, sp, sn):
+        pos_score = xv[:, 1] if xv.ndim == 2 else xv
+        bucket = jnp.clip((pos_score * num_thresholds).astype(jnp.int32), 0,
+                          num_thresholds)
+        is_pos = (lb.reshape(-1) > 0).astype(jnp.float32)
+        pos_hist = jax.ops.segment_sum(is_pos, bucket, num_thresholds + 1)
+        neg_hist = jax.ops.segment_sum(1.0 - is_pos, bucket, num_thresholds + 1)
+        sp = sp + pos_hist
+        sn = sn + neg_hist
+        tot_pos = jnp.cumsum(sp[::-1])[::-1]
+        tot_neg = jnp.cumsum(sn[::-1])[::-1]
+        # trapezoid over thresholds descending
+        tp = jnp.concatenate([tot_pos, jnp.zeros(1)])
+        fp = jnp.concatenate([tot_neg, jnp.zeros(1)])
+        area = jnp.sum((fp[:-1] - fp[1:]) * (tp[:-1] + tp[1:]) / 2.0)
+        denom = jnp.maximum(tot_pos[0] * tot_neg[0], 1e-8)
+        return area / denom, sp, sn
+
+    zeros = jnp.zeros(num_thresholds + 1, jnp.float32)
+    sp = stat_pos if stat_pos is not None else Tensor(zeros)
+    sn = stat_neg if stat_neg is not None else Tensor(zeros)
+    return passthrough("auc", fn, [x, label, sp, sn])
+
+
+# ---- graph message passing --------------------------------------------------
+
+def send_u_recv(x, src_index, dst_index, reduce_op="SUM", out_size=None,
+                name=None):
+    """Gather source-node features, scatter-reduce to destinations
+    (reference op: send_u_recv / graph_send_recv)."""
+    n = out_size if out_size else unwrap(x).shape[0]
+
+    def fn(v, si, di):
+        msgs = v[si]
+        if reduce_op in ("SUM", "MEAN"):
+            out = jax.ops.segment_sum(msgs, di, n)
+            if reduce_op == "MEAN":
+                cnt = jax.ops.segment_sum(jnp.ones_like(di, v.dtype), di, n)
+                out = out / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (v.ndim - 1)]
+            return out
+        if reduce_op == "MAX":
+            return jax.ops.segment_max(msgs, di, n)
+        return jax.ops.segment_min(msgs, di, n)
+
+    return primitive("send_u_recv", fn, [x, src_index, dst_index])
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="ADD", reduce_op="SUM",
+                 out_size=None, name=None):
+    """Like send_u_recv with an edge-feature message op (reference op:
+    send_ue_recv)."""
+    n = out_size if out_size else unwrap(x).shape[0]
+
+    def fn(v, e, si, di):
+        msgs = v[si]
+        msgs = msgs + e if message_op == "ADD" else msgs * e
+        if reduce_op in ("SUM", "MEAN"):
+            out = jax.ops.segment_sum(msgs, di, n)
+            if reduce_op == "MEAN":
+                cnt = jax.ops.segment_sum(jnp.ones_like(di, v.dtype), di, n)
+                out = out / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (v.ndim - 1)]
+            return out
+        if reduce_op == "MAX":
+            return jax.ops.segment_max(msgs, di, n)
+        return jax.ops.segment_min(msgs, di, n)
+
+    return primitive("send_ue_recv", fn, [x, y, src_index, dst_index])
+
+
+def send_uv(x, y, src_index, dst_index, message_op="ADD", name=None):
+    """Edge message from both endpoints (reference op: send_uv)."""
+
+    def fn(xv, yv, si, di):
+        mu, mv = xv[si], yv[di]
+        return mu + mv if message_op == "ADD" else mu * mv
+
+    return primitive("send_uv", fn, [x, y, src_index, dst_index])
+
+
+def reindex_graph(x, neighbors, count, hashtable_value=None,
+                  hashtable_index=None, name=None):
+    """Compact global node ids to local ids (reference op: reindex_graph)."""
+    import numpy as np
+
+    xv = np.asarray(unwrap(x))
+    nb = np.asarray(unwrap(neighbors))
+    uniq = np.concatenate([xv, nb])
+    _, first_idx = np.unique(uniq, return_index=True)
+    order = uniq[np.sort(first_idx)]
+    lut = {int(g): i for i, g in enumerate(order)}
+    re_nb = np.asarray([lut[int(g)] for g in nb], dtype=nb.dtype)
+    cnt = np.asarray(unwrap(count))
+    re_src = np.repeat(np.arange(len(xv), dtype=nb.dtype), cnt)
+    return Tensor(re_nb), Tensor(re_src), Tensor(order)
+
+
+def graph_sample_neighbors(row, colptr, x, eids=None, perm_buffer=None,
+                           sample_size=-1, return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Uniform neighbor sampling over CSC graph (reference op:
+    graph_sample_neighbors). Host-side numpy (sampling is data-dependent
+    control flow — it stays off the TPU by design, like the reference's CPU
+    kernel)."""
+    import numpy as np
+
+    r = np.asarray(unwrap(row))
+    cp = np.asarray(unwrap(colptr))
+    nodes = np.asarray(unwrap(x))
+    out_nb, out_cnt = [], []
+    rs = np.random.RandomState(0)
+    for nid in nodes:
+        lo, hi = int(cp[nid]), int(cp[nid + 1])
+        neigh = r[lo:hi]
+        if sample_size > 0 and len(neigh) > sample_size:
+            neigh = rs.choice(neigh, sample_size, replace=False)
+        out_nb.append(neigh)
+        out_cnt.append(len(neigh))
+    nb = np.concatenate(out_nb) if out_nb else np.zeros(0, r.dtype)
+    return Tensor(nb), Tensor(np.asarray(out_cnt, np.int32))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, x, eids=None,
+                              sample_size=-1, return_eids=False, name=None):
+    """Weight-proportional neighbor sampling (reference op:
+    weighted_sample_neighbors)."""
+    import numpy as np
+
+    r = np.asarray(unwrap(row))
+    cp = np.asarray(unwrap(colptr))
+    w = np.asarray(unwrap(edge_weight))
+    nodes = np.asarray(unwrap(x))
+    out_nb, out_cnt = [], []
+    rs = np.random.RandomState(0)
+    for nid in nodes:
+        lo, hi = int(cp[nid]), int(cp[nid + 1])
+        neigh, wt = r[lo:hi], w[lo:hi]
+        if sample_size > 0 and len(neigh) > sample_size:
+            p = wt / wt.sum()
+            neigh = rs.choice(neigh, sample_size, replace=False, p=p)
+        out_nb.append(neigh)
+        out_cnt.append(len(neigh))
+    nb = np.concatenate(out_nb) if out_nb else np.zeros(0, r.dtype)
+    return Tensor(nb), Tensor(np.asarray(out_cnt, np.int32))
+
+
+def graph_khop_sampler(row, colptr, x, eids=None, sample_sizes=(5,),
+                       return_eids=False, name=None):
+    """Multi-hop sampling built on graph_sample_neighbors (reference op:
+    graph_khop_sampler)."""
+    import numpy as np
+
+    cur = x
+    all_nb = []
+    for k in sample_sizes:
+        nb, cnt = graph_sample_neighbors(row, colptr, cur, sample_size=k)
+        all_nb.append(np.asarray(unwrap(nb)))
+        cur = nb
+    merged = np.concatenate(all_nb) if all_nb else np.zeros(0, np.int64)
+    return Tensor(merged), Tensor(np.asarray([len(a) for a in all_nb], np.int32))

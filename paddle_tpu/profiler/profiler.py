@@ -68,10 +68,13 @@ class RecordEvent:
         if self._t0 is None:
             return
         t1 = time.perf_counter_ns()
+        from ..ops import registry
+
         with _global_lock:
             _global_events.append(
                 {"name": self.name, "ts": self._t0 / 1e3, "dur": (t1 - self._t0) / 1e3,
-                 "tid": threading.get_ident() % 100000}
+                 "tid": threading.get_ident() % 100000,
+                 "cat": registry.profiler_tag(self.name)}
             )
         self._t0 = None
 

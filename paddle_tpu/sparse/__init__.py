@@ -159,9 +159,12 @@ def to_sparse_coo(dense: Tensor, sparse_dim=None) -> SparseCooTensor:
     """Dense -> COO (reference Tensor.to_sparse_coo)."""
     arr = np.asarray(dense.numpy())
     sparse_dim = sparse_dim or arr.ndim
-    if sparse_dim != arr.ndim:
-        raise NotImplementedError("hybrid sparse_dim not supported")
-    idx = np.stack(np.nonzero(arr))
+    if sparse_dim == arr.ndim:
+        idx = np.stack(np.nonzero(arr))
+    else:
+        # hybrid: sparse over the leading dims, dense trailing value blocks
+        red = np.abs(arr).sum(axis=tuple(range(sparse_dim, arr.ndim)))
+        idx = np.stack(np.nonzero(red))
     from ..ops.manipulation import gather_nd
 
     vals = gather_nd(dense, Tensor(idx.T.astype(np.int64)))
@@ -216,3 +219,319 @@ tanh = _unary("sparse_tanh", jnp.tanh)
 sqrt = _unary("sparse_sqrt", jnp.sqrt)
 abs = _unary("sparse_abs", jnp.abs)  # noqa: A001
 neg = _unary("sparse_neg", jnp.negative)
+
+
+acos = _unary("sparse_acos", jnp.arccos)
+acosh = _unary("sparse_acosh", jnp.arccosh)
+asin = _unary("sparse_asin", jnp.arcsin)
+asinh = _unary("sparse_asinh", jnp.arcsinh)
+atan = _unary("sparse_atan", jnp.arctan)
+atanh = _unary("sparse_atanh", jnp.arctanh)
+expm1 = _unary("sparse_expm1", jnp.expm1)
+log1p = _unary("sparse_log1p", jnp.log1p)
+sinh = _unary("sparse_sinh", jnp.sinh)
+tan = _unary("sparse_tan", jnp.tan)
+square = _unary("sparse_square", jnp.square)
+relu6 = _unary("sparse_relu6", lambda v: jnp.clip(v, 0.0, 6.0))
+isnan = _unary("sparse_isnan", jnp.isnan)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = _as_coo(x)
+    out = primitive("sparse_leaky_relu",
+                    lambda v: jnp.where(v >= 0, v, negative_slope * v),
+                    [x.values_t])
+    return SparseCooTensor(x.indices_t, out, x.shape)
+
+
+def pow(x, factor, name=None):  # noqa: A001 — paddle.sparse.pow API name
+    x = _as_coo(x)
+    out = primitive("sparse_pow", lambda v: jnp.power(v, factor), [x.values_t])
+    return SparseCooTensor(x.indices_t, out, x.shape)
+
+
+def scale(x, scale_val=1.0, bias=0.0, bias_after_scale=True, name=None):
+    x = _as_coo(x)
+    fn = (lambda v: v * scale_val + bias) if bias_after_scale else \
+        (lambda v: (v + bias) * scale_val)
+    return SparseCooTensor(x.indices_t, primitive("sparse_scale", fn, [x.values_t]),
+                           x.shape)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..base import dtype as dtype_mod
+
+    x = _as_coo(x)
+    idx = x.indices_t
+    vals = x.values_t
+    if index_dtype is not None:
+        idx = Tensor(jnp.asarray(idx._value).astype(dtype_mod.np_dtype(index_dtype)))
+    if value_dtype is not None:
+        vals = primitive("sparse_cast",
+                         lambda v: v.astype(dtype_mod.np_dtype(value_dtype)),
+                         [vals])
+    return SparseCooTensor(idx, vals, x.shape)
+
+
+def divide_scalar(x, scalar, name=None):
+    return scale(x, 1.0 / scalar)
+
+
+def _binary_vals(name, fn):
+    def op(x, y, name=None):
+        xc, yc = _as_coo(x), _as_coo(y)
+        xd, yd = xc.to_dense(), yc.to_dense()
+        out = primitive(name, fn, [xd, yd])
+        return to_sparse_coo(out, sparse_dim=xc.indices_t.shape[0])
+
+    op.__name__ = name
+    return op
+
+
+subtract = _binary_vals("sparse_subtract", lambda a, b: a - b)
+multiply = _binary_vals("sparse_multiply", lambda a, b: a * b)
+divide = _binary_vals("sparse_divide", lambda a, b: a / b)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) with sparse x (reference sparse op: addmm)."""
+    prod = matmul(x, y)
+    from ..core.dispatch import primitive as _p
+
+    return _p("sparse_addmm", lambda i, m: beta * i + alpha * m,
+              [input, prod])
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(x @ y) sampled at mask's sparsity pattern (reference sparse op:
+    masked_matmul — SDDMM). Only the nnz dot products are computed."""
+    mc = _as_coo(mask) if not isinstance(mask, SparseCsrTensor) else mask.to_sparse_coo()
+
+    def fn(xd, yd, idx):
+        rows, cols = idx[0], idx[1]
+        return jnp.einsum("nd,nd->n", xd[rows], yd[:, cols].T)
+
+    vals = primitive("sparse_masked_matmul", fn, [x, y, mc.indices_t])
+    return SparseCooTensor(mc.indices_t, vals, [x.shape[0] if hasattr(x, 'shape') else mc.shape[0], mc.shape[1]])
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix × dense vector (reference sparse op: mv)."""
+    xc = _as_coo(x)
+
+    def fn(idx, vals, v):
+        rows, cols = idx[0], idx[1]
+        contrib = vals * v[cols]
+        return jax.ops.segment_sum(contrib, rows, xc.shape[0])
+
+    return primitive("sparse_mv", fn, [xc.indices_t, xc.values_t, vec])
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices (reference sparse op: coalesce)."""
+    xc = _as_coo(x)
+    idx = np.asarray(xc.indices_t.numpy())
+    nd = idx.shape[0]
+    keys = np.ravel_multi_index(tuple(idx), tuple(xc.shape[:nd]))
+    uniq, inv = np.unique(keys, return_inverse=True)
+
+    def fn(vals):
+        return jax.ops.segment_sum(vals, jnp.asarray(inv), len(uniq))
+
+    vals = primitive("sparse_coalesce", fn, [xc.values_t])
+    new_idx = np.stack(np.unravel_index(uniq, tuple(xc.shape[:nd])))
+    return SparseCooTensor(new_idx.astype(np.int64), vals, xc.shape, coalesced=True)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    xc = _as_coo(x)
+    vals = primitive("sparse_full_like",
+                     lambda v: jnp.full_like(v, fill_value), [xc.values_t])
+    return SparseCooTensor(xc.indices_t, vals, xc.shape)
+
+
+def indices(x, name=None):
+    return _as_coo(x).indices()
+
+
+def values(x, name=None):
+    return x.values()
+
+
+def to_dense(x, name=None):
+    return x.to_dense()
+
+
+def to_sparse_csr(x, name=None):
+    return _as_coo(x).to_sparse_csr()
+
+
+def mask_as(x, mask, name=None):
+    """Keep x's entries at mask's sparsity pattern (reference sparse op:
+    mask_as)."""
+    mc = _as_coo(mask)
+    nd = mc.indices_t.shape[0]
+
+    def fn(xd, idx):
+        return xd[tuple(idx[d] for d in range(nd))]
+
+    vals = primitive("sparse_mask_as", fn, [x, mc.indices_t])
+    return SparseCooTensor(mc.indices_t, vals, mc.shape)
+
+
+def reshape(x, shape, name=None):
+    xc = _as_coo(x)
+    return to_sparse_coo(
+        primitive("sparse_reshape", lambda v: v.reshape(shape), [xc.to_dense()]),
+        sparse_dim=len([s for s in shape]))
+
+
+def transpose(x, perm, name=None):
+    xc = _as_coo(x)
+    idx = np.asarray(xc.indices_t.numpy())
+    new_idx = idx[list(perm)]
+    new_shape = [xc.shape[p] for p in perm]
+    return SparseCooTensor(new_idx, xc.values_t, new_shape)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    xc = _as_coo(x)
+    idx = np.asarray(xc.indices_t.numpy())
+    keep = np.ones(idx.shape[1], bool)
+    offs = {int(a): int(s) for a, s in zip(axes, starts)}
+    new_shape = list(xc.shape)
+    for a, s, e in zip(axes, starts, ends):
+        a, s, e = int(a), int(s), int(e)
+        e = min(e, xc.shape[a])
+        keep &= (idx[a] >= s) & (idx[a] < e)
+        new_shape[a] = e - s
+    sel = np.nonzero(keep)[0]
+    new_idx = idx[:, sel].copy()
+    for a in offs:
+        new_idx[a] -= offs[a]
+    from ..ops.manipulation import gather
+
+    vals = gather(xc.values_t, Tensor(sel.astype(np.int64)))
+    return SparseCooTensor(new_idx, vals, new_shape)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    xc = _as_coo(x)
+    from ..ops import math as _m
+
+    return _m.sum(xc.to_dense(), axis=axis, keepdim=keepdim)
+
+
+def softmax(x, axis=-1, name=None):
+    """Sparse softmax over the last axis within each row's nnz (reference
+    sparse op: softmax on CSR)."""
+    if isinstance(x, SparseCsrTensor):
+        rows = x._row_ids()
+        n_rows = x.shape[0]
+
+        def fn(vals):
+            rmax = jax.ops.segment_max(vals, jnp.asarray(rows), n_rows)
+            ex = jnp.exp(vals - rmax[jnp.asarray(rows)])
+            denom = jax.ops.segment_sum(ex, jnp.asarray(rows), n_rows)
+            return ex / denom[jnp.asarray(rows)]
+
+        return SparseCsrTensor(x.crows_t, x.cols_t,
+                               primitive("sparse_softmax", fn, [x.values_t]),
+                               x.shape)
+    xc = _as_coo(x)
+    return to_sparse_coo(
+        primitive("sparse_softmax_dense",
+                  lambda d: jax.nn.softmax(jnp.where(d == 0, -jnp.inf, d), axis),
+                  [xc.to_dense()]),
+        sparse_dim=xc.indices_t.shape[0])
+
+
+def maxpool(x, kernel_sizes, paddings=(0, 0, 0), strides=(1, 1, 1), name=None):
+    """Sparse 3-D max pooling (reference sparse op: maxpool on NDHWC COO):
+    densify → reduce_window → re-sparsify (submanifold behavior approximated)."""
+    xc = _as_coo(x)
+    from jax import lax
+
+    k = tuple(kernel_sizes)
+    s = tuple(strides)
+    p = tuple(paddings)
+
+    def fn(d):
+        window = (1,) + k + (1,)
+        stride = (1,) + s + (1,)
+        pads = ((0, 0),) + tuple((pi, pi) for pi in p) + ((0, 0),)
+        return lax.reduce_window(d, -jnp.inf, lax.max, window, stride, pads)
+
+    dense = primitive("sparse_maxpool", fn, [xc.to_dense()])
+    out = Tensor(jnp.where(jnp.isneginf(dense._value), 0.0, dense._value))
+    return to_sparse_coo(out, sparse_dim=4)
+
+
+def conv3d(x, kernel, bias=None, stride=(1, 1, 1), padding=(0, 0, 0),
+           dilation=(1, 1, 1), groups=1, subm=False, key=None, name=None):
+    """Sparse 3-D convolution (reference sparse ops: conv3d /
+    conv3d_implicit_gemm). NDHWC COO input, DHWCM kernel. TPU path: densify
+    and run the XLA conv (the MXU eats dense convs; true gather-scatter
+    sparse conv only wins at extreme sparsity on CPU-style hardware), then
+    re-sparsify — submanifold (subm=True) masks outputs to input sites."""
+    xc = _as_coo(x)
+
+    def fn(d, w, *b):
+        out = jax.lax.conv_general_dilated(
+            d, w, window_strides=tuple(stride),
+            padding=tuple((p, p) for p in padding),
+            rhs_dilation=tuple(dilation),
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            feature_group_count=groups)
+        if b:
+            out = out + b[0]
+        return out
+
+    args = [xc.to_dense(), kernel] + ([bias] if bias is not None else [])
+    dense_out = primitive("sparse_conv3d", fn, args)
+    if subm:
+        idx = np.asarray(xc.indices_t.numpy())
+
+        def mask_fn(o):
+            m = jnp.zeros(o.shape[:-1], bool).at[
+                tuple(idx[d] for d in range(idx.shape[0]))].set(True)
+            return jnp.where(m[..., None], o, 0.0)
+
+        dense_out = primitive("sparse_subm_mask", mask_fn, [dense_out])
+    return to_sparse_coo(dense_out, sparse_dim=4)
+
+
+conv3d_implicit_gemm = conv3d
+
+
+def batch_norm_(x, running_mean, running_var, weight, bias, training=False,
+                momentum=0.9, epsilon=1e-5, data_format="NDHWC",
+                use_global_stats=False, name=None):
+    """BatchNorm over sparse values (reference sparse op: batch_norm_):
+    normalize the nnz values per channel."""
+    xc = _as_coo(x)
+
+    def fn(v, rm, rv, w, b):
+        if training and not use_global_stats:
+            mean = v.mean(0)
+            var = v.var(0)
+        else:
+            mean, var = rm, rv
+        out = (v - mean) / jnp.sqrt(var + epsilon) * w + b
+        return out
+
+    vals = primitive("sparse_batch_norm", fn,
+                     [xc.values_t, running_mean, running_var, weight, bias])
+    return SparseCooTensor(xc.indices_t, vals, xc.shape)
+
+
+sync_batch_norm_ = batch_norm_
+
+
+def fused_attention(query, key, value, sparse_mask, key_padding_mask=None,
+                    attn_mask=None, name=None):
+    """Attention with a sparse-CSR score mask (reference sparse op:
+    fused_attention): scores are only computed/kept at mask nnz."""
+    from ..nn.functional.flash_attention import sparse_attention as _sa
+
+    return _sa(query, key, value, sparse_mask.crows_t, sparse_mask.cols_t)
