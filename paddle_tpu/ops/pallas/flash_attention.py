@@ -1,14 +1,15 @@
-"""Flash attention Pallas TPU kernel.
+"""Flash attention Pallas TPU kernels, forward AND backward.
 
 TPU-native replacement for the reference's FlashAttention-2 integration
-(third_party/flashattn + paddle/phi/kernels/gpu/flash_attn_kernel.cu): an
-online-softmax tiled kernel. Forward runs in Pallas (MXU matmuls on
-[block_q, d] x [d, block_k] tiles, f32 accumulators in VMEM); backward uses
-recompute + the XLA composition's VJP (a Pallas backward lands in a later
-round — XLA's fused backward is already bandwidth-bound-competitive).
+(third_party/flashattn + paddle/phi/kernels/gpu/flash_attn_kernel.cu fwd,
+flash_attn_grad_kernel.cu bwd): online-softmax tiled forward saving the
+per-row logsumexp, and the standard two-pass recompute backward — a dq pass
+(per q-block, loop over k-blocks) and a dk/dv pass (per k-block, loop over
+q-blocks), each recomputing the probabilities from (q, k, lse) so attention
+scores are never materialized at O(S²) in HBM.
 
 Layout: [batch, seq, heads, head_dim] (paddle convention), internally
-[batch*heads, seq, head_dim].
+[batch*heads, seq, head_dim]. All dots hit the MXU with f32 accumulators.
 """
 from __future__ import annotations
 
@@ -34,7 +35,8 @@ def available() -> bool:
     return get_flag("use_pallas_kernels") and _on_tpu()
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k, seq_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q,
+                block_k, seq_k):
     from jax.experimental import pallas as pl
 
     j = pl.program_id(1)  # q-block index
@@ -73,6 +75,106 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k, 
         nk_eff = nk
     m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    # per-row logsumexp, saved for the recompute backward
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale, causal, block_q, block_k, seq_k):
+    """dQ pass: one q-block per program, loop over k-blocks.
+    dS = P ∘ (dO·Vᵀ − Δ); dQ = scale · dS·K with P recomputed from lse."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    d = q.shape[-1]
+    nk = seq_k // block_k
+
+    q_pos = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(i, dq):
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    if causal:
+        nk_eff = jnp.minimum(nk, ((j + 1) * block_q + block_k - 1) // block_k)
+    else:
+        nk_eff = nk
+    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                    dv_ref, *, scale, causal, block_q, block_k, seq_q):
+    """dK/dV pass: one k-block per program, loop over q-blocks.
+    dV = Pᵀ·dO; dK = scale · dSᵀ·Q."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)  # k-block index
+    k = k_ref[0].astype(jnp.float32)   # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+    nq = seq_q // block_q
+
+    k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(jq, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(jq * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(jq * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(jq * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(jq * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = jq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    if causal:
+        # q-blocks before this k-block are fully masked: start at the first
+        # q-block whose end reaches the k-block start
+        jq0 = (i * block_k) // block_q
+    else:
+        jq0 = 0
+    dk, dv = jax.lax.fori_loop(
+        jq0, nq, body,
+        (jnp.zeros((block_k, d), jnp.float32), jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _blocks(sq, sk, block_q, block_k):
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    while sq % block_q:
+        block_q //= 2
+    while sk % block_k:
+        block_k //= 2
+    return max(block_q, 1), max(block_k, 1)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret"))
@@ -85,31 +187,93 @@ def _flash_fwd(q, k, v, causal, scale, block_q=256, block_k=512, interpret=False
     kt = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
     vt = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
 
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    while sq % block_q:
-        block_q //= 2
-    while sk % block_k:
-        block_k //= 2
-    block_q = max(block_q, 1)
-    block_k = max(block_k, 1)
+    block_q, block_k = _blocks(sq, sk, block_q, block_k)
 
     grid = (b * h, sq // block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_k=sk
         ),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
         interpret=interpret,
     )(qt, kt, vt)
-    return jnp.moveaxis(out.reshape(b, h, sq, d), 1, 2)
+    return jnp.moveaxis(out.reshape(b, h, sq, d), 1, 2), lse
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret"))
+def _flash_bwd(q, k, v, o, lse, do, causal, scale, block_q=256, block_k=512,
+               interpret=False):
+    """Two-pass recompute backward (reference capability:
+    paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu). Δ = rowsum(dO ∘ O) is
+    a cheap XLA reduction; the O(S²) recompute stays in VMEM tiles."""
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qt = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
+    kt = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
+    vt = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
+    ot = jnp.moveaxis(o, 2, 1).reshape(b * h, sq, d)
+    dot_ = jnp.moveaxis(do, 2, 1).reshape(b * h, sq, d)
+    delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32), -1)
+
+    block_q, block_k = _blocks(sq, sk, block_q, block_k)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=sk),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=sq),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse, delta)
+
+    unflat = lambda t, s: jnp.moveaxis(t.reshape(b, h, s, d), 1, 2)
+    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
 
 
 def _xla_reference(q, k, v, causal, scale):
@@ -124,17 +288,17 @@ def _xla_reference(q, k, v, causal, scale):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention_value(q, k, v, causal=False, scale=1.0, interpret=False):
-    return _flash_fwd(q, k, v, causal, scale, interpret=interpret)
+    return _flash_fwd(q, k, v, causal, scale, interpret=interpret)[0]
 
 
 def _fa_fwd(q, k, v, causal, scale, interpret):
-    return _flash_fwd(q, k, v, causal, scale, interpret=interpret), (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, scale, interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _xla_reference(q, k, v, causal, scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, interpret=interpret)
 
 
 flash_attention_value.defvjp(_fa_fwd, _fa_bwd)
@@ -143,4 +307,12 @@ flash_attention_value.defvjp(_fa_fwd, _fa_bwd)
 def flash_attention_interpret_test(q, k, v, causal):
     """Test hook: run the pallas kernel in interpret mode on CPU."""
     scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_fwd(q, k, v, causal, scale, interpret=True)
+    return _flash_fwd(q, k, v, causal, scale, interpret=True)[0]
+
+
+def flash_attention_grad_interpret_test(q, k, v, do, causal):
+    """Test hook: full fwd+bwd through the Pallas kernels in interpret mode,
+    for parity checks against the XLA composition's VJP."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _flash_fwd(q, k, v, causal, scale, interpret=True)
+    return out, _flash_bwd(q, k, v, out, lse, do, causal, scale, interpret=True)

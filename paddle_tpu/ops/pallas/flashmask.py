@@ -1,0 +1,306 @@
+"""FlashMask: column-sparse-mask flash attention, Pallas TPU kernels.
+
+Rebuild of the reference's flashmask_attention
+(python/paddle/nn/functional/flash_attention.py:1098 + its CUDA kernels):
+the attention mask is represented column-compressed — for every key column
+j, `startend_row_indices` gives the query-row interval(s) that are masked.
+This covers causal-document masks, sliding windows, shared prefixes and
+arbitrary block layouts at O(S) mask storage instead of O(S²).
+
+Kernels mirror ops/pallas/flash_attention.py (online-softmax forward saving
+lse; two-pass recompute backward) with the interval mask applied per tile:
+the (block_q × block_k) start/end slabs load as VMEM vectors and the mask is
+an elementwise compare — no O(S²) mask tensor ever exists in HBM.
+
+Index layouts (matching the reference contract):
+- causal, last dim 1: [LTS]            — rows >= LTS[j] masked (plus causal)
+- causal, last dim 2: [LTS, LTE]       — rows in [LTS, LTE) masked
+- full,   last dim 4: [LTS, LTE, UTS, UTE]
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _tile_mask(idx_blk, q_pos, causal, ncol, block_q):
+    """Disallowed-mask for one (block_q, block_k) tile from the column
+    intervals idx_blk [block_k, ncol]."""
+    if causal:
+        if ncol == 1:
+            lts = idx_blk[:, 0][None, :]
+            masked = q_pos >= lts
+        else:
+            lts = idx_blk[:, 0][None, :]
+            lte = idx_blk[:, 1][None, :]
+            masked = (q_pos >= lts) & (q_pos < lte)
+    else:
+        lts = idx_blk[:, 0][None, :]
+        lte = idx_blk[:, 1][None, :]
+        uts = idx_blk[:, 2][None, :]
+        ute = idx_blk[:, 3][None, :]
+        masked = ((q_pos >= lts) & (q_pos < lte)) | ((q_pos >= uts) & (q_pos < ute))
+    return masked
+
+
+def _fm_fwd_kernel(q_ref, k_ref, v_ref, idx_ref, o_ref, lse_ref, *, scale,
+                   causal, ncol, block_q, block_k, seq_k):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    d = q.shape[-1]
+    nk = seq_k // block_k
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    q_rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        idx = idx_ref[0, pl.dslice(i * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        disallowed = _tile_mask(idx, q_rows, causal, ncol, block_q)
+        if causal:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            disallowed = disallowed | (q_rows < k_pos)
+        s = jnp.where(disallowed, NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        # fully-masked rows: m stays NEG_INF, exp(NEG_INF - NEG_INF)=1 would
+        # poison l; zero those columns explicitly
+        p = jnp.where(disallowed, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        nk_eff = jnp.minimum(nk, ((j + 1) * block_q + block_k - 1) // block_k)
+    else:
+        nk_eff = nk
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, *, scale, causal, ncol, block_q, block_k, seq_k):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    d = q.shape[-1]
+    nk = seq_k // block_k
+    q_rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(i, dq):
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        idx = idx_ref[0, pl.dslice(i * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        disallowed = _tile_mask(idx, q_rows, causal, ncol, block_q)
+        if causal:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            disallowed = disallowed | (q_rows < k_pos)
+        p = jnp.where(disallowed, 0.0, jnp.exp(s - lse[:, None]))
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    nk_eff = jnp.minimum(nk, ((j + 1) * block_q + block_k - 1) // block_k) if causal else nk
+    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _fm_bwd_dkv_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, *, scale, causal, ncol, block_q,
+                       block_k, seq_q):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    idx = idx_ref[0]
+    d = k.shape[-1]
+    nq = seq_q // block_q
+    k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(jq, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(jq * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(jq * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(jq * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(jq * block_q, block_q)]
+        q_rows = jq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        disallowed = _tile_mask(idx, q_rows, causal, ncol, block_q)
+        if causal:
+            disallowed = disallowed | (q_rows < k_pos)
+        p = jnp.where(disallowed, 0.0, jnp.exp(s - lse[:, None]))
+        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    jq0 = (i * block_k) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        jq0, nq, body,
+        (jnp.zeros((block_k, d), jnp.float32), jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _prep(q, k, v, idx):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    ncol = idx.shape[-1]
+    qt = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
+    kt = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
+    vt = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
+    # idx (B, Hm, Sk, ncol) with Hm in {1, h} → (b*h, sk, ncol)
+    if idx.shape[1] == 1 and h > 1:
+        idx = jnp.broadcast_to(idx, (b, h, sk, ncol))
+    it = idx.reshape(b * h, sk, ncol).astype(jnp.int32)
+    return qt, kt, vt, it, (b, sq, sk, h, d, ncol)
+
+
+def _fm_blocks(sq, sk, block_q=256, block_k=512):
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    while sq % block_q:
+        block_q //= 2
+    while sk % block_k:
+        block_k //= 2
+    return max(block_q, 1), max(block_k, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret"))
+def _fm_fwd(q, k, v, idx, causal, scale, interpret=False):
+    from jax.experimental import pallas as pl
+
+    qt, kt, vt, it, (b, sq, sk, h, d, ncol) = _prep(q, k, v, idx)
+    block_q, block_k = _fm_blocks(sq, sk)
+    out, lse = pl.pallas_call(
+        functools.partial(_fm_fwd_kernel, scale=scale, causal=causal,
+                          ncol=ncol, block_q=block_q, block_k=block_k, seq_k=sk),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, ncol), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, it)
+    return jnp.moveaxis(out.reshape(b, h, sq, d), 1, 2), lse
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret"))
+def _fm_bwd(q, k, v, idx, o, lse, do, causal, scale, interpret=False):
+    from jax.experimental import pallas as pl
+
+    qt, kt, vt, it, (b, sq, sk, h, d, ncol) = _prep(q, k, v, idx)
+    ot = jnp.moveaxis(o, 2, 1).reshape(b * h, sq, d)
+    dot_ = jnp.moveaxis(do, 2, 1).reshape(b * h, sq, d)
+    delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32), -1)
+    block_q, block_k = _fm_blocks(sq, sk)
+
+    dq = pl.pallas_call(
+        functools.partial(_fm_bwd_dq_kernel, scale=scale, causal=causal,
+                          ncol=ncol, block_q=block_q, block_k=block_k, seq_k=sk),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, ncol), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qt, kt, vt, it, dot_, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_fm_bwd_dkv_kernel, scale=scale, causal=causal,
+                          ncol=ncol, block_q=block_q, block_k=block_k, seq_q=sq),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, ncol), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, it, dot_, lse, delta)
+
+    unflat = lambda t, s: jnp.moveaxis(t.reshape(b, h, s, d), 1, 2)
+    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flashmask_value(q, k, v, startend_row_indices, causal=True, scale=1.0,
+                    interpret=False):
+    return _fm_fwd(q, k, v, startend_row_indices, causal, scale,
+                   interpret=interpret)[0]
+
+
+def _fm_vjp_fwd(q, k, v, idx, causal, scale, interpret):
+    out, lse = _fm_fwd(q, k, v, idx, causal, scale, interpret=interpret)
+    return out, (q, k, v, idx, out, lse)
+
+
+def _fm_vjp_bwd(causal, scale, interpret, res, g):
+    q, k, v, idx, out, lse = res
+    dq, dk, dv = _fm_bwd(q, k, v, idx, out, lse, g, causal, scale,
+                         interpret=interpret)
+    return dq, dk, dv, None
+
+
+flashmask_value.defvjp(_fm_vjp_fwd, _fm_vjp_bwd)

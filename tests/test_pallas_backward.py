@@ -1,0 +1,143 @@
+"""Interpret-mode parity tests for the Pallas flash-attention BACKWARD
+kernels and the flashmask forward/backward kernels (reference capability:
+paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu and
+python/paddle/nn/functional/flash_attention.py:1098 flashmask_attention).
+The XLA dense composition is the oracle; the Pallas kernels run in
+interpret mode on the CPU test platform."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_dense(q, k, v, causal, scale, disallowed=None):
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    s, t = logits.shape[-2], logits.shape[-1]
+    if causal:
+        mask = jnp.tril(jnp.ones((s, t), bool), t - s)
+        logits = jnp.where(mask, logits, -1e30)
+    if disallowed is not None:
+        logits = jnp.where(disallowed, -1e30, logits)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(1, 16, 2, 8), (2, 32, 2, 8)])
+def test_flash_backward_matches_xla_vjp(causal, shape):
+    from paddle_tpu.ops.pallas.flash_attention import (
+        flash_attention_grad_interpret_test,
+    )
+
+    rs = np.random.RandomState(0)
+    b, s, h, d = shape
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    do = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    scale = 1.0 / np.sqrt(d)
+
+    out, (dq, dk, dv) = flash_attention_grad_interpret_test(q, k, v, do, causal)
+
+    ref_out, vjp = jax.vjp(lambda q, k, v: _xla_dense(q, k, v, causal, scale),
+                           q, k, v)
+    rdq, rdk, rdv = vjp(do)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), rtol=2e-3, atol=2e-3)
+
+
+def _doc_mask_indices(b, s, split):
+    """Causal document mask via LTS: key cols in doc1 mask rows >= split."""
+    start = np.full((b, 1, s, 1), s, np.int32)
+    start[:, :, :split, 0] = split
+    return start
+
+
+def test_flashmask_forward_matches_dense():
+    from paddle_tpu.ops.pallas.flashmask import _fm_fwd
+
+    rs = np.random.RandomState(0)
+    b, s, h, d = 1, 16, 2, 8
+    split = 8
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    idx = jnp.asarray(_doc_mask_indices(b, s, split))
+    scale = 1.0 / np.sqrt(d)
+
+    out, lse = _fm_fwd(q, q, q, idx, True, scale, interpret=True)
+
+    rows = np.arange(s)[:, None]
+    disallowed = rows >= np.broadcast_to(idx[0, 0, :, 0], (s, s))
+    ref = _xla_dense(q, q, q, True, scale, jnp.asarray(disallowed)[None, None])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_flashmask_backward_matches_dense_vjp():
+    from paddle_tpu.ops.pallas.flashmask import _fm_bwd, _fm_fwd
+
+    rs = np.random.RandomState(1)
+    b, s, h, d = 1, 16, 2, 8
+    split = 8
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    do = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    idx = jnp.asarray(_doc_mask_indices(b, s, split))
+    scale = 1.0 / np.sqrt(d)
+
+    out, lse = _fm_fwd(q, k, v, idx, True, scale, interpret=True)
+    dq, dk, dv = _fm_bwd(q, k, v, idx, out, lse, do, True, scale, interpret=True)
+
+    rows = np.arange(s)[:, None]
+    disallowed = jnp.asarray(rows >= np.broadcast_to(idx[0, 0, :, 0], (s, s)))[None, None]
+    ref_out, vjp = jax.vjp(
+        lambda q, k, v: _xla_dense(q, k, v, True, scale, disallowed), q, k, v)
+    rdq, rdk, rdv = vjp(do)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), rtol=2e-3, atol=2e-3)
+
+
+def test_flashmask_full_mode_two_intervals():
+    """Non-causal 4-column layout: band mask via lower+upper intervals."""
+    from paddle_tpu.ops.pallas.flashmask import _fm_fwd
+
+    rs = np.random.RandomState(2)
+    b, s, h, d = 1, 16, 1, 8
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    # sliding window of width 4: for key col j mask rows >= j+4 (lower) and
+    # rows < j-3 → upper interval [0, j-3)
+    lts = np.minimum(np.arange(s) + 4, s)
+    lte = np.full(s, s)
+    uts = np.zeros(s)
+    ute = np.maximum(np.arange(s) - 3, 0)
+    idx = np.stack([lts, lte, uts, ute], -1).astype(np.int32)[None, None]
+    scale = 1.0 / np.sqrt(d)
+
+    out, _ = _fm_fwd(q, q, q, jnp.asarray(idx), False, scale, interpret=True)
+
+    rows = np.arange(s)[:, None]
+    cols = np.arange(s)[None, :]
+    disallowed = (np.abs(rows - cols) > 3)
+    ref = _xla_dense(q, q, q, False, scale, jnp.asarray(disallowed)[None, None])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_flashmask_value_custom_vjp_grad_flows():
+    from paddle_tpu.ops.pallas.flashmask import flashmask_value
+
+    rs = np.random.RandomState(3)
+    b, s, h, d = 1, 16, 1, 8
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32))
+    idx = jnp.asarray(_doc_mask_indices(b, s, 8))
+
+    def loss(q):
+        return flashmask_value(q, q, q, idx, True, 1.0 / np.sqrt(d), True).sum()
+
+    g = jax.grad(loss)(q)
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).max() > 0
