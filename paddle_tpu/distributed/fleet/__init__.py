@@ -39,6 +39,7 @@ from .pipeline_schedules import (  # noqa: F401
     PipelinedStack,
     forward_backward_pipeline_1f1b,
     forward_backward_pipeline_interleave,
+    forward_backward_pipeline_rotation,
 )
 
 meta_parallel = mpu  # submodule alias: fleet.meta_parallel.* layer surface

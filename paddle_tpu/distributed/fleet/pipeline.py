@@ -108,6 +108,8 @@ class PipelineLayer(Layer):
         loss_fn=None,
         seg_method="uniform",
         recompute_interval=0,
+        num_virtual_pipeline_stages: int = 1,
+        num_microbatches: Optional[int] = None,
         **kwargs,
     ):
         super().__init__()
@@ -120,8 +122,58 @@ class PipelineLayer(Layer):
         descs = list(layers)
         self._segment_bounds = SegmentLayers(descs, self._num_stages, seg_method).do_segment()
         self._shared_layers = {}
+
+        # Heterogeneous-stage schedule routing: the longest homogeneous run
+        # of one LayerDesc class (the decoder trunk) runs under the SPMD
+        # rotation schedule (PipelinedStack — real stage parallelism); the
+        # pre/post edge segments (embedding / final LN / LM head, reference
+        # first/last-stage placement) execute outside the rotation with their
+        # params sharded over the pp axis (memory parity with placement).
+        self._stack = None
+        self._stack_range = (0, 0)
+        mesh = env_mod.get_mesh()
+        mesh_pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        # the rotation schedule runs over the mesh's pp axis — route through
+        # it only when that axis really carries num_stages devices; otherwise
+        # keep the full-graph composition (stage placement by sharding only)
+        if self._num_stages > 1 and mesh_pp == self._num_stages:
+            def _same_desc(a, b):
+                # identical constructor signature, not just the class: the
+                # stack rebuilds every trunk layer from one desc
+                return (isinstance(a, LayerDesc) and isinstance(b, LayerDesc)
+                        and not isinstance(a, SharedLayerDesc)
+                        and not isinstance(b, SharedLayerDesc)
+                        and a.layer_cls is b.layer_cls
+                        and a.inputs == b.inputs and a.kwargs == b.kwargs)
+
+            lo_best = hi_best = 0
+            lo = 0
+            while lo < len(descs):
+                hi = lo
+                while hi < len(descs) and _same_desc(descs[hi], descs[lo]):
+                    hi += 1
+                if hi - lo > hi_best - lo_best:
+                    lo_best, hi_best = lo, hi
+                lo = max(hi, lo + 1)
+            per = self._num_stages * max(num_virtual_pipeline_stages, 1)
+            n_mid = (hi_best - lo_best) - (hi_best - lo_best) % per
+            if n_mid >= per:
+                hi_best = lo_best + n_mid
+                from .pipeline_schedules import PipelinedStack
+
+                mid = descs[lo_best]
+                self._stack = PipelinedStack(
+                    lambda: mid.build_layer(), n_mid,
+                    num_stages=self._num_stages,
+                    num_chunks=max(num_virtual_pipeline_stages, 1),
+                    num_microbatches=num_microbatches)
+                self._stack_range = (lo_best, hi_best)
+
         built: List = []
-        for item in descs:
+        slo, shi = self._stack_range
+        for pos, item in enumerate(descs):
+            if self._stack is not None and slo <= pos < shi:
+                continue  # lives inside the rotation stack
             if isinstance(item, SharedLayerDesc):
                 if item.layer_name in self._shared_layers:
                     src = self._shared_layers[item.layer_name]
@@ -134,6 +186,10 @@ class PipelineLayer(Layer):
                 built.append(item.build_layer())
             else:
                 built.append(item)
+            if self._stack is not None and pos == slo - 1:
+                built.append(self._stack)
+        if self._stack is not None and slo == 0:
+            built.insert(0, self._stack)
         from ...nn.layer.container import LayerList
 
         self.run_function = LayerList([l for l in built if isinstance(l, Layer)])
@@ -153,14 +209,14 @@ class PipelineLayer(Layer):
         # memory footprint splits across stage devices.
         from .. import env as _env
 
-        for si in range(self._num_stages):
-            seg = self._funcs[self._segment_bounds[si]:self._segment_bounds[si + 1]]
-            for l in seg:
-                if not isinstance(l, Layer):
-                    continue
-                for p in l.parameters():
-                    if p._placements is None:
-                        p._replace_value(_env.shard_largest_dim(p._value, mesh, "pp"))
+        from .pipeline_schedules import PipelinedStack
+
+        for l in self._funcs:
+            if not isinstance(l, Layer) or isinstance(l, PipelinedStack):
+                continue  # the stack's params are already pp-sharded (stacked dim)
+            for p in l.parameters():
+                if p._placements is None:
+                    p._replace_value(_env.shard_largest_dim(p._value, mesh, "pp"))
 
     def get_stage_from_index(self, idx) -> int:
         for si in range(self._num_stages):

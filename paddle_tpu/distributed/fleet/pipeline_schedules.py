@@ -95,45 +95,87 @@ def pipeline_spmd(
     axis: str = "pp",
     batch_axis: Optional[str] = None,
     remat: bool = True,
+    rng_key=None,
 ):
     """Run x [B, ...] through the pipelined layer stack; returns [B, ...].
 
     apply_layer(leaves, x_local) -> y_local applies ONE layer given its
     parameter leaves; stacked_leaves are arrays with leading dim num_layers
     in `chunk_permutation` order, sharded over `axis`.
+
+    rng_key: optional PRNG key. When given, every (stage, tick) folds a
+    distinct subkey and installs it as the framework RNG stream while the
+    chunk applies — dropout inside pipelined layers draws an independent
+    mask per (stage, microbatch, chunk), the SPMD analog of the reference's
+    per-stage RNG state tracker (fleet/meta_parallel/mpu/random.py:34).
+    Folding is deterministic, so jax.checkpoint recompute replays the exact
+    masks in backward.
     """
     mesh = mesh or env_mod.get_mesh()
     p, v, m = num_stages, num_chunks, num_microbatches
-    if p <= 1:
-        def body(xc, leaves):
-            return apply_layer(leaves, xc), None
 
-        return jax.lax.scan(body, x, stacked_leaves)[0]
+    def with_tick_rng(fn, key, xc, chunk):
+        """Run fn(chunk, xc) with the folded key installed as the global RNG
+        stream (object-level cell swap; trace-safe per swap_rng_cell)."""
+        if key is None:
+            return fn(chunk, xc)
+        from ...base import global_state
+
+        cell = Tensor(key, name="pp_tick_rng", stop_gradient=True)
+        prev = global_state.swap_rng_cell(cell)
+        try:
+            return fn(chunk, xc)
+        finally:
+            global_state.swap_rng_cell(prev)
+
+    if p <= 1:
+        def body(xc, scanned):
+            t, leaves = scanned
+            key = (jax.random.fold_in(rng_key, t) if rng_key is not None else None)
+            out = with_tick_rng(apply_layer, key, xc, leaves) if key is not None \
+                else apply_layer(leaves, xc)
+            return out, None
+
+        idx = jnp.arange(stacked_leaves[0].shape[0])
+        return jax.lax.scan(body, x, (idx, stacked_leaves))[0]
     if m % p != 0:
         raise ValueError(f"num_microbatches {m} must divide by pp degree {p}")
     b = x.shape[0]
     if b % m != 0:
         raise ValueError(f"batch {b} must divide into {m} microbatches")
 
-    def shard_body(x_mb, *leaves):
+    has_rng = rng_key is not None
+
+    def shard_body(x_mb, *args):
+        if has_rng:
+            rng, *leaves = args
+        else:
+            rng, leaves = None, list(args)
         d = jax.lax.axis_index(axis)
         n_local = leaves[0].shape[0]  # v·k layers on this device
         k = n_local // v
         local = [a.reshape((v, k) + a.shape[1:]) for a in leaves]
 
-        def apply_chunk(chunk_leaves, xc):
+        def apply_chunk(chunk_leaves, xc, key):
             def one(xin, layer_leaves):
                 return apply_layer(layer_leaves, xin), None
 
-            return jax.lax.scan(one, xc, chunk_leaves)[0]
+            def run(cl, xx):
+                return jax.lax.scan(one, xx, cl)[0]
+
+            return with_tick_rng(run, key, xc, chunk_leaves)
+
+        def apply_chunk_entry(chunk_leaves, xc, key):
+            return apply_chunk(chunk_leaves, xc, key)
 
         if remat:
-            apply_chunk = jax.checkpoint(
-                apply_chunk, policy=jax.checkpoint_policies.nothing_saveable)
+            apply_chunk_entry = jax.checkpoint(
+                apply_chunk_entry, policy=jax.checkpoint_policies.nothing_saveable)
 
         T = m * v + p - 1
         out0 = jnp.zeros(x_mb.shape, x_mb.dtype)
         cur0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+        stage_rng = (jax.random.fold_in(rng, d) if has_rng else None)
 
         def tick(carry, t):
             cur, out = carry
@@ -142,7 +184,8 @@ def pipeline_spmd(
                      for a in local]
             x_in = jnp.where(
                 c == 0, jax.lax.dynamic_index_in_dim(x_mb, i, 0, keepdims=False), cur)
-            y = apply_chunk(chunk, x_in)
+            key = (jax.random.fold_in(stage_rng, t) if has_rng else None)
+            y = apply_chunk_entry(chunk, x_in, key)
             # emit finished microbatch (only ever true on the last stage)
             done = active & (c == v * p - 1)
             slot = jax.lax.dynamic_index_in_dim(out, i, 0, keepdims=False)
@@ -163,19 +206,52 @@ def pipeline_spmd(
     x_mb = x.reshape(mb_shape)
     x_spec = P(None, batch_axis, *([None] * (len(mb_shape) - 2)))
     leaf_specs = tuple(P(axis, *([None] * (a.ndim - 1))) for a in stacked_leaves)
-    shmap = jax.shard_map(
-        shard_body,
-        mesh=mesh,
-        in_specs=(x_spec,) + leaf_specs,
-        out_specs=x_spec,
-        check_vma=False,
+    rng_specs = (P(),) if has_rng else ()
+
+    # Compiled-callable cache: eager calls reuse one jitted shard_map per
+    # (apply_layer, degrees, shapes, dtypes) key instead of rebuilding (and
+    # recompiling) per call. Under an outer trace the jit inlines as before.
+    cache_key = (
+        apply_layer, p, v, m, axis, batch_axis, remat, mesh, has_rng,
+        tuple(mb_shape), str(x_mb.dtype),
+        tuple((tuple(a.shape), str(a.dtype)) for a in stacked_leaves),
     )
+    jitted = _COMPILED.get(cache_key)
+    if jitted is not None:
+        _COMPILED.move_to_end(cache_key)  # LRU touch
+    if jitted is None:
+        # manual only over the pp ring (+ the batch axis when microbatches
+        # ride dp); other mesh axes (mp/sep) stay GSPMD-auto, so tensor-
+        # parallel layers inside the pipelined template keep their sharding
+        # semantics — pp×mp composes in one program
+        manual = {axis} | ({batch_axis} if batch_axis else set())
+        shmap = jax.shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(x_spec,) + rng_specs + leaf_specs,
+            out_specs=x_spec,
+            axis_names=frozenset(manual),
+            check_vma=False,
+        )
+        # the remat'd scan inside shard_map requires a jit scope (harmless
+        # when we are already under an outer trace — it inlines)
+        jitted = jax.jit(shmap)
+        _COMPILED[cache_key] = jitted
+        while len(_COMPILED) > _COMPILED_MAX:
+            # bounded LRU: old entries pin stacked params + executables of
+            # discarded stacks; evict oldest
+            _COMPILED.popitem(last=False)
     if not isinstance(x_mb, jax.core.Tracer):
         x_mb = jax.device_put(x_mb, NamedSharding(mesh, x_spec))
-    # the remat'd scan inside shard_map requires a jit scope (harmless when
-    # we are already under an outer trace — it inlines)
-    out = jax.jit(shmap)(x_mb, *stacked_leaves)
+    rng_args = (rng_key,) if has_rng else ()
+    out = jitted(x_mb, *rng_args, *stacked_leaves)
     return out.reshape(x.shape)
+
+
+import collections
+
+_COMPILED: "collections.OrderedDict" = collections.OrderedDict()
+_COMPILED_MAX = 32
 
 
 class PipelinedStack(Layer):
@@ -252,18 +328,52 @@ class PipelinedStack(Layer):
         stacked = [getattr(self, n) for n in self._stacked_names]
         mesh = env_mod.get_mesh()
         xv0 = x._value if hasattr(x, "_value") else x
+
+        # training mode: thread a PRNG key so dropout inside the stack folds
+        # per (stage, tick) — see pipeline_spmd's rng_key contract
+        rng_key = None
+        if self.training:
+            from ...base import global_state
+
+            rng_key = global_state.default_generator.split()
+
+        # adapt the microbatch count to the incoming batch: largest m ≤ the
+        # configured one with m % p == 0 and batch % m == 0; a batch that
+        # cannot even split into p microbatches runs the serial scan path
+        # (correct, no stage parallelism — the reference errors out here
+        # instead; degrading keeps small-batch eval/debug usable)
+        p = self.num_stages
+        batch = xv0.shape[0]
+        m_eff = 0
+        m = (self.num_microbatches // p) * p
+        while m >= p:
+            if batch % m == 0:
+                m_eff = m
+                break
+            m -= p
+        stages_eff = p if m_eff else 1
+        if not m_eff and self.num_chunks > 1:
+            # serial fallback would replay the chunk-permuted stacking order;
+            # interleaved stacks keep the strict divisibility contract
+            raise ValueError(
+                f"batch {batch} cannot split into ≥{p} microbatches for the "
+                f"interleaved pipeline (num_chunks={self.num_chunks})")
+        m_eff = m_eff or 1
+
+        # dp sharding decision must follow the EFFECTIVE microbatch split
         dp = mesh.shape.get("dp", 1) if mesh is not None else 1
-        mb = xv0.shape[0] // self.num_microbatches if xv0.shape[0] % self.num_microbatches == 0 else 0
-        batch_axis = "dp" if (dp > 1 and mb and mb % dp == 0) else None
+        mb = batch // m_eff
+        batch_axis = "dp" if (dp > 1 and stages_eff > 1 and mb % dp == 0) else None
 
         def fn(xv, *leaf_vals):
             return pipeline_spmd(
                 self._apply_layer, list(leaf_vals), xv,
-                num_stages=self.num_stages,
-                num_microbatches=self.num_microbatches,
+                num_stages=stages_eff,
+                num_microbatches=m_eff,
                 num_chunks=self.num_chunks,
                 batch_axis=batch_axis,
                 remat=self.remat,
+                rng_key=rng_key,
             )
 
         return primitive("pipelined_stack", fn, [x, *stacked])
@@ -278,14 +388,29 @@ class PipelinedStack(Layer):
         }
 
 
-def forward_backward_pipeline_1f1b(stack: PipelinedStack, x):
-    """Reference-named entry (pipeline_parallel.py:575): rotation schedule,
-    one chunk per stage."""
+def forward_backward_pipeline_rotation(stack: PipelinedStack, x):
+    """Rotation schedule, one chunk per stage — schedule-wise a rotation
+    GPipe: all-forward ticks, then jax-AD-reversed backward with per-chunk
+    remat. In-flight activation memory is O(m·v) per device (each stage's
+    saved chunk inputs), NOT 1F1B's O(p); the reference's true 1F1B
+    (pipeline_parallel.py:575) interleaves fwd/bwd ticks to cap in-flight
+    work at p microbatches. The remat policy recovers most of the memory
+    difference at ~33% recompute cost; a tick-interleaved fwd/bwd schedule
+    is the remaining gap."""
     assert stack.num_chunks == 1
     return stack(x)
 
 
+# Honest alias: earlier rounds exported the rotation schedule under the
+# reference's 1F1B name; keep the name importable but documented as rotation.
+forward_backward_pipeline_1f1b = forward_backward_pipeline_rotation
+
+
 def forward_backward_pipeline_interleave(stack: PipelinedStack, x):
-    """Reference-named entry (pipeline_parallel.py:1174): interleaved VPP."""
+    """Reference-named entry (pipeline_parallel.py:1174): interleaved VPP
+    chunk placement (device d owns chunks {d, d+p, ...}); same rotation tick
+    loop, bubble (p-1)/(m·v+p-1)."""
     assert stack.num_chunks > 1
     return stack(x)
+
+

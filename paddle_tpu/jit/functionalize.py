@@ -63,6 +63,21 @@ class DiscoveryContext:
         if id(t) not in self.cells:
             self.cells[id(t)] = t
 
+    def prune_tracer_cells(self):
+        """Drop cells whose value is a dead tracer. Tensors created inside an
+        inner trace during the eager discovery run (e.g. the pipeline
+        schedule's per-tick RNG cells) get registered by their writes but die
+        with that trace — keeping them would pin a leaked tracer into the
+        compiled entry's state. Real state (params, optimizer moments created
+        lazily on the first step) holds concrete arrays and stays."""
+        import jax.core as jcore
+
+        dead = [tid for tid, c in self.cells.items()
+                if isinstance(c._value, jcore.Tracer)]
+        for tid in dead:
+            self.cells.pop(tid, None)
+            self.old_values.pop(tid, None)
+
     def rollback(self):
         for tid, old in self.old_values.items():
             self.cells[tid]._value = old  # raw restore, no re-interception
@@ -161,6 +176,7 @@ class CompiledFunction:
             )
             ctx = self._discover(probe_args, probe_kwargs)
 
+        ctx.prune_tracer_cells()
         cells: List[Tensor] = list(ctx.cells.values())
         fn = self.fn
 

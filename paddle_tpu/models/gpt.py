@@ -211,10 +211,10 @@ class GPTModel(Layer):
         if config.pipeline_parallel:
             from ..distributed.fleet.pipeline_schedules import PipelinedStack
 
-            if config.hidden_dropout_prob or config.attention_dropout_prob:
-                raise ValueError(
-                    "pipeline_parallel stack requires dropout=0 (stage "
-                    "boundaries carry activations only)")
+            # dropout>0 is supported inside the stack: pipeline_spmd folds a
+            # per-(stage, tick) RNG key so every microbatch/chunk draws an
+            # independent mask (the SPMD analog of the reference's
+            # RNGStatesTracker, fleet/meta_parallel/mpu/random.py:34)
             self.h = PipelinedStack(
                 lambda: GPTDecoderLayer(config),
                 num_layers=config.num_hidden_layers,
