@@ -36,12 +36,16 @@ def _parse_args(argv=None):
     p.add_argument("--max_restarts", type=int, default=0,
                    help="relaunch failed workers up to N times")
     p.add_argument("--devices", default=None, help="visible device selection")
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="0: off; >=1: run the Master KV rendezvous + elastic "
+                        "manager; worker relaunch is driven by its decisions")
+    p.add_argument("--job_id", default="default", help="elastic job id")
     p.add_argument("training_script", help="script to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
-def _spawn(args, local_rank: int):
+def _spawn(args, local_rank: int, generation: int = 0):
     world = args.nnodes * args.nproc_per_node
     rank = args.rank * args.nproc_per_node + local_rank
     env = dict(os.environ)
@@ -56,6 +60,9 @@ def _spawn(args, local_rank: int):
         WORLD_SIZE=str(world),
         PADDLE_LOCAL_RANK=str(local_rank),
         PADDLE_NNODES=str(args.nnodes),
+        PADDLE_NODE_RANK=str(args.rank),
+        PADDLE_RESTART_GEN=str(generation),
+        PADDLE_JOB_ID=str(getattr(args, "job_id", "default")),
     )
     if args.devices:
         env["JAX_VISIBLE_DEVICES"] = args.devices
@@ -71,7 +78,30 @@ def _spawn(args, local_rank: int):
 def launch(argv=None) -> int:
     args = _parse_args(argv)
     restarts = {i: 0 for i in range(args.nproc_per_node)}
-    procs = {i: _spawn(args, i) for i in range(args.nproc_per_node)}
+
+    # elastic mode: the node launcher joins the Master KV service (rank 0
+    # hosts the store one port above the trainer master port) and runs an
+    # ElasticManager whose HOLD/RESTART/EXIT decisions drive this loop —
+    # the reference's manager→launcher wiring (elastic/manager.py:125)
+    master = None
+    elastic = None
+    generation = 0
+    if args.elastic_level > 0:
+        from ..fleet.elastic import ElasticManager, ElasticStatus
+        from .master import Master
+
+        ep = args.master or "127.0.0.1:49178"
+        host, _, port = ep.rpartition(":")
+        store_ep = f"{host or '127.0.0.1'}:{int(port) + 1}"
+        master = Master(store_ep, args.rank, args.nnodes, job_id=args.job_id)
+        master.register(ep, args.nproc_per_node)
+        master.sync_peers(timeout=60.0)
+        generation = master.generation()
+        elastic = ElasticManager(rank=args.rank, world_size=args.nnodes,
+                                 store=master.store, job_id=args.job_id)
+        elastic.start()
+
+    procs = {i: _spawn(args, i, generation) for i in range(args.nproc_per_node)}
 
     def _terminate_all():
         for p in procs.values():
@@ -84,6 +114,16 @@ def launch(argv=None) -> int:
             except subprocess.TimeoutExpired:
                 p.kill()
 
+    def _restart_worker(i, code):
+        nonlocal generation
+        restarts[i] += 1
+        if master is not None:
+            generation = master.bump_generation()
+        print(f"[launch] worker {i} exited {code}; RESTART "
+              f"{restarts[i]}/{args.max_restarts} (gen {generation})",
+              file=sys.stderr)
+        procs[i] = _spawn(args, i, generation)
+
     try:
         while True:
             alive = False
@@ -93,20 +133,41 @@ def launch(argv=None) -> int:
                     alive = True
                 elif code != 0:
                     if restarts[i] < args.max_restarts:
-                        restarts[i] += 1
-                        print(f"[launch] worker {i} exited {code}; restart "
-                              f"{restarts[i]}/{args.max_restarts}", file=sys.stderr)
-                        procs[i] = _spawn(args, i)
+                        _restart_worker(i, code)
                         alive = True
                     else:
                         print(f"[launch] worker {i} failed with code {code}; "
                               "terminating job", file=sys.stderr)
+                        if elastic is not None:
+                            elastic.exit(completed=False)
                         _terminate_all()
                         return code
+            # elastic membership scan: a peer NODE going stale is a RESTART
+            # decision — re-form the job at a new generation so workers
+            # re-rendezvous and resume from the dist checkpoint
+            if elastic is not None and alive:
+                status = elastic.watch()
+                if status == ElasticStatus.RESTART:
+                    cur = master.generation()
+                    if cur == generation:
+                        generation = master.bump_generation()
+                    else:
+                        generation = cur
+                    print(f"[launch] elastic RESTART -> generation "
+                          f"{generation}", file=sys.stderr)
+                    _terminate_all()
+                    procs.update({i: _spawn(args, i, generation)
+                                  for i in range(args.nproc_per_node)})
+                elif status == ElasticStatus.COMPLETED:
+                    pass  # workers will exit 0 on their own
             if not alive:
+                if elastic is not None:
+                    elastic.exit(completed=True)
                 return 0
             time.sleep(0.2)
     except KeyboardInterrupt:
+        if elastic is not None:
+            elastic.exit(completed=False)
         _terminate_all()
         return 130
 

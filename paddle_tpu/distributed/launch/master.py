@@ -1,0 +1,109 @@
+"""Master rendezvous service for multi-node launch (reference:
+python/paddle/distributed/launch/controllers/master.py:73 HTTPMaster /
+:186 ETCDMaster — nodes sync peer lists through a KV service and heartbeat
+for elastic membership).
+
+TPU-native: the KV service is the framework's own native TCPStore
+(native/tcp_store.cc) — the same store that backs fleet.elastic — so one
+socket server covers rendezvous, elastic heartbeats and user KV. The node
+with rank 0 hosts it; every node's launcher connects as a client.
+
+Protocol (all keys under ``rdzv/<job>/``):
+- ``peers/<rank>``  — node endpoint + nproc, set at register time
+- ``joined``        — atomic join counter; ``sync_peers`` blocks until it
+                      reaches nnodes, then returns the sorted peer list
+- ``gen``           — restart generation; bumped on elastic RESTART so
+                      re-joining workers agree on a fresh rendezvous round
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional, Tuple
+
+from ...base.log import get_logger
+from ...native import TCPStore
+
+
+class Master:
+    """KV rendezvous over the native TCPStore."""
+
+    def __init__(self, endpoint: str, rank: int, nnodes: int,
+                 job_id: str = "default", is_master: Optional[bool] = None):
+        host, _, port = endpoint.rpartition(":")
+        self.endpoint = endpoint
+        self.rank = rank
+        self.nnodes = nnodes
+        self.job_id = job_id
+        self.is_master = (rank == 0) if is_master is None else is_master
+        self.store = TCPStore(host or "127.0.0.1", int(port),
+                              is_master=self.is_master, world_size=nnodes)
+
+    def _k(self, key: str) -> str:
+        return f"rdzv/{self.job_id}/{key}"
+
+    # ------------------------------------------------------------ rendezvous
+    def register(self, node_endpoint: str, nproc: int) -> None:
+        info = json.dumps({"endpoint": node_endpoint, "nproc": nproc,
+                           "rank": self.rank})
+        self.store.set(self._k(f"peers/{self.rank}"), info)
+        self.store.add(self._k("joined"), 1)
+
+    def sync_peers(self, timeout: float = 120.0) -> List[dict]:
+        """Block until all nnodes registered; return peers sorted by rank
+        (reference master.sync_peers)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.store.add(self._k("joined"), 0) >= self.nnodes:
+                peers = []
+                for r in range(self.nnodes):
+                    raw = self.store.get(self._k(f"peers/{r}"), timeout=10.0)
+                    peers.append(json.loads(raw.decode()))
+                return sorted(peers, key=lambda p: p["rank"])
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"rendezvous: {self.store.add(self._k('joined'), 0)}/{self.nnodes} "
+            f"nodes joined within {timeout}s")
+
+    # ---------------------------------------------------------- generations
+    def generation(self) -> int:
+        return self.store.add(self._k("gen"), 0)
+
+    def bump_generation(self) -> int:
+        """Start a new rendezvous round after an elastic RESTART decision."""
+        return self.store.add(self._k("gen"), 1)
+
+    def wait_generation(self, current: int, timeout: float = 60.0) -> int:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            g = self.generation()
+            if g > current:
+                return g
+            time.sleep(0.2)
+        return current
+
+    # ------------------------------------------------------------------- kv
+    def set(self, key: str, value) -> None:
+        self.store.set(self._k(key), value)
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        return self.store.get(self._k(key), timeout=timeout)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return self.store.add(self._k(key), amount)
+
+    def close(self):
+        self.store.close()
+
+
+def master_from_env(job_id: str = "default") -> Optional[Master]:
+    """Build a Master client from the PADDLE_* env contract the launcher
+    distributes (PADDLE_MASTER, PADDLE_NNODES, node rank)."""
+    endpoint = os.environ.get("PADDLE_MASTER")
+    if not endpoint:
+        return None
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    rank = int(os.environ.get("PADDLE_NODE_RANK",
+                              os.environ.get("PADDLE_TRAINER_ID", "0")))
+    return Master(endpoint, rank, nnodes, job_id=job_id)
