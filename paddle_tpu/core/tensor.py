@@ -460,7 +460,11 @@ class Tensor:
 
 
 def _tensor_flatten(t: Tensor):
-    return (t._value,), (t.stop_gradient, t.name)
+    # aux must NOT carry per-instance auto-generated names: treedef equality
+    # is the jit cache key, and unique names would force a recompile for
+    # every fresh input tensor. Persistable tensors (parameters/buffers)
+    # keep their stable names.
+    return (t._value,), (t.stop_gradient, t.name if t.persistable else None)
 
 
 def _tensor_unflatten(aux, children):

@@ -63,7 +63,9 @@ class TrainStep:
     constant), so LR schedules do not retrace.
     """
 
-    def __init__(self, model=None, optimizer=None, loss_fn: Optional[Callable] = None, grad_accum_steps: int = 1):
+    def __init__(self, model=None, optimizer=None, loss_fn: Optional[Callable] = None, grad_accum_steps: int = 1,
+                 bucket_axes: Optional[dict] = None, bucket_range: Optional[tuple] = None,
+                 bucket_pad_values: Optional[dict] = None):
         import jax.numpy as jnp
 
         self.model = model
@@ -89,7 +91,18 @@ class TrainStep:
         static_key = None
         if model is not None:
             static_key = lambda: ("train" if model.training else "eval")  # noqa: E731
-        self._compiled = CompiledFunction(step_fn, static_key_fn=static_key, name="train_step")
+        if bucket_axes:
+            # dynamic-shape policy: pad variable dims to the log2 bucket
+            # ladder so distinct lengths share ≤ log2(max/min)+1 programs
+            from .bucketing import BucketedFunction
+
+            lo, hi = bucket_range or (16, 4096)
+            self._compiled = BucketedFunction(
+                step_fn, bucket_axes=bucket_axes, min_len=lo, max_len=hi,
+                pad_values=bucket_pad_values, static_key_fn=static_key,
+                name="train_step")
+        else:
+            self._compiled = CompiledFunction(step_fn, static_key_fn=static_key, name="train_step")
 
     def __call__(self, *batch):
         import jax.numpy as jnp

@@ -1,0 +1,140 @@
+"""Dynamic-shape policy: pad-to-bucket compilation (SURVEY §7 hard part #4).
+
+The reference keeps compiled coverage under dynamic shapes with SOT frame
+capture (python/paddle/jit/sot/, paddle/fluid/pybind/sot/eval_frame.c) —
+bytecode-level graph breaks around dynamic regions. Under XLA, shapes are
+static per compile, so the TPU-native policy is *shape quantization*:
+variable dims are padded up to a small ladder of bucket sizes, and the jit
+cache keys on the bucket — a job with seq lens in [min, max] compiles at
+most ``log2(max/min) + 1`` programs instead of one per distinct length, and
+never silently falls back to eager.
+
+Pieces:
+- ``powers_of_two_buckets`` / ``bucket_for`` — the ladder
+- ``pad_to_bucket``       — right-pad one array along an axis
+- ``BucketedFunction``    — wraps ``functionalize``; pads declared args
+  before dispatch (loss masking stays the caller's contract, as with any
+  padded-batch training)
+- ``bucket_collate``      — DataLoader collate that pads each batch's
+  variable-length samples to the bucket of the batch max
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def powers_of_two_buckets(min_len: int, max_len: int) -> List[int]:
+    """[min, 2·min, …, ≥max] — the log₂ ladder."""
+    buckets = []
+    b = max(int(min_len), 1)
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(b)
+    return buckets
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return int(b)
+    raise ValueError(f"length {n} exceeds largest bucket {buckets[-1]}")
+
+
+def pad_to_bucket(value, axis: int, bucket: int, pad_value=0):
+    """Right-pad ``value`` along ``axis`` up to ``bucket``; returns the
+    padded array (unchanged when already that size)."""
+    import jax.numpy as jnp
+
+    v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+    n = v.shape[axis]
+    if n == bucket:
+        return value
+    if n > bucket:
+        raise ValueError(f"dim {n} larger than bucket {bucket}")
+    widths = [(0, 0)] * v.ndim
+    widths[axis] = (0, bucket - n)
+    padded = jnp.pad(v, widths, constant_values=pad_value)
+    if isinstance(value, Tensor):
+        return Tensor(padded, stop_gradient=value.stop_gradient)
+    return padded
+
+
+class BucketedFunction:
+    """functionalize() with pad-to-bucket on declared argument axes.
+
+    bucket_axes: {arg_index: axis} — which positional args have a variable
+    dim. All declared dims share one bucket per call (the common seq-len
+    case); pad_values supplies per-arg fill (e.g. ignore_index for labels).
+    """
+
+    def __init__(self, fn: Callable, *, bucket_axes: Dict[int, int],
+                 min_len: int, max_len: int,
+                 pad_values: Optional[Dict[int, float]] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 static_key_fn=None, name=None):
+        from .functionalize import CompiledFunction
+
+        self.buckets = list(buckets) if buckets else powers_of_two_buckets(min_len, max_len)
+        self.bucket_axes = dict(bucket_axes)
+        self.pad_values = dict(pad_values or {})
+        self._compiled = CompiledFunction(fn, static_key_fn=static_key_fn,
+                                          name=name or getattr(fn, "__name__", "fn"))
+
+    @property
+    def num_compiled(self) -> int:
+        return len(self._compiled._cache)
+
+    def __call__(self, *args, **kwargs):
+        lengths = []
+        for idx, axis in self.bucket_axes.items():
+            v = args[idx]
+            shape = (v._value.shape if isinstance(v, Tensor)
+                     else np.asarray(v).shape)
+            lengths.append(shape[axis])
+        bucket = bucket_for(max(lengths), self.buckets) if lengths else None
+        if bucket is not None:
+            args = list(args)
+            for idx, axis in self.bucket_axes.items():
+                args[idx] = pad_to_bucket(args[idx], axis, bucket,
+                                          self.pad_values.get(idx, 0))
+        return self._compiled(*args, **kwargs)
+
+
+def bucket_collate(axis: int = 0, min_len: int = 16, max_len: int = 4096,
+                   pad_value=0, buckets: Optional[Sequence[int]] = None,
+                   base_collate=None):
+    """DataLoader collate_fn factory: pads each sample's ``axis`` to the
+    bucket of the batch max before stacking, so downstream compiles see at
+    most the bucket ladder's shapes (reference analog: the bucketing
+    samplers in text data pipelines)."""
+    ladder = list(buckets) if buckets else powers_of_two_buckets(min_len, max_len)
+
+    def collate(batch):
+        from .. import io as io_mod
+
+        def pad_leaf(samples):
+            arrs = [np.asarray(s) for s in samples]
+            if arrs[0].ndim <= axis or not np.issubdtype(arrs[0].dtype, np.number):
+                return io_mod.dataloader.default_collate_fn(samples)
+            mx = max(a.shape[axis] for a in arrs)
+            b = bucket_for(mx, ladder)
+            out = []
+            for a in arrs:
+                widths = [(0, 0)] * a.ndim
+                widths[axis] = (0, b - a.shape[axis])
+                out.append(np.pad(a, widths, constant_values=pad_value))
+            return io_mod.dataloader.default_collate_fn(out)
+
+        sample = batch[0]
+        if isinstance(sample, (tuple, list)):
+            return tuple(pad_leaf(list(f)) for f in zip(*batch))
+        if isinstance(sample, dict):
+            return {k: pad_leaf([s[k] for s in batch]) for k in sample}
+        return pad_leaf(batch)
+
+    return collate
