@@ -22,6 +22,28 @@ class DistEngine:
         self._optimizer = optimizer
         self._strategy = strategy
         self._step: Optional[TrainStep] = None
+        self._plan = None
+
+    def prepare(self, batch_size: Optional[int] = None, seq_len: Optional[int] = None,
+                hbm_bytes: int = 16 << 30, n_devices: Optional[int] = None,
+                mode: str = "auto"):
+        """Plan the mesh (dp/mp/pp degrees) for this model WITHOUT user
+        input, then initialize the hybrid environment (reference:
+        static/engine.py:98 prepare() over completion + planner; search tier
+        auto_tuner/prune.py). Returns the chosen Plan."""
+        import jax
+
+        from .. import fleet
+        from .planner import ModelSpec, choose_plan
+
+        n = n_devices or len(jax.devices())
+        spec = ModelSpec.from_model(self._layer, seq_len=seq_len)
+        self._plan = choose_plan(spec, n, batch_size or max(n, 8),
+                                 hbm_bytes=hbm_bytes)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = self._plan.degrees
+        fleet.init(is_collective=True, strategy=strategy)
+        return self._plan
 
     def _ensure_step(self):
         if self._step is None:

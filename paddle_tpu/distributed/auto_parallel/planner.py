@@ -1,0 +1,159 @@
+"""Parallelism planner: greedy mesh/degree chooser backed by a memory model.
+
+Rebuild of the reference's auto-parallel search tier — the cost-model-guided
+planner in python/paddle/distributed/auto_parallel/static/ (completion +
+partitioner + cost model) and the black-box search pruner
+(python/paddle/distributed/auto_tuner/prune.py). GSPMD already does
+completion/partitioning inside XLA, so what remains to plan is the *mesh
+shape*: how to factor N devices into dp×mp×pp×sep. The chooser:
+
+1. enumerates all divisor factorizations (auto_tuner's candidate grid),
+2. prunes infeasible ones (divisibility of batch/heads/layers/seq — the
+   same rules as auto_tuner/prune.py), and configs whose per-device memory
+   estimate exceeds the HBM budget,
+3. greedily scores the survivors: data parallelism first (cheapest
+   comms — gradient allreduce overlaps), then the smallest mp that fits
+   (mp collectives sit on the critical path), pp last (bubble), mirroring
+   the reference tuner's default ordering.
+
+The memory model follows the standard transformer accounting (params,
+grads, Adam moments, activations with remat) — the same quantities the
+reference's cost model estimates from the dist program.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """What the planner needs to know about the model."""
+
+    num_params: int
+    num_layers: int = 1
+    hidden_size: int = 1024
+    num_heads: int = 16
+    vocab_size: int = 50304
+    seq_len: int = 1024
+
+    @classmethod
+    def from_model(cls, model, seq_len: Optional[int] = None) -> "ModelSpec":
+        import numpy as np
+
+        n = int(sum(int(np.prod(p.shape)) for p in model.parameters()))
+        cfg = getattr(model, "config", None)
+        get = lambda name, d: int(getattr(cfg, name, d)) if cfg is not None else d
+        return cls(
+            num_params=n,
+            num_layers=get("num_hidden_layers", 1),
+            hidden_size=get("hidden_size", 1024),
+            num_heads=get("num_attention_heads", 16),
+            vocab_size=get("vocab_size", 50304),
+            seq_len=seq_len or get("max_position_embeddings", 1024),
+        )
+
+
+@dataclasses.dataclass
+class Plan:
+    dp: int
+    mp: int
+    pp: int
+    sep: int = 1
+    per_device_bytes: int = 0
+    reason: str = ""
+
+    @property
+    def degrees(self) -> dict:
+        return {"dp_degree": self.dp, "mp_degree": self.mp,
+                "pp_degree": self.pp, "sep_degree": self.sep}
+
+
+def _factorizations(n: int) -> List[tuple]:
+    """All (dp, mp, pp, sep) with dp*mp*pp*sep == n."""
+    out = []
+    for dp in range(1, n + 1):
+        if n % dp:
+            continue
+        r1 = n // dp
+        for mp in range(1, r1 + 1):
+            if r1 % mp:
+                continue
+            r2 = r1 // mp
+            for pp in range(1, r2 + 1):
+                if r2 % pp:
+                    continue
+                out.append((dp, mp, pp, r2 // pp))
+    return out
+
+
+def estimate_per_device_bytes(spec: ModelSpec, batch_size: int, dp: int,
+                              mp: int, pp: int, sep: int = 1,
+                              param_bytes: int = 2, master_weights: bool = True,
+                              remat: bool = True) -> int:
+    """Per-device HBM estimate: params + grads + Adam moments (+fp32
+    master) sharded over mp·pp, plus activations sharded over dp·mp·sep.
+    Activation term uses the remat'd transformer footprint
+    (~2·s·h bytes/layer/sample boundaries instead of ~34·s·h full)."""
+    model_shard = spec.num_params / (mp * pp)
+    # bf16 param + bf16-ish grad + 2 fp32 moments (+ fp32 master)
+    state_mult = param_bytes + param_bytes + 8 + (4 if master_weights else 0)
+    model_bytes = model_shard * state_mult
+
+    micro_batch = max(batch_size // dp, 1)
+    layers_per_stage = max(spec.num_layers // pp, 1)
+    act_per_layer = (2.0 if remat else 34.0) * spec.seq_len * spec.hidden_size / sep
+    act_bytes = micro_batch * layers_per_stage * act_per_layer * param_bytes
+    # logits + embedding activations
+    head_bytes = micro_batch * spec.seq_len * spec.vocab_size / mp * 2
+    return int(model_bytes + act_bytes + head_bytes)
+
+
+def feasible(spec: ModelSpec, batch_size: int, dp: int, mp: int, pp: int,
+             sep: int = 1) -> bool:
+    """auto_tuner/prune.py-style divisibility rules."""
+    if batch_size % dp:
+        return False
+    if spec.num_heads % (mp * sep):
+        return False
+    if spec.hidden_size % mp:
+        return False
+    if spec.num_layers % pp:
+        return False
+    if spec.seq_len % sep:
+        return False
+    if pp > 1 and (batch_size // dp) % pp:
+        return False  # need ≥pp microbatches per dp replica
+    return True
+
+
+def choose_plan(spec: ModelSpec, n_devices: int, batch_size: int,
+                hbm_bytes: int = 16 << 30, max_mp: int = 8,
+                use_sep: bool = False) -> Plan:
+    """Greedy chooser over the pruned candidate grid."""
+    best: Optional[Plan] = None
+    candidates = []
+    for dp, mp, pp, sep in _factorizations(n_devices):
+        if not use_sep and sep != 1:
+            continue
+        if mp > max_mp:
+            continue
+        if not feasible(spec, batch_size, dp, mp, pp, sep):
+            continue
+        mem = estimate_per_device_bytes(spec, batch_size, dp, mp, pp, sep)
+        if mem > hbm_bytes:
+            continue
+        candidates.append(Plan(dp, mp, pp, sep, per_device_bytes=mem))
+    if not candidates:
+        raise ValueError(
+            f"no feasible parallel plan for {n_devices} devices, "
+            f"batch {batch_size}, ~{spec.num_params/1e6:.1f}M params within "
+            f"{hbm_bytes/2**30:.0f} GiB/device")
+    # greedy order: max dp, then min pp (bubble), then min mp (critical-path
+    # collectives), then min memory
+    candidates.sort(key=lambda p: (-p.dp, p.pp, p.mp, p.per_device_bytes))
+    best = candidates[0]
+    best.reason = (
+        f"dp-first greedy over {len(candidates)} feasible configs; "
+        f"~{best.per_device_bytes / 2**30:.2f} GiB/device")
+    return best
