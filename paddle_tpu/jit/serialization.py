@@ -22,11 +22,23 @@ from ..framework import io as fio
 
 
 def save(layer, path, input_spec=None, **configs):
-    """Save layer params + (if input_spec given) an exported StableHLO fwd."""
+    """Save layer params + (if input_spec given) an exported StableHLO fwd.
+
+    configs["quantize"]: optional — "weight_only_int8" / "weight_only_int4"
+    converts every Linear to int8/int4 weight storage before export
+    (quantization/ptq.py::quantize_weight_only), so the exported program
+    carries quantized weights and runs the fused dequant-matmul path.
+    """
+    quantize = configs.pop("quantize", None)
+    if quantize:
+        from ..quantization.ptq import quantize_weight_only
+
+        layer = quantize_weight_only(layer, algo=quantize)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     state = layer.state_dict()
     fio.save(state, path + ".pdiparams")
-    meta = {"class": type(layer).__name__, "has_program": False}
+    meta = {"class": type(layer).__name__, "has_program": False,
+            "quantize": quantize}
     if input_spec is not None:
         from jax import export as jax_export
 

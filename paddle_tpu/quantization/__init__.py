@@ -9,3 +9,4 @@ estimator) so trained scales export to any int8 runtime.
 from .config import QuantConfig  # noqa: F401
 from .qat import QAT  # noqa: F401
 from .quanters import FakeQuanterWithAbsMax, FakeQuanterWithAbsMaxObserver  # noqa: F401
+from .ptq import PTQ, QuantizedLinear, WeightOnlyLinear, quantize_weight_only  # noqa: F401

@@ -1,0 +1,134 @@
+"""Post-training quantization (reference:
+python/paddle/quantization/ptq.py PTQ + observer tier; weight-only path:
+paddle/phi/kernels/fusion/*weight_only* and
+python/paddle/nn/quant/quantized_linear.py).
+
+Two modes:
+- ``PTQ``: observer-based activation+weight calibration — run sample
+  batches, collect per-tensor abs-max, convert Linear layers to fake-quant
+  int8 simulation (accuracy evaluation on TPU, where int8 activation
+  matmuls hold no speed edge over bf16 MXU ops).
+- ``WeightOnlyQuant``: true int8/int4 weight storage — Linear weights are
+  replaced by (int8, scale) pairs and forward runs
+  ops.quant_ops.weight_only_linear, halving weight HBM traffic (the TPU
+  inference win; decode is bandwidth-bound).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import Layer, Linear
+from ..ops import quant_ops
+
+
+class AbsmaxObserver:
+    """Running abs-max activation observer (reference
+    quantization/observers/abs_max.py)."""
+
+    def __init__(self):
+        self.scale = 0.0
+
+    def observe(self, value):
+        v = value._value if isinstance(value, Tensor) else value
+        self.scale = max(self.scale, float(jnp.max(jnp.abs(v))))
+
+
+class ObservedLinear(Layer):
+    """Linear wrapper that records activation scales during calibration."""
+
+    def __init__(self, layer: Linear):
+        super().__init__()
+        self.inner = layer
+        self.observer = AbsmaxObserver()
+
+    def forward(self, x):
+        self.observer.observe(x)
+        return self.inner(x)
+
+
+class QuantizedLinear(Layer):
+    """Int8-simulated linear after PTQ convert: weights stored int8 +
+    scale; activations fake-quantized with the calibrated scale."""
+
+    def __init__(self, layer: Linear, act_scale: float):
+        super().__init__()
+        wq, wscale = quant_ops.weight_quantize(layer.weight)
+        self.register_buffer("weight_quant", wq)
+        self.register_buffer("weight_scale", wscale)
+        self.bias = layer.bias
+        self.act_scale = max(act_scale, 1e-8)
+
+    def forward(self, x):
+        from ..core.dispatch import primitive
+
+        s = self.act_scale
+
+        def fq(v):
+            q = jnp.clip(jnp.round(v / s * 127.0), -127, 127)
+            return q * s / 127.0
+
+        xq = primitive("fake_quant_act", fq, [x])
+        return quant_ops.weight_only_linear(xq, self.weight_quant, self.bias,
+                                            self.weight_scale)
+
+
+class WeightOnlyLinear(Layer):
+    """True weight-only int8/int4 linear (reference
+    nn/quant/quantized_linear.py weight_only_linear path)."""
+
+    def __init__(self, layer: Linear, algo: str = "weight_only_int8"):
+        super().__init__()
+        wq, wscale = quant_ops.weight_quantize(layer.weight, algo=algo)
+        self.register_buffer("weight_quant", wq)
+        self.register_buffer("weight_scale", wscale)
+        self.bias = layer.bias
+        self.weight_dtype = "int4" if "int4" in algo else "int8"
+
+    def forward(self, x):
+        return quant_ops.weight_only_linear(x, self.weight_quant, self.bias,
+                                            self.weight_scale,
+                                            weight_dtype=self.weight_dtype)
+
+
+def _swap_linears(layer: Layer, make):
+    for name, sub in list(layer.named_children()):
+        if isinstance(sub, Linear):
+            setattr(layer, name, make(sub))
+        else:
+            _swap_linears(sub, make)
+
+
+class PTQ:
+    """Observer-calibrate-convert loop (reference quantization/ptq.py)."""
+
+    def __init__(self, config=None):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        """Instrument: wrap Linear layers with activation observers."""
+        _swap_linears(model, ObservedLinear)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        """After calibration batches ran, swap to int8-simulated linears."""
+
+        def make(sub):
+            return sub
+
+        for name, sub in list(model.named_children()):
+            if isinstance(sub, ObservedLinear):
+                setattr(model, name, QuantizedLinear(sub.inner, sub.observer.scale))
+            else:
+                self.convert(sub)
+        return model
+
+
+def quantize_weight_only(model: Layer, algo: str = "weight_only_int8") -> Layer:
+    """One-shot weight-only conversion of every Linear (the TPU inference
+    path; no calibration data needed)."""
+    _swap_linears(model, lambda lin: WeightOnlyLinear(lin, algo))
+    return model

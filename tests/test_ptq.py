@@ -1,0 +1,71 @@
+"""PTQ + weight-only int8 tests (reference: python/paddle/quantization/ptq.py
++ phi weight_only fusion kernels): calibration accuracy vs fp32, weight-only
+roundtrip through the inference Predictor."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def test_ptq_calibrate_convert_accuracy():
+    from paddle_tpu.quantization import PTQ, QuantizedLinear
+
+    rs = np.random.RandomState(0)
+    model = _mlp()
+    x = paddle.to_tensor(rs.randn(64, 16).astype(np.float32))
+    ref = model(x).numpy()
+
+    ptq = PTQ()
+    ptq.quantize(model)
+    for _ in range(4):  # calibration passes
+        model(x)
+    ptq.convert(model)
+    assert any(isinstance(l, QuantizedLinear) for l in model.sublayers())
+    got = model(x).numpy()
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.06, rel  # int8 sim stays close to fp32
+
+
+def test_weight_only_int8_accuracy_and_memory():
+    from paddle_tpu.quantization import WeightOnlyLinear, quantize_weight_only
+
+    rs = np.random.RandomState(1)
+    model = _mlp()
+    x = paddle.to_tensor(rs.randn(32, 16).astype(np.float32))
+    ref = model(x).numpy()
+    quantize_weight_only(model)
+    layers = [l for l in model.sublayers() if isinstance(l, WeightOnlyLinear)]
+    assert len(layers) == 2
+    assert all(l.weight_quant.numpy().dtype == np.int8 for l in layers)
+    got = model(x).numpy()
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_weight_only_int8_through_predictor(tmp_path):
+    """jit.save(quantize=...) → create_predictor → run: the exported program
+    carries int8 weights and matches fp32 outputs within int8 tolerance."""
+    from paddle_tpu import inference, jit
+    from paddle_tpu.static import InputSpec
+
+    rs = np.random.RandomState(2)
+    model = _mlp()
+    x = rs.randn(8, 16).astype(np.float32)
+    ref = model(paddle.to_tensor(x)).numpy()
+
+    prefix = os.path.join(str(tmp_path), "wo_model")
+    jit.save(model, prefix, input_spec=[InputSpec([8, 16], "float32")],
+             quantize="weight_only_int8")
+
+    cfg = inference.Config(prefix)
+    pred = inference.create_predictor(cfg)
+    out = pred.run([x])[0]
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
