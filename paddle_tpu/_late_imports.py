@@ -169,3 +169,19 @@ try:
     __all__.append("audio")
 except ImportError:
     pass
+
+try:
+    from . import onnx  # noqa: F401
+
+    __all__.append("onnx")
+except ImportError:
+    pass
+
+try:
+    from .core.custom_op import get_custom_op, register_op, run_custom_op  # noqa: F401
+    from .core.tensor_array import SelectedRows, StringTensor, TensorArray  # noqa: F401
+
+    __all__ += ["register_op", "run_custom_op", "TensorArray", "SelectedRows",
+                "StringTensor"]
+except ImportError:
+    pass

@@ -1,0 +1,82 @@
+"""Custom user-op extension point (reference:
+paddle/fluid/framework/custom_operator.cc + python/paddle/utils/cpp_extension
+— user C++/CUDA ops compiled into .so and registered into the op registry).
+
+TPU-native plug-in surface: a custom op is (a) a jax-traceable forward
+(jnp ops or a Pallas TPU kernel) plus (b) an optional backward rule. The
+registration funnels through core.dispatch.primitive, so custom ops get
+autograd-tape recording, AMP casting, NaN checks and profiler tags exactly
+like built-ins — the python-level equivalent of registering a phi kernel.
+
+    from paddle_tpu.core.custom_op import register_op
+
+    @register_op("my_gelu", backward=my_gelu_grad)   # backward optional
+    def my_gelu(x):                                   # jnp / pallas_call body
+        return 0.5 * x * (1 + jnp.tanh(0.79788456 * (x + 0.044715 * x**3)))
+
+    out = paddle.utils.run_custom_op("my_gelu", tensor)   # or the returned fn
+
+Host-library ops (the reference's .so path): wrap the ctypes-loaded symbol
+in a numpy-bridge forward and register it the same way — see
+native/__init__.py for the loading pattern used by the framework itself.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+CUSTOM_OPS: Dict[str, dict] = {}
+
+
+def register_op(name: str, forward: Optional[Callable] = None,
+                backward: Optional[Callable] = None,
+                n_outputs: Optional[int] = None):
+    """Register a custom op. Usable as decorator or direct call.
+
+    forward(*jax_values, **attrs) -> jax value(s)
+    backward(res, *cotangents) -> input cotangents, given res = (inputs, outputs)
+    """
+
+    def _register(fwd: Callable):
+        import jax
+
+        if backward is not None:
+            @jax.custom_vjp
+            def op_fn(*vals, **attrs):
+                return fwd(*vals, **attrs)
+
+            def op_fwd(*vals, **attrs):
+                out = fwd(*vals, **attrs)
+                return out, (vals, out)
+
+            def op_bwd(res, g):
+                return tuple(backward(res, g))
+
+            op_fn.defvjp(op_fwd, op_bwd)
+        else:
+            op_fn = fwd
+
+        def api(*tensors, **attrs):
+            from .dispatch import primitive
+
+            return primitive(name, lambda *v: op_fn(*v, **attrs), list(tensors),
+                             n_outputs=n_outputs)
+
+        CUSTOM_OPS[name] = {"forward": fwd, "backward": backward, "api": api}
+        api.__name__ = name
+        return api
+
+    if forward is not None:
+        return _register(forward)
+    return _register
+
+
+def run_custom_op(name: str, *tensors, **attrs):
+    """Invoke a registered custom op by name (reference:
+    _run_custom_op / custom op dispatch)."""
+    if name not in CUSTOM_OPS:
+        raise KeyError(f"custom op '{name}' is not registered")
+    return CUSTOM_OPS[name]["api"](*tensors, **attrs)
+
+
+def get_custom_op(name: str):
+    return CUSTOM_OPS.get(name)

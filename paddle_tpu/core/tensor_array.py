@@ -1,0 +1,122 @@
+"""Auxiliary tensor containers (reference:
+paddle/phi/core/tensor_array.h TensorArray,
+paddle/phi/core/selected_rows.h SelectedRows,
+paddle/phi/core/string_tensor.h StringTensor).
+
+TPU-native notes: TensorArray inside compiled code is a `lax.scan` output —
+this eager container covers the dynamic-graph API (write/read/stack) and
+converts to a stacked array at the jit boundary. SelectedRows represents
+row-sparse gradients (embedding tails); on TPU the dense scatter-add is
+usually faster than gather-compaction, so SelectedRows is an interchange
+format, with `to_dense`/`merge` the conversion points. StringTensor is
+host-side by design (TPUs do not compute on strings; tokenizers run in the
+input pipeline).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .tensor import Tensor
+
+
+class TensorArray:
+    """Growable list of same-rank tensors (reference TensorArray)."""
+
+    def __init__(self, values: Optional[Sequence[Tensor]] = None):
+        self._items: List[Tensor] = list(values or [])
+
+    def append(self, t) -> "TensorArray":
+        self._items.append(t if isinstance(t, Tensor) else Tensor(t))
+        return self
+
+    write = append
+
+    def read(self, i: int) -> Tensor:
+        return self._items[i]
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def __len__(self):
+        return len(self._items)
+
+    def stack(self, axis: int = 0) -> Tensor:
+        from ..ops.manipulation import stack
+
+        return stack(self._items, axis)
+
+    def concat(self, axis: int = 0) -> Tensor:
+        from ..ops.manipulation import concat
+
+        return concat(self._items, axis)
+
+    def pop(self, i: int = -1) -> Tensor:
+        return self._items.pop(i)
+
+
+class SelectedRows:
+    """Row-sparse value container (reference SelectedRows): `rows` are the
+    touched indices of a [height, ...] dense space, `value` their data."""
+
+    def __init__(self, rows, value, height: int):
+        self.rows = rows if isinstance(rows, Tensor) else Tensor(np.asarray(rows))
+        self.value = value if isinstance(value, Tensor) else Tensor(np.asarray(value))
+        self.height = int(height)
+
+    def to_dense(self) -> Tensor:
+        import jax
+
+        from .dispatch import primitive
+
+        h = self.height
+
+        def fn(rows, vals):
+            return jax.ops.segment_sum(vals, rows, h)
+
+        return primitive("selected_rows_to_dense", fn, [self.rows, self.value])
+
+    def merge(self) -> "SelectedRows":
+        """Deduplicate rows by summation (reference merge_selected_rows)."""
+        idx = np.asarray(self.rows.numpy())
+        uniq, inv = np.unique(idx, return_inverse=True)
+        import jax
+
+        from .dispatch import primitive
+
+        n = len(uniq)
+        vals = primitive(
+            "merge_selected_rows",
+            lambda v: jax.ops.segment_sum(v, np.asarray(inv), n),
+            [self.value])
+        return SelectedRows(uniq.astype(np.int64), vals, self.height)
+
+    def __repr__(self):
+        return f"SelectedRows(height={self.height}, nnz_rows={self.rows.shape[0]})"
+
+
+class StringTensor:
+    """Host-side string tensor (reference StringTensor) — numpy object array
+    with shape semantics; compute stays in the input pipeline."""
+
+    def __init__(self, data, name: Optional[str] = None):
+        self._data = np.asarray(data, dtype=object)
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def __getitem__(self, i):
+        out = self._data[i]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __len__(self):
+        return self._data.shape[0]
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape})"

