@@ -192,6 +192,73 @@ def _pure_jax_gpt_control(cfg, batch, seq, steps):
     return {"pure_jax_tokens_per_sec": round(batch * seq * steps / dt, 2)}
 
 
+def bench_llama(on_tpu):
+    """LLaMA-style decoder (GQA + rope + RMSNorm + SwiGLU) training
+    tokens/sec — exercises the Pallas flash fwd+bwd path at longer seq."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import LlamaForCausalLM, LlamaPretrainingCriterion, llama_tiny
+
+    if on_tpu:
+        from paddle_tpu.models import LlamaConfig
+
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          intermediate_size=2048, max_position_embeddings=2048)
+        batch, seq, steps = 4, 2048, int(os.environ.get("BENCH_STEPS", "10"))
+    else:
+        cfg = llama_tiny()
+        batch, seq, steps = 4, 128, 5
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    criterion = LlamaPretrainingCriterion(cfg)
+    if on_tpu:
+        paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def loss_fn(ids):
+        if on_tpu:
+            with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+                logits = model(ids)
+        else:
+            logits = model(ids)
+        return criterion(logits, ids)
+
+    step = TrainStep(model=model, optimizer=opt, loss_fn=loss_fn)
+    rs = np.random.RandomState(0)
+    ids = paddle.Tensor(rs.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64),
+                        stop_gradient=True)
+    _sync(step(ids))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    name = "llama_124m_gqa" if on_tpu else "llama_tiny"
+    tok_s = batch * seq * steps / dt
+    flops = _llama_flops_per_step(batch, seq, cfg)
+    extras = {"tflops_per_sec": round(flops * steps / dt / 1e12, 2)}
+    return f"{name}_train_tokens_per_sec", tok_s, "tokens/sec", extras
+
+
+def _llama_flops_per_step(batch, seq, cfg):
+    """Exact matmul-parameter accounting for the LLaMA shape (GQA + SwiGLU
+    differ from GPT's 12h² per layer): train FLOPs = 3 × fwd, fwd matmul
+    FLOPs = 2 · tokens · params, attention = 4·B·S²·h per layer fwd."""
+    h = cfg.hidden_size
+    d = h // cfg.num_attention_heads
+    kv = cfg.num_key_value_heads * d
+    ffn = cfg.intermediate_size
+    per_layer = h * (h + 2 * kv) + h * h + 3 * h * ffn
+    matmul_params = cfg.num_hidden_layers * per_layer + h * cfg.vocab_size
+    tokens = batch * seq
+    fwd = 2.0 * tokens * matmul_params + cfg.num_hidden_layers * 4.0 * batch * seq * seq * h
+    return 3.0 * fwd
+
+
 def bench_bert(on_tpu):
     import numpy as np
 
@@ -295,7 +362,7 @@ def _worker():
     on_tpu = platform == "tpu"
     mode = os.environ.get("BENCH_MODE", "gpt")
     metric, value, unit, extras = {
-        "gpt": bench_gpt, "bert": bench_bert, "resnet": bench_resnet,
+        "gpt": bench_gpt, "bert": bench_bert, "resnet": bench_resnet, "llama": bench_llama,
     }[mode](on_tpu)
     peak = _peak_tflops(getattr(dev, "device_kind", "")) if on_tpu else None
     mfu = (round(extras["tflops_per_sec"] / peak, 4)

@@ -26,3 +26,13 @@ from .gpt import (  # noqa: F401
     gpt2_medium,
     gpt_1p3b,
 )
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaDecoderLayer,
+    LlamaForCausalLM,
+    LlamaModel,
+    LlamaPretrainingCriterion,
+    llama2_7b,
+    llama3_8b,
+    llama_tiny,
+)
