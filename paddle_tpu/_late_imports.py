@@ -185,3 +185,10 @@ try:
                 "StringTensor"]
 except ImportError:
     pass
+
+try:
+    from . import geometric  # noqa: F401
+
+    __all__.append("geometric")
+except ImportError:
+    pass

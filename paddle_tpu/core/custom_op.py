@@ -39,27 +39,30 @@ def register_op(name: str, forward: Optional[Callable] = None,
     def _register(fwd: Callable):
         import jax
 
-        if backward is not None:
-            @jax.custom_vjp
-            def op_fn(*vals, **attrs):
-                return fwd(*vals, **attrs)
-
-            def op_fwd(*vals, **attrs):
-                out = fwd(*vals, **attrs)
-                return out, (vals, out)
-
-            def op_bwd(res, g):
-                return tuple(backward(res, g))
-
-            op_fn.defvjp(op_fwd, op_bwd)
-        else:
-            op_fn = fwd
-
         def api(*tensors, **attrs):
             from .dispatch import primitive
 
-            return primitive(name, lambda *v: op_fn(*v, **attrs), list(tensors),
-                             n_outputs=n_outputs)
+            if backward is not None:
+                # custom_vjp rejects **kwargs; close the attrs into a
+                # positional-only wrapper built per call (trace-time only)
+                @jax.custom_vjp
+                def op_fn(*vals):
+                    return fwd(*vals, **attrs)
+
+                def op_fwd(*vals):
+                    out = fwd(*vals, **attrs)
+                    return out, (vals, out)
+
+                def op_bwd(res, g):
+                    return tuple(backward(res, g))
+
+                op_fn.defvjp(op_fwd, op_bwd)
+                impl = op_fn
+            else:
+                def impl(*vals):
+                    return fwd(*vals, **attrs)
+
+            return primitive(name, impl, list(tensors), n_outputs=n_outputs)
 
         CUSTOM_OPS[name] = {"forward": fwd, "backward": backward, "api": api}
         api.__name__ = name

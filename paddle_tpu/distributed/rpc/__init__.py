@@ -1,0 +1,164 @@
+"""paddle.distributed.rpc parity (reference: python/paddle/distributed/rpc/
+— init_rpc / rpc_sync / rpc_async / shutdown over a gRPC agent).
+
+TPU-native: the control-plane transport is the framework's native TCPStore
+(the same server that backs rendezvous + elastic), not a second RPC stack.
+Each worker runs a small dispatcher thread that polls its inbox key,
+executes the pickled callable, and posts the pickled result; rpc_sync/
+rpc_async are futures over that. This intentionally covers the reference's
+*control* use cases (coordination, light metadata exchange) — bulk tensor
+movement belongs to the XLA collective path, not RPC.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+from ...base.log import get_logger
+
+_state: Dict = {"store": None, "name": None, "rank": None, "world": None,
+                "thread": None, "stop": None, "names": {}}
+
+
+class WorkerInfo:
+    def __init__(self, name: str, rank: int):
+        self.name = name
+        self.rank = rank
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank})"
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None, master_endpoint: Optional[str] = None):
+    """Join the RPC group (reference rpc.init_rpc)."""
+    from ...native import TCPStore
+
+    rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world = world_size if world_size is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    ep = master_endpoint or os.environ.get("PADDLE_MASTER", "127.0.0.1:49381")
+    host, _, port = ep.rpartition(":")
+    store = TCPStore(host or "127.0.0.1", int(port), is_master=(rank == 0),
+                     world_size=world)
+    _state.update(store=store, name=name, rank=rank, world=world)
+    store.set(f"rpc/name/{rank}", name)
+    store.add("rpc/joined", 1)
+    stop = threading.Event()
+    _state["stop"] = stop
+
+    def serve():
+        # the TCPStore client socket is not thread-safe: the dispatcher runs
+        # on its own client connection to the same server
+        serve_store = TCPStore(host or "127.0.0.1", int(port), is_master=False,
+                               world_size=world)
+        seq = 0
+        while not stop.is_set():
+            key = f"rpc/inbox/{rank}/{seq}"
+            try:
+                raw = serve_store.get(key, timeout=0.5)
+            except Exception:
+                continue
+            seq += 1
+            try:
+                req = pickle.loads(raw)
+                fn, args, kwargs = req["fn"], req["args"], req["kwargs"]
+                try:
+                    result = ("ok", fn(*args, **kwargs))
+                except Exception as e:  # executed remotely: report, don't die
+                    result = ("err", repr(e))
+                serve_store.set(f"rpc/result/{req['id']}", pickle.dumps(result))
+            except Exception as e:
+                get_logger().warning("rpc dispatcher error: %s", e)
+        serve_store.close()
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+    _state["thread"] = th
+    # wait for the full group
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if store.add("rpc/joined", 0) >= world:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("init_rpc: group did not assemble")
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    store = _state["store"]
+    if name is None:
+        return WorkerInfo(_state["name"], _state["rank"])
+    for r in range(_state["world"]):
+        n = store.get(f"rpc/name/{r}", timeout=5.0).decode()
+        if n == name:
+            return WorkerInfo(n, r)
+    raise KeyError(f"unknown rpc worker {name!r}")
+
+
+def get_all_worker_infos():
+    store = _state["store"]
+    return [WorkerInfo(store.get(f"rpc/name/{r}", timeout=5.0).decode(), r)
+            for r in range(_state["world"])]
+
+
+def _post(to: str, fn, args, kwargs) -> str:
+    store = _state["store"]
+    info = get_worker_info(to)
+    req_id = uuid.uuid4().hex
+    payload = pickle.dumps({"id": req_id, "fn": fn, "args": args, "kwargs": kwargs})
+    seq = store.add(f"rpc/seq/{info.rank}", 1) - 1
+    store.set(f"rpc/inbox/{info.rank}/{seq}", payload)
+    return req_id
+
+
+def _wait(req_id: str, timeout: Optional[float]):
+    store = _state["store"]
+    raw = store.get(f"rpc/result/{req_id}", timeout=timeout or 60.0)
+    status, value = pickle.loads(raw)
+    if status == "err":
+        raise RuntimeError(f"remote function raised: {value}")
+    return value
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: Optional[float] = None):
+    """Execute fn on worker `to`, block for the result (reference rpc_sync)."""
+    return _wait(_post(to, fn, tuple(args), kwargs or {}), timeout)
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout: Optional[float] = None):
+    """Fire-and-collect future (reference rpc_async)."""
+    req_id = _post(to, fn, tuple(args), kwargs or {})
+    fut: Future = Future()
+
+    def collect():
+        try:
+            fut.set_result(_wait(req_id, timeout))
+        except Exception as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=collect, daemon=True).start()
+    return fut
+
+
+def shutdown():
+    """Leave the group (reference rpc.shutdown): barrier on completion."""
+    store = _state.get("store")
+    if store is None:
+        return
+    stop = _state["stop"]
+    store.add("rpc/done", 1)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if store.add("rpc/done", 0) >= _state["world"]:
+            break
+        time.sleep(0.05)
+    stop.set()
+    th = _state.get("thread")
+    if th is not None:
+        th.join(timeout=5)
+    _state.update(store=None, thread=None, stop=None)

@@ -1,0 +1,62 @@
+"""paddle.geometric parity surface (reference: python/paddle/geometric/ —
+message passing send_u_recv/send_ue_recv/send_uv, segment reductions,
+sampling, reindex). All backed by jax segment ops (ops/sequence_ops.py) —
+the TPU-friendly sorted-scatter path for graph aggregation.
+"""
+from __future__ import annotations
+
+from ..ops.sequence_ops import (  # noqa: F401
+    graph_khop_sampler,
+    graph_sample_neighbors,
+    reindex_graph,
+    send_u_recv,
+    send_ue_recv,
+    send_uv,
+    weighted_sample_neighbors,
+)
+
+
+def segment_sum(data, segment_ids, name=None):
+    from ..ops.pooling import segment_pool
+
+    return segment_pool(data, segment_ids, "SUM")
+
+
+def segment_mean(data, segment_ids, name=None):
+    from ..ops.pooling import segment_pool
+
+    return segment_pool(data, segment_ids, "MEAN")
+
+
+def segment_max(data, segment_ids, name=None):
+    from ..ops.pooling import segment_pool
+
+    return segment_pool(data, segment_ids, "MAX")
+
+
+def segment_min(data, segment_ids, name=None):
+    from ..ops.pooling import segment_pool
+
+    return segment_pool(data, segment_ids, "MIN")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """(reference paddle.geometric.sample_neighbors → (neighbors, count)
+    or (neighbors, count, eids) with return_eids)."""
+    return graph_sample_neighbors(row, colptr, input_nodes, eids=eids,
+                                  sample_size=sample_size,
+                                  return_eids=return_eids)
+
+
+def reindex_heter_graph(x, neighbors, count, name=None):
+    """Heterogeneous reindex: neighbors/count given per edge type."""
+    outs = [reindex_graph(x, nb, ct) for nb, ct in zip(neighbors, count)]
+    return outs
+
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv", "segment_sum", "segment_mean",
+    "segment_max", "segment_min", "sample_neighbors", "reindex_graph",
+    "reindex_heter_graph", "graph_khop_sampler", "weighted_sample_neighbors",
+]

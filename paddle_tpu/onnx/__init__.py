@@ -4,9 +4,10 @@ paddle2onnx converting the static program to an ONNX graph).
 TPU-native: the framework's portable interchange format is StableHLO (the
 jit.save export path) — XLA's own stable serialization, loadable by any
 PJRT runtime and convertible offline. ``export`` therefore always writes
-the StableHLO bundle next to the requested path; when the ``onnx`` python
-package is importable it additionally converts elementwise/linear graphs,
-otherwise it raises with instructions, never silently producing nothing.
+the StableHLO bundle next to the requested path and then raises with
+instructions pointing at it: direct ONNX graph construction is not
+implemented (and the ``onnx`` package is absent in the TPU image). The
+raise is deliberate — never silently pretend a ``.onnx`` file exists.
 """
 from __future__ import annotations
 
@@ -17,8 +18,9 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
            opset_version: int = 11, **configs):
     """Export ``layer`` for interchange (reference paddle.onnx.export API).
 
-    Writes ``<path>.pdiparams`` + ``<path>.stablehlo.json`` via jit.save;
-    produces ``<path>.onnx`` only when the optional onnx package exists.
+    Writes ``<path>.pdiparams`` + the StableHLO program via jit.save, then
+    raises (RuntimeError without the onnx package, NotImplementedError with
+    it) directing the caller to the portable bundle.
     """
     from ..jit import serialization
 

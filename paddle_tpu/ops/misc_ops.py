@@ -241,18 +241,18 @@ def lookup_table_dequant(w, ids, padding_idx=-1, name=None):
 
 
 def merge_selected_rows(x, name=None):
-    """Deduplicate a (rows, values) sparse-gradient pair by summing
-    duplicate rows (reference op: merge_selected_rows over SelectedRows).
-    Input here is a tuple (indices, values, height)."""
+    """Deduplicate a row-sparse gradient by summing duplicate rows
+    (reference op: merge_selected_rows over SelectedRows). Accepts a
+    core.tensor_array.SelectedRows (returns a merged SelectedRows) or a
+    (rows, values, height) tuple (returns (rows, values)); one
+    implementation lives on the SelectedRows class."""
+    from ..core.tensor_array import SelectedRows
+
+    if isinstance(x, SelectedRows):
+        return x.merge()
     idx, vals, height = x
-
-    def fn(iv, vv):
-        return jax.ops.segment_sum(vv, iv, int(height))
-
-    dense = primitive("merge_selected_rows", fn, [idx, vals])
-    nz = jnp.any(jnp.asarray(unwrap(dense)) != 0, axis=tuple(range(1, unwrap(dense).ndim)))
-    rows = jnp.nonzero(nz, size=nz.shape[0], fill_value=-1)[0]
-    return rows, dense
+    merged = SelectedRows(idx, vals, int(height)).merge()
+    return merged.rows, merged.value
 
 
 def match_matrix_tensor(x, y, w, dim_t=1, name=None):

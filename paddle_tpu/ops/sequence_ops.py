@@ -387,25 +387,32 @@ def graph_sample_neighbors(row, colptr, x, eids=None, perm_buffer=None,
                            sample_size=-1, return_eids=False, flag_perm_buffer=False,
                            name=None):
     """Uniform neighbor sampling over CSC graph (reference op:
-    graph_sample_neighbors). Host-side numpy (sampling is data-dependent
-    control flow — it stays off the TPU by design, like the reference's CPU
-    kernel)."""
+    graph_sample_neighbors → (neighbors, count[, eids])). Host-side numpy
+    (sampling is data-dependent control flow — it stays off the TPU by
+    design, like the reference's CPU kernel)."""
     import numpy as np
 
     r = np.asarray(unwrap(row))
     cp = np.asarray(unwrap(colptr))
     nodes = np.asarray(unwrap(x))
-    out_nb, out_cnt = [], []
+    ev = np.asarray(unwrap(eids)) if eids is not None else None
+    out_nb, out_cnt, out_eid = [], [], []
     rs = np.random.RandomState(0)
     for nid in nodes:
         lo, hi = int(cp[nid]), int(cp[nid + 1])
-        neigh = r[lo:hi]
-        if sample_size > 0 and len(neigh) > sample_size:
-            neigh = rs.choice(neigh, sample_size, replace=False)
-        out_nb.append(neigh)
-        out_cnt.append(len(neigh))
+        pos = np.arange(lo, hi)
+        if sample_size > 0 and len(pos) > sample_size:
+            pos = rs.choice(pos, sample_size, replace=False)
+        out_nb.append(r[pos])
+        out_cnt.append(len(pos))
+        if return_eids:
+            out_eid.append(ev[pos] if ev is not None else pos.astype(r.dtype))
     nb = np.concatenate(out_nb) if out_nb else np.zeros(0, r.dtype)
-    return Tensor(nb), Tensor(np.asarray(out_cnt, np.int32))
+    cnt = Tensor(np.asarray(out_cnt, np.int32))
+    if return_eids:
+        ei = np.concatenate(out_eid) if out_eid else np.zeros(0, r.dtype)
+        return Tensor(nb), cnt, Tensor(ei)
+    return Tensor(nb), cnt
 
 
 def weighted_sample_neighbors(row, colptr, edge_weight, x, eids=None,

@@ -102,8 +102,9 @@ class Imdb(_FileDataset):
                 labels.append(label)
                 for t in toks:
                     freq[t] = freq.get(t, 0) + 1
-        vocab = {w: i for i, (w, c) in enumerate(
-            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))) if c >= self.cutoff}
+        kept = [w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+                if c >= self.cutoff]
+        vocab = {w: i for i, w in enumerate(kept)}  # contiguous ids
         self.word_idx = vocab
         unk = len(vocab)
         self._samples = [
@@ -132,9 +133,9 @@ class Imikolov(_FileDataset):
                 lines.append(toks)
                 for t in toks:
                     freq[t] = freq.get(t, 0) + 1
-        vocab = {w: i for i, (w, c) in enumerate(
-            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
-            if c >= self.min_word_freq or w in ("<s>", "<e>")}
+        kept = [w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+                if c >= self.min_word_freq or w in ("<s>", "<e>")]
+        vocab = {w: i for i, w in enumerate(kept)}  # contiguous ids
         unk = len(vocab)
         self.word_idx = vocab
         for toks in lines:

@@ -1,0 +1,83 @@
+"""Tests for paddle.geometric, distributed.rpc and auto_tuner parity
+surfaces (reference: python/paddle/geometric/, distributed/rpc/,
+distributed/auto_tuner/)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_geometric_segment_ops():
+    import paddle_tpu.geometric as G
+
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+    seg = paddle.to_tensor(np.array([0, 0, 1, 1]))
+    np.testing.assert_allclose(G.segment_sum(x, seg).numpy(), [[2, 4], [10, 12]])
+    np.testing.assert_allclose(G.segment_mean(x, seg).numpy(), [[1, 2], [5, 6]])
+    np.testing.assert_allclose(G.segment_max(x, seg).numpy(), [[2, 3], [6, 7]])
+
+
+def test_geometric_message_passing():
+    import paddle_tpu.geometric as G
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 3).astype(np.float32)
+    si = np.array([0, 1, 2])
+    di = np.array([1, 2, 3])
+    out = G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(si),
+                        paddle.to_tensor(di), "SUM")
+    ref = np.zeros_like(x)
+    for s, d in zip(si, di):
+        ref[d] += x[s]
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def _double(v):
+    return v * 2
+
+
+def test_rpc_sync_async_roundtrip():
+    from paddle_tpu.distributed import rpc
+
+    port = 49500 + (os.getpid() % 300)
+    rpc.init_rpc("worker0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        info = rpc.get_worker_info()
+        assert info.name == "worker0" and info.rank == 0
+        assert rpc.rpc_sync("worker0", _double, args=(21,)) == 42
+        fut = rpc.rpc_async("worker0", _double, args=(5,))
+        assert fut.result(timeout=30) == 10
+        infos = rpc.get_all_worker_infos()
+        assert [w.name for w in infos] == ["worker0"]
+    finally:
+        rpc.shutdown()
+
+
+def test_auto_tuner_prunes_and_measures():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, ModelSpec
+
+    spec = ModelSpec(num_params=1_000_000, num_layers=4, hidden_size=64,
+                     num_heads=4, vocab_size=100, seq_len=64)
+    tuner = AutoTuner(spec, n_devices=8, batch_size=16)
+    cands = tuner.candidates()
+    assert cands and all(p.dp * p.mp * p.pp * p.sep == 8 for p in cands)
+    assert cands[0].dp == 8  # dp-first greedy ordering
+
+    seen = []
+
+    def build(plan):
+        if plan.pp > 1:
+            raise RuntimeError("simulated build failure")  # gets pruned
+
+        def step():
+            seen.append(plan.degrees)
+
+        return step
+
+    best = tuner.tune(build, steps=1, warmup=0)
+    assert best.pp == 1
+    assert any("error" in h for h in tuner.history) or all(
+        h["plan"]["pp_degree"] == 1 for h in tuner.history)
+    assert "ms/step" in best.reason
