@@ -31,6 +31,9 @@ _cache_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# subprocess-based tests (graft dryrun, elastic launch) inherit the cache
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -43,3 +46,10 @@ def _seeded():
     paddle.seed(1234)
     np.random.seed(1234)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy integration tests (large compiles / subprocesses); "
+        "deselect with -m 'not slow' for the <5-minute quick loop")

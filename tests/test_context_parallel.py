@@ -54,6 +54,7 @@ def test_ulysses_attention_matches_serial(causal):
     np.testing.assert_allclose(out.numpy(), expect, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grads_match_serial():
     qn, kn, vn = _qkv(s=16)
     q, k, v = (paddle.to_tensor(x, stop_gradient=False) for x in (qn, kn, vn))
@@ -112,6 +113,7 @@ def test_long_sequence_ring():
     np.testing.assert_allclose(out.numpy(), expect, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_gpt_with_context_parallel_trains():
     from paddle_tpu.jit.api import TrainStep
     from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny
@@ -125,6 +127,6 @@ def test_gpt_with_context_parallel_trains():
     ids = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (2, 32)).astype(np.int64))
     step = TrainStep(model=model, optimizer=opt, loss_fn=lambda x: crit(model(x), x))
     first = float(step(ids).numpy())
-    for _ in range(5):
+    for _ in range(2):
         last = float(step(ids).numpy())
     assert np.isfinite(last) and last < first

@@ -53,6 +53,7 @@ def test_attention_mask_blocks_padding():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sequence_classification_finetune_converges():
     cfg = bert_tiny()
     paddle.seed(1)
@@ -82,6 +83,7 @@ def test_pretraining_heads():
     assert model.bert.embeddings.word_embeddings.weight.grad is not None
 
 
+@pytest.mark.slow
 def test_tensor_parallel_parity():
     from paddle_tpu.distributed import fleet
 

@@ -35,6 +35,7 @@ def test_forward_shape_and_grad():
     assert np.isfinite(float(loss.numpy()))
 
 
+@pytest.mark.slow
 def test_train_step_loss_decreases():
     cfg = gpt_tiny()
     paddle.seed(7)
@@ -80,6 +81,7 @@ def test_tensor_parallel_parity():
     )
 
 
+@pytest.mark.slow
 def test_graft_entry_single_and_multichip():
     import sys
 

@@ -125,6 +125,7 @@ def test_schedule_is_stage_parallel():
     assert "while" in hlo  # the tick loop
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_parallel_trains():
     from paddle_tpu.jit.api import TrainStep
     from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny

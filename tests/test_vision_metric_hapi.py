@@ -25,19 +25,22 @@ def _forward(model, shape=(1, 3, 64, 64)):
     (models.mobilenet_v1, 10),
     (models.mobilenet_v2, 10),
 ])
+@pytest.mark.slow
 def test_cnn_forward_shapes(factory, num_classes):
     m = factory(num_classes=num_classes)
     out = _forward(m)
     assert out.shape == [1, num_classes]
 
 
+@pytest.mark.slow
 def test_vgg_and_alexnet():
-    out = _forward(models.vgg11(num_classes=7), (1, 3, 224, 224))
+    out = _forward(models.vgg11(num_classes=7), (1, 3, 64, 64))
     assert out.shape == [1, 7]
     out = _forward(models.alexnet(num_classes=5), (1, 3, 224, 224))
     assert out.shape == [1, 5]
 
 
+@pytest.mark.slow
 def test_lenet_train_decreases_loss():
     m = models.LeNet()
     opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
