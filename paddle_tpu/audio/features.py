@@ -1,0 +1,113 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py —
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC). Composed from the
+signal.stft + audio.functional mel/dct helpers; everything is jnp so feature
+extraction fuses into the compiled input pipeline on TPU.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..nn.layer.layers import Layer
+from . import functional as AF
+
+
+class Spectrogram(Layer):
+    """Magnitude/power spectrogram over STFT frames (reference
+    features.Spectrogram)."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = 512,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 1.0, center: bool = True, pad_mode: str = "reflect",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or 512  # reference default hop
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self._window = AF.get_window(window, self.win_length)
+
+    def forward(self, x):
+        from .. import signal
+
+        spec = signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                           window=self._window, center=self.center,
+                           pad_mode=self.pad_mode)
+        return primitive("spectrogram",
+                         lambda v: jnp.abs(v) ** self.power, [spec])
+
+
+class MelSpectrogram(Layer):
+    """Mel-filterbank spectrogram (reference features.MelSpectrogram)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 2048,
+                 hop_length: Optional[int] = 512, win_length: Optional[int] = None,
+                 window: str = "hann", power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode)
+        self.n_mels = n_mels
+        fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk=htk,
+                                        norm=norm)
+        self._fbank = jnp.asarray(fbank._value if hasattr(fbank, '_value') else fbank)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)  # (..., freq, time)
+        return primitive("mel_spectrogram",
+                         lambda v: jnp.einsum("mf,...ft->...mt", self._fbank, v),
+                         [spec])
+
+
+class LogMelSpectrogram(Layer):
+    """Log-compressed mel spectrogram (reference features.LogMelSpectrogram)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 2048,
+                 hop_length: Optional[int] = 512, win_length: Optional[int] = None,
+                 window: str = "hann", power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min, f_max,
+                                  htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+        return AF.power_to_db(m, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """Mel-frequency cepstral coefficients (reference features.MFCC)."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 2048,
+                 hop_length: Optional[int] = 512, win_length: Optional[int] = None,
+                 window: str = "hann", power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode, n_mels,
+                                        f_min, f_max, htk, norm, ref_value, amin,
+                                        top_db)
+        dct = AF.create_dct(n_mfcc, n_mels)
+        self._dct = jnp.asarray(dct._value if hasattr(dct, '_value') else dct)
+
+    def forward(self, x):
+        lm = self.logmel(x)
+        return primitive("mfcc",
+                         lambda v: jnp.einsum("mc,...mt->...ct", self._dct, v),
+                         [lm])

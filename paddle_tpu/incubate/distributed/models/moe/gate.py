@@ -76,16 +76,27 @@ class NaiveGate(BaseGate):
 
 
 class GShardGate(BaseGate):
-    """Top-2 with renormalized weights + balance loss (reference gshard_gate.py)."""
+    """Top-2 with renormalized weights, random second-expert drop and
+    balance loss (reference gshard_gate.py: random_routing keeps expert 2
+    only with probability 2·p₂, the GShard exploration rule)."""
 
     def __init__(self, d_model, num_expert=None, world_size=1, topk=2,
-                 capacity=(1.2, 2.4), group=None, num_experts=None):
+                 capacity=(1.2, 2.4), group=None, num_experts=None,
+                 random_routing=True):
         total = (num_experts if num_experts is not None else num_expert * world_size)
         super().__init__(d_model, total, topk)
         self.capacity = capacity
+        self.random_routing = random_routing
 
     def forward(self, x):
-        return self._route(x, normalize=True)
+        value, idx, aux = self._route(x, normalize=True)
+        if self.random_routing and self.training and idx.shape[-1] >= 2:
+            from .....incubate.moe_ops import random_routing as rr
+            from .....ops.random import uniform
+
+            prob = uniform([value.shape[0]], min=0.0, max=1.0)
+            idx = rr(idx, value, prob)
+        return value, idx, aux
 
 
 class SwitchGate(BaseGate):

@@ -1,0 +1,178 @@
+"""paddle.incubate.nn fused layers (reference:
+python/paddle/incubate/nn/layer/fused_transformer.py — FusedMultiHeadAttention,
+FusedFeedForward, FusedTransformerEncoderLayer; fused_linear;
+FusedDropoutAdd). Thin Layer wrappers over ops/fused_ops.py composites: the
+"fusion" is one traced region XLA compiles into fused kernels, so these
+carry the reference API without hand-written CUDA.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from ...ops import fused_ops
+
+
+class FusedLinear(Layer):
+    """(reference incubate.nn.FusedLinear / functional.fused_linear).
+    transpose_weight=True stores the weight [out, in] (the reference's
+    transposed layout, matmul-ing with y = x @ W.T)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        from ... import nn
+
+        self.transpose_weight = transpose_weight
+        if transpose_weight:
+            from ...core.tensor import Parameter
+
+            init = nn.Linear(in_features, out_features,
+                             weight_attr=weight_attr, bias_attr=bias_attr)
+            self.weight = Parameter(init.weight._value.T)  # [out, in] layout
+            self.bias = init.bias
+            self._linear = None
+        else:
+            self._linear = nn.Linear(in_features, out_features,
+                                     weight_attr=weight_attr, bias_attr=bias_attr)
+            self.weight = self._linear.weight
+            self.bias = self._linear.bias
+
+    def forward(self, x):
+        if self.transpose_weight:
+            from ...ops import manipulation
+            from ...ops.math import matmul
+
+            out = matmul(x, manipulation.transpose(self.weight, [1, 0]))
+            return out + self.bias if self.bias is not None else out
+        return self._linear(x)
+
+
+class FusedDropoutAdd(Layer):
+    """(reference incubate.nn.FusedDropoutAdd): dropout(x) + y fused."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return fused_ops.fused_dropout_add(x, y, p=self.p,
+                                           is_test=not self.training,
+                                           mode=self.mode)
+
+
+class FusedMultiHeadAttention(Layer):
+    """(reference incubate.nn.FusedMultiHeadAttention): pre/post-LN +
+    packed-QKV attention + out projection + residual, one fused region."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None, ln_scale_attr=None,
+                 ln_bias_attr=None, epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        from ... import nn
+
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.qkv = nn.Linear(embed_dim, 3 * embed_dim, weight_attr=qkv_weight_attr,
+                             bias_attr=qkv_bias_attr)
+        self.out_proj = nn.Linear(embed_dim, embed_dim,
+                                  weight_attr=linear_weight_attr,
+                                  bias_attr=linear_bias_attr)
+        self.ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        from ...nn import functional as F
+        from ...ops import manipulation
+
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        b, s = x.shape[0], x.shape[1]
+        d = self.embed_dim // self.num_heads
+        qkv = manipulation.reshape(self.qkv(x), [b, s, 3, self.num_heads, d])
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, training=self.training)
+        out = self.out_proj(manipulation.reshape(out, [b, s, self.embed_dim]))
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """(reference incubate.nn.FusedFeedForward): LN + fc1 + act + dropout +
+    fc2 + residual."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-5,
+                 activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None, ln1_bias_attr=None,
+                 ln2_scale_attr=None, ln2_bias_attr=None, nranks=1, ring_id=-1,
+                 name=None):
+        super().__init__()
+        from ... import nn
+
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = act_dropout_rate if act_dropout_rate is not None else dropout_rate
+        self.activation = activation
+        self.fc1 = nn.Linear(d_model, dim_feedforward,
+                             weight_attr=linear1_weight_attr, bias_attr=linear1_bias_attr)
+        self.fc2 = nn.Linear(dim_feedforward, d_model,
+                             weight_attr=linear2_weight_attr, bias_attr=linear2_bias_attr)
+        self.ln = nn.LayerNorm(d_model, epsilon=epsilon)
+
+    def forward(self, x):
+        from ...nn import functional as F
+
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        h = fused_ops.fused_bias_act(self.fc1(x), act_method=self.activation)
+        h = F.dropout(h, self.act_dropout_rate, training=self.training)
+        h = self.fc2(h)
+        h = F.dropout(h, self.dropout_rate, training=self.training)
+        out = residual + h
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """(reference incubate.nn.FusedTransformerEncoderLayer)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, name=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate is not None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+__all__ = ["FusedLinear", "FusedDropoutAdd", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer"]

@@ -1,4 +1,40 @@
 """Pallas TPU kernels for the fusion tier (reference analog:
 paddle/phi/kernels/fusion/*.cu). Each module exposes ``available()`` plus the
-op; callers fall back to XLA compositions when unavailable (CPU tests)."""
-from . import flash_attention, rms_norm  # noqa: F401
+op; callers fall back to XLA compositions when unavailable (CPU tests).
+
+``self_test(name, probe)`` is the shared once-per-process hardware probe:
+kernels gate on a tiny real-device run so a Mosaic lowering/toolchain
+failure downgrades to the XLA path instead of killing the training step.
+"""
+from typing import Callable, Dict
+
+_SELF_TESTS: Dict[str, bool] = {}
+
+
+def self_test(name: str, probe: Callable[[], None]) -> bool:
+    """Run ``probe`` once on the real device; cache pass/fail per process."""
+    if name in _SELF_TESTS:
+        return _SELF_TESTS[name]
+    try:
+        probe()
+        _SELF_TESTS[name] = True
+    except Exception as e:  # pragma: no cover - hardware/toolchain specific
+        from ...base.log import get_logger
+
+        get_logger().warning(
+            "pallas %s self-test failed (%s); falling back to XLA",
+            name, str(e).split("\n")[0])
+        _SELF_TESTS[name] = False
+    return _SELF_TESTS[name]
+
+
+def on_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+from . import flash_attention, flashmask, rms_norm  # noqa: F401,E402

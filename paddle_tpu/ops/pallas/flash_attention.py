@@ -31,8 +31,23 @@ def _on_tpu() -> bool:
         return False
 
 
+def _probe():
+    """Tiny fwd+bwd on the real device (shared self_test gate: a Mosaic
+    failure downgrades flash to the XLA composition instead of killing the
+    training step — the bench's headline number must survive a kernel
+    regression)."""
+    q = jnp.ones((1, 256, 1, 64), jnp.bfloat16)
+    out = flash_attention_value(q, q, q, True, 0.125)
+    g = jax.grad(lambda a: flash_attention_value(a, a, a, True, 0.125).astype(
+        jnp.float32).sum())(q)
+    jax.block_until_ready((out, g))
+
+
 def available() -> bool:
-    return get_flag("use_pallas_kernels") and _on_tpu()
+    from . import self_test
+
+    return (get_flag("use_pallas_kernels") and _on_tpu()
+            and self_test("flash_attention", _probe))
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q,

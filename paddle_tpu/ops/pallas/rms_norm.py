@@ -22,8 +22,17 @@ def _on_tpu() -> bool:
         return False
 
 
+def _probe():
+    x = jnp.ones((8, 128), jnp.bfloat16)
+    w = jnp.ones((128,), jnp.bfloat16)
+    jax.block_until_ready(rms_norm_value(x, w))
+
+
 def available() -> bool:
-    return get_flag("use_pallas_kernels") and _on_tpu()
+    from . import self_test
+
+    return (get_flag("use_pallas_kernels") and _on_tpu()
+            and self_test("rms_norm", _probe))
 
 
 def _rms_kernel(x_ref, w_ref, o_ref, *, eps):

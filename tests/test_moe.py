@@ -22,6 +22,7 @@ def _x(t=16, d=8, seed=0):
 
 def test_gate_shapes_and_aux():
     g = GShardGate(8, num_experts=4, topk=2)
+    g.eval()  # deterministic: no random 2nd-expert drop (-1 markers)
     val, idx, aux = g(_x())
     assert val.shape == [16, 2] and idx.shape == [16, 2]
     assert (idx.numpy() >= 0).all() and (idx.numpy() < 4).all()
@@ -45,6 +46,7 @@ def test_moe_layer_identity_when_experts_are_identity():
 
     layer = MoELayer(8, experts=[Identity() for _ in range(4)], gate="gshard",
                      capacity_factor=8.0)
+    layer.eval()  # random 2nd-expert drop is a training-only policy
     x = _x()
     out = layer(x)
     np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-4, atol=1e-5)
@@ -102,6 +104,7 @@ def test_moe_under_jit_matches_eager():
 
     paddle.seed(3)
     layer = MoELayer(8, num_experts=4, d_hidden=16, gate="gshard", capacity_factor=4.0)
+    layer.eval()  # deterministic routing for jit-vs-eager parity
     x = _x(16, seed=5)
     eager = layer(x).numpy()
 
@@ -116,3 +119,20 @@ def test_moe_3d_input():
     layer = MoELayer(8, num_experts=4, d_hidden=16, gate="gshard", capacity_factor=4.0)
     x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8, 8).astype(np.float32))
     assert layer(x).shape == [2, 8, 8]
+
+
+def test_gshard_random_routing_drops_low_prob_second_expert():
+    """Training-mode GShard: the 2nd expert is kept only with prob 2*p2
+    (reference gshard_gate random routing); dropped slots are marked -1 and
+    dispatch to no expert."""
+    paddle.seed(9)
+    g = GShardGate(8, num_experts=4, topk=2)
+    g.train()
+    val, idx, _ = g(_x(64))
+    dropped = (idx.numpy()[:, 1] == -1)
+    assert dropped.any()  # with renormalized top-2, some p2 < ~0.25 exist
+    assert (idx.numpy()[:, 0] >= 0).all()  # first expert never dropped
+
+    g.eval()
+    _, idx_eval, _ = g(_x(64))
+    assert (idx_eval.numpy() >= 0).all()
