@@ -13,6 +13,7 @@ import numpy as np
 
 from ...nn.layer.layers import Layer
 from ...ops import fused_ops
+from . import functional  # noqa: F401
 
 
 class FusedLinear(Layer):

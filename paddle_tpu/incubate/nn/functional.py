@@ -1,0 +1,94 @@
+"""paddle.incubate.nn.functional parity (reference:
+python/paddle/incubate/nn/functional/ — the functional faces of the fused
+transformer ops). Direct re-exports of the ops/fused_ops composites plus
+thin signature adapters where the reference argument order differs.
+"""
+from __future__ import annotations
+
+from ...nn.functional.flash_attention import (  # noqa: F401
+    flashmask_attention,
+    fused_softmax_mask,
+    fused_softmax_mask_upper_triangle,
+)
+from ...ops.fused_ops import (  # noqa: F401
+    blha_get_max_len,
+    block_multihead_attention_ as block_multihead_attention,
+    fused_bias_act,
+    fused_bias_dropout_residual_layer_norm,
+    fused_dot_product_attention,
+    fused_dropout_add,
+    fused_linear_param_grad_add,
+    fused_moe,
+    fused_multi_transformer_ as fused_multi_transformer,
+    fused_rotary_position_embedding,
+)
+from ...ops.quant_ops import (  # noqa: F401
+    llm_int8_linear,
+    weight_dequantize,
+    weight_only_linear,
+    weight_quantize,
+)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """(reference incubate.nn.functional.fused_linear)."""
+    from ...ops.math import matmul
+
+    out = matmul(x, weight, transpose_y=transpose_weight)
+    return out + bias if bias is not None else out
+
+
+def _check_last_axis(x, begin_norm_axis, op):
+    ndim = len(x.shape)
+    if begin_norm_axis not in (-1, ndim - 1):
+        raise NotImplementedError(
+            f"{op}: only last-axis normalization is implemented "
+            f"(begin_norm_axis={begin_norm_axis}, ndim={ndim}); flatten the "
+            "trailing dims first")
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None,
+                     residual_alpha=1.0, name=None):
+    """(reference incubate.nn.functional.fused_layer_norm → (out,
+    residual_out))."""
+    from ...ops.fused_ops import fused_bias_residual_layernorm
+    from ...ops.math import add
+
+    _check_last_axis(x, begin_norm_axis, "fused_layer_norm")
+    out = fused_bias_residual_layernorm(
+        x, bias=bias, residual=residual, norm_weight=norm_weight,
+        norm_bias=norm_bias, epsilon=epsilon, residual_alpha=residual_alpha,
+        begin_norm_axis=begin_norm_axis)
+    residual_out = x
+    if bias is not None:
+        residual_out = add(residual_out, bias)
+    if residual is not None:
+        residual_out = add(residual_out, residual)
+    return out, residual_out
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None, name=None):
+    """(reference incubate.nn.functional.fused_rms_norm → (out,
+    residual_out)) — routes through the Pallas rms_norm on TPU.
+    residual_out is the pre-norm sum feeding the next skip connection."""
+    from ...nn import functional as F
+    from ...ops.math import add
+
+    _check_last_axis(x, begin_norm_axis, "fused_rms_norm")
+    v = x
+    if bias is not None:
+        v = add(v, bias)
+    if residual is not None:
+        v = add(v, residual)
+    out = F.rms_norm(v, norm_weight, epsilon=epsilon)
+    if norm_bias is not None:
+        out = add(out, norm_bias)
+    return out, v
+
+
+def swiglu(x, y=None, name=None):
+    from ...ops.activation import swiglu as _swiglu
+
+    return _swiglu(x, y)

@@ -74,3 +74,29 @@ def test_fused_dropout_add_eval_identity():
     fda.eval()
     x = paddle.to_tensor(np.ones((3, 4), np.float32))
     np.testing.assert_allclose(fda(x, x).numpy(), 2 * np.ones((3, 4)), rtol=1e-6)
+
+
+def test_incubate_functional_surface():
+    import paddle_tpu.incubate.nn.functional as IF
+
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(2, 4, 8).astype(np.float32))
+    w = paddle.to_tensor(rs.randn(8, 6).astype(np.float32))
+    np.testing.assert_allclose(IF.fused_linear(x, w).numpy(),
+                               x.numpy() @ w.numpy(), rtol=1e-5)
+    wt = paddle.to_tensor(w.numpy().T.copy())
+    np.testing.assert_allclose(IF.fused_linear(x, wt, transpose_weight=True).numpy(),
+                               x.numpy() @ w.numpy(), rtol=1e-5)
+    g = paddle.to_tensor(np.ones(8, np.float32))
+    ln, ln_res = IF.fused_layer_norm(x, norm_weight=g,
+                                     norm_bias=paddle.to_tensor(np.zeros(8, np.float32)))
+    assert abs(float(ln.numpy().mean())) < 1e-5
+    np.testing.assert_allclose(ln_res.numpy(), x.numpy())  # residual_out = pre-norm sum
+    rn, rn_res = IF.fused_rms_norm(x, g)
+    assert np.isfinite(rn.numpy()).all()
+    np.testing.assert_allclose(rn_res.numpy(), x.numpy())
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        IF.fused_rms_norm(x, g, begin_norm_axis=1)
+    assert IF.swiglu(x).numpy().shape == (2, 4, 4)
+    assert callable(IF.weight_only_linear) and callable(IF.fused_moe)
