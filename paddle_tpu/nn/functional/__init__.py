@@ -84,4 +84,19 @@ from .loss import (  # noqa: F401
     square_error_cost,
     triplet_margin_loss,
 )
-from .attention import flash_attention, scaled_dot_product_attention, sdp_kernel  # noqa: F401
+from .attention import scaled_dot_product_attention, sdp_kernel  # noqa: F401
+# initialize the flash_attention SUBMODULE first (its import would otherwise
+# setattr the module over the function later), then bind the function name —
+# same dual nature as the reference: F.flash_attention(...) is the function,
+# `from ...nn.functional.flash_attention import flashmask_attention` works
+# via sys.modules
+from . import flash_attention as _flash_attention_module  # noqa: F401
+from .flash_attention import (  # noqa: F401
+    calc_reduced_attn_scores,
+    flash_attn_qkvpacked,
+    flash_attn_unpadded,
+    flash_attn_varlen_qkvpacked,
+    flashmask_attention,
+    sparse_attention,
+)
+from .attention import flash_attention  # noqa: F401,E402
