@@ -32,8 +32,9 @@ import sys
 import time
 
 # bf16 peak TFLOP/s per chip, by device_kind substring (public specs).
+# "v5 lite" covers the axon tunnel's "TPU v5 lite" device_kind spelling.
 _PEAK_TFLOPS = [
-    ("v5litepod", 197.0), ("v5e", 197.0), ("v5p", 459.0),
+    ("v5litepod", 197.0), ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
     ("v6e", 918.0), ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
 ]
 

@@ -90,8 +90,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q,
         nk_eff = nk
     m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-    # per-row logsumexp, saved for the recompute backward
-    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    # per-row logsumexp, saved for the recompute backward. Kept as a
+    # [bh, 1, sq] 3-D array so the Mosaic block shape (1, 1, block_q) meets
+    # the TPU (8, 128) last-two-dims tiling rule (1 == array dim, block_q
+    # aligned); a [bh, sq] 2-D layout lowers only when block == full array.
+    lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
@@ -103,8 +106,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     j = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
     d = q.shape[-1]
     nk = seq_k // block_k
 
@@ -151,8 +154,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dk, dv = carry
         q = q_ref[0, pl.dslice(jq * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.dslice(jq * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(jq * block_q, block_q)]
-        delta = delta_ref[0, pl.dslice(jq * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.dslice(jq * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.dslice(jq * block_q, block_q)]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -211,7 +214,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q=256, block_k=512, interpret=False
         ),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ],
         grid=grid,
         in_specs=[
@@ -221,7 +224,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q=256, block_k=512, interpret=False
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         interpret=interpret,
     )(qt, kt, vt)
@@ -243,7 +246,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, block_q=256, block_k=512,
     vt = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
     ot = jnp.moveaxis(o, 2, 1).reshape(b * h, sq, d)
     dot_ = jnp.moveaxis(do, 2, 1).reshape(b * h, sq, d)
-    delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32), -1)
+    delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32), -1)[:, None, :]
 
     block_q, block_k = _blocks(sq, sk, block_q, block_k)
 
@@ -257,8 +260,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, block_q=256, block_k=512,
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         interpret=interpret,
@@ -277,8 +280,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, block_q=256, block_k=512,
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),

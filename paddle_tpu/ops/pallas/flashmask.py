@@ -92,7 +92,9 @@ def _fm_fwd_kernel(q_ref, k_ref, v_ref, idx_ref, o_ref, lse_ref, *, scale,
         nk_eff = nk
     m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    # [bh, 1, sq] 3-D lse: block (1, 1, block_q) satisfies the Mosaic
+    # (8, 128) last-two-dims rule (see flash_attention.py note)
+    lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
@@ -102,8 +104,8 @@ def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
     j = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
     d = q.shape[-1]
     nk = seq_k // block_k
     q_rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -148,8 +150,8 @@ def _fm_bwd_dkv_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.dslice(jq * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.dslice(jq * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(jq * block_q, block_q)]
-        delta = delta_ref[0, pl.dslice(jq * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.dslice(jq * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.dslice(jq * block_q, block_q)]
         q_rows = jq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -210,7 +212,7 @@ def _fm_fwd(q, k, v, idx, causal, scale, interpret=False):
                           ncol=ncol, block_q=block_q, block_k=block_k, seq_k=sk),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ],
         grid=(b * h, sq // block_q),
         in_specs=[
@@ -221,7 +223,7 @@ def _fm_fwd(q, k, v, idx, causal, scale, interpret=False):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         interpret=interpret,
     )(qt, kt, vt, it)
@@ -235,7 +237,7 @@ def _fm_bwd(q, k, v, idx, o, lse, do, causal, scale, interpret=False):
     qt, kt, vt, it, (b, sq, sk, h, d, ncol) = _prep(q, k, v, idx)
     ot = jnp.moveaxis(o, 2, 1).reshape(b * h, sq, d)
     dot_ = jnp.moveaxis(do, 2, 1).reshape(b * h, sq, d)
-    delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32), -1)
+    delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32), -1)[:, None, :]
     block_q, block_k = _fm_blocks(sq, sk)
 
     dq = pl.pallas_call(
@@ -249,8 +251,8 @@ def _fm_bwd(q, k, v, idx, o, lse, do, causal, scale, interpret=False):
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, sk, ncol), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         interpret=interpret,
@@ -270,8 +272,8 @@ def _fm_bwd(q, k, v, idx, o, lse, do, causal, scale, interpret=False):
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, ncol), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
