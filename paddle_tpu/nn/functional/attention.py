@@ -52,6 +52,26 @@ def flash_attention(
     scale = 1.0 / math.sqrt(unwrap(query).shape[-1])
     dkey = global_state.default_generator.split() if (dropout > 0.0 and training) else None
 
+    if return_softmax:
+        # The flash kernel never materializes the probability matrix — the
+        # debug contract (reference flash_attention return_softmax=True)
+        # is served by the XLA composition, which does.
+        def fn(q, k, v):
+            logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+            if causal:
+                s, t = logits.shape[-2], logits.shape[-1]
+                mask = jnp.tril(jnp.ones((s, t), bool), t - s)
+                logits = jnp.where(mask, logits, -1e30)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+            p = probs
+            if dropout > 0.0 and training and dkey is not None:
+                keep = jax.random.bernoulli(dkey, 1.0 - dropout, p.shape)
+                p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+            return jnp.einsum("bhst,bthd->bshd", p, v), probs
+
+        out, probs = primitive("flash_attention_xla", fn, [query, key, value])
+        return out, probs
+
     if pallas_fa.available() and dropout == 0.0:
         out = primitive(
             "flash_attention",
@@ -66,9 +86,7 @@ def flash_attention(
             ),
             [query, key, value],
         )
-    if return_softmax:
-        return out, None
-    return out, None if not return_softmax else None
+    return out, None
 
 
 def scaled_dot_product_attention(

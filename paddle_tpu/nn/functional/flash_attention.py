@@ -64,10 +64,16 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         logits = jnp.einsum("qhd,khd->hqk", q, k) * sc
         logits = jnp.where(same[None], logits, -1e30)
         probs = jax.nn.softmax(logits, -1)
-        return jnp.einsum("hqk,khd->qhd", probs, v)
+        out = jnp.einsum("hqk,khd->qhd", probs, v)
+        if return_softmax:
+            return out, probs
+        return out
 
+    if return_softmax:
+        out, probs = primitive("flash_attn_unpadded", fn, [query, key, value])
+        return out, probs
     out = primitive("flash_attn_unpadded", fn, [query, key, value])
-    return (out, None) if return_softmax else (out, None)
+    return out, None
 
 
 def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
@@ -116,6 +122,10 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
             [query, key, value],
         )
 
+    from ...base import global_state
+
+    dkey = global_state.default_generator.split() if dropout > 0.0 else None
+
     def fn(q, k, v):
         B, S, H, D = q.shape
         Sk = k.shape[1]
@@ -141,7 +151,8 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
                      & (rows[None, None] < ute[:, :, None, :]))
             disallowed = lower | upper
         bias = jnp.where(disallowed, -1e30, 0.0)
-        return _xla_attention(q, k, v, causal=False, scale=scale, bias=bias)
+        return _xla_attention(q, k, v, causal=False, scale=scale, bias=bias,
+                              dropout=dropout, dropout_key=dkey)
 
     return primitive("flashmask_attention_xla", fn, [query, key, value])
 

@@ -217,21 +217,45 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
             q = jnp.clip(jnp.round(w / scale[None, :] * 127.0), -127, 127).astype(jnp.int8)
             return q, scale
         if algo == "weight_only_int4":
+            # Full [-8, 7] int4 range (the max element clips 8→7: ≤1/16
+            # relative error on one value, standard for symmetric int4) and
+            # two nibbles packed per int8 byte along the input dim — the
+            # stored weight really is half the int8 bytes, matching the
+            # reference's packed weight_quantize layout.
             scale = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-8)
-            q = jnp.clip(jnp.round(w / scale[None, :] * 7.0), -7, 7).astype(jnp.int8)
-            return q, scale
+            q = jnp.clip(jnp.round(w / scale[None, :] * 8.0), -8, 7).astype(jnp.int8)
+            if q.shape[0] % 2:
+                q = jnp.concatenate([q, jnp.zeros((1, q.shape[1]), jnp.int8)], 0)
+            lo, hi = q[0::2], q[1::2]
+            packed = ((lo & 0x0F) | (hi << 4)).astype(jnp.int8)
+            return packed, scale
         raise NotImplementedError(f"weight_quantize algo={algo}")
 
     return passthrough("weight_quantize", fn, [x])
 
 
+def _unpack_int4(packed):
+    """((in+1)//2, out) packed nibbles → (2*rows, out) sign-extended int8.
+    Arithmetic shifts on int8 sign-extend: low nibble via <<4 then >>4."""
+    lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
+    hi = jnp.right_shift(packed, 4)
+    rows2 = jnp.stack([lo, hi], axis=1)  # (rows, 2, out)
+    return rows2.reshape(packed.shape[0] * 2, packed.shape[1])
+
+
 def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16",
                       group_size=-1, name=None):
-    """(reference op: weight_dequantize)."""
-    qmax = 7.0 if algo == "weight_only_int4" else 127.0
+    """(reference op: weight_dequantize). int4 weights arrive nibble-packed
+    (see weight_quantize); the unpacked row count is 2× the packed rows —
+    callers with an odd original in-dim slice off the final zero pad row."""
+    if algo == "weight_only_int4":
+        return primitive(
+            "weight_dequantize",
+            lambda q, s: _unpack_int4(q).astype(jnp.float32) * s[None, :] / 8.0,
+            [x, scale])
     return primitive(
         "weight_dequantize",
-        lambda q, s: q.astype(jnp.float32) * s[None, :] / qmax, [x, scale])
+        lambda q, s: q.astype(jnp.float32) * s[None, :] / 127.0, [x, scale])
 
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
@@ -241,7 +265,8 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     (reference fused op: weight_only_linear). The int8→bf16 convert+scale
     sits between HBM load and MXU feed; XLA fuses it, halving weight
     bandwidth vs bf16 weights."""
-    qmax = 7.0 if weight_dtype == "int4" else 127.0
+    int4 = weight_dtype == "int4"
+    qmax = 8.0 if int4 else 127.0
     args = [x, weight] + ([weight_scale] if weight_scale is not None else []) \
         + ([bias] if bias is not None else [])
     has_scale = weight_scale is not None
@@ -252,6 +277,8 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         scale = rest[i] if has_scale else jnp.ones(wq.shape[-1], xv.dtype)
         i += 1 if has_scale else 0
         b = rest[i] if has_bias else None
+        if int4:
+            wq = _unpack_int4(wq)[: xv.shape[-1]]  # drop odd-in-dim pad row
         wf = wq.astype(xv.dtype) * (scale.astype(xv.dtype) / qmax)[None, :]
         y = xv @ wf
         return y + b if b is not None else y

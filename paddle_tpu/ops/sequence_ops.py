@@ -212,12 +212,16 @@ def beam_search_step(log_probs, prev_scores, beam_size, end_id=0, name=None):
 
 def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
     """Nucleus sampling (reference op: top_p_sampling): zero out the tail
-    beyond cumulative prob p, renormalize, sample."""
-    import numpy as np
+    beyond cumulative prob p, renormalize, sample.
 
+    Without an explicit seed the key comes from the framework generator's
+    mutable cell, so under jit the advancing key is threaded through the
+    compiled program as state — each execution of a compiled decode step
+    samples fresh tokens (a trace-time np.random key would be baked in)."""
     from ..base import global_state
 
-    key = jax.random.PRNGKey(int(np.random.randint(0, 2**31)) if seed in (None, -1) else int(seed))
+    key = (global_state.default_generator.split() if seed in (None, -1)
+           else jax.random.PRNGKey(int(seed)))
 
     def fn(logits, p):
         sorted_logits = jnp.sort(logits, -1)[..., ::-1]

@@ -39,6 +39,25 @@ def test_registry_signature_and_amp():
     assert registry.get_op("swiglu") is not None
 
 
+def test_amp_stems_token_boundaries():
+    """ADVICE r3: substring stems blacklisted expand ('exp') and could
+    whitelist gammaln ('mm') — stems must match snake_case tokens."""
+    from paddle_tpu.ops import registry
+
+    for dtype_neutral in ("expand", "expand_as", "logical_and", "logical_not",
+                          "gaussian", "gammaln"):
+        assert registry._amp_class(dtype_neutral) == "none", dtype_neutral
+    for overflow_prone in ("exp", "expm1", "logsumexp", "log_softmax",
+                           "layer_norm", "softmax"):
+        assert registry._amp_class(overflow_prone) == "black", overflow_prone
+    for mxu_bound in ("matmul", "conv2d_transpose", "depthwise_conv2d",
+                      "flash_attn"):
+        assert registry._amp_class(mxu_bound) == "white", mxu_bound
+    # the black/white sets stay disjoint and non-trivial
+    assert not (registry.amp_black() & registry.amp_white())
+    assert len(registry.amp_white()) > 10 and len(registry.amp_black()) > 20
+
+
 def test_pooling_with_index_and_unpool():
     from paddle_tpu.ops import pooling as PL
 
@@ -147,6 +166,32 @@ def test_quant_roundtrip_and_weight_only():
     out, _ = Q.fake_quantize_dequantize_abs_max(t)
     P.sum(out).backward()
     assert np.isfinite(t.grad.numpy()).all()
+
+
+def test_weight_only_int4_packed():
+    from paddle_tpu.ops import quant_ops as Q
+
+    rs = np.random.RandomState(3)
+    for in_dim in (16, 15):  # even and odd (pad row) in-dims
+        w = rs.randn(in_dim, 8).astype(np.float32)
+        x = rs.randn(4, in_dim).astype(np.float32)
+        wq, sc = Q.weight_quantize(P.to_tensor(w), algo="weight_only_int4")
+        # packed storage: half the int8 bytes of the unpacked matrix
+        assert wq.numpy().shape == ((in_dim + 1) // 2, 8)
+        assert wq.numpy().dtype == np.int8
+        y = Q.weight_only_linear(P.to_tensor(x), wq, weight_scale=sc,
+                                 weight_dtype="int4")
+        ref = x @ w
+        # 16-level grid: per-element error ≤ scale/16, accumulated over the
+        # in-dim → ~10% relative output error is the expected int4 regime
+        assert np.abs(y.numpy() - ref).max() / np.abs(ref).max() < 0.12
+        dq = Q.weight_dequantize(wq, sc, algo="weight_only_int4")
+        assert np.abs(dq.numpy()[:in_dim] - w).max() < np.abs(w).max() / 8 * 1.01
+    # the -8 code point is reachable (full int4 range)
+    w8 = np.array([[-1.0], [0.99], [0.5]], np.float32)
+    wq8, _ = Q.weight_quantize(P.to_tensor(w8), algo="weight_only_int4")
+    lo = (wq8.numpy().astype(np.int8) << 4) >> 4
+    assert lo.min() == -8
 
 
 def test_special_functions_vs_scipy():
@@ -294,6 +339,50 @@ def test_flashmask_attention_xla_semantics():
     probs = np.exp(logits - spsp.logsumexp(logits, -1, keepdims=True))
     ref = np.einsum("bhst,bthd->bshd", probs, qn)
     np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_return_softmax_and_dropout_fallback():
+    """ADVICE r3: return_softmax must return the probs (not a silent None),
+    and the flashmask XLA fallback must actually apply dropout."""
+    import paddle_tpu.nn.functional as F
+
+    rs = np.random.RandomState(0)
+    q = P.to_tensor(rs.randn(1, 8, 2, 4).astype(np.float32))
+    out, probs = F.flash_attention(q, q, q, causal=True, return_softmax=True)
+    assert probs is not None
+    pn = probs.numpy()  # (B, H, S, S), rows sum to 1, causal upper zeroed
+    np.testing.assert_allclose(pn.sum(-1), np.ones(pn.shape[:-1]), rtol=1e-5)
+    assert np.abs(np.triu(pn[0, 0], 1)).max() == 0.0
+    # unpadded variant returns probs too
+    cu = P.to_tensor(np.array([0, 8], np.int32))
+    qa = P.to_tensor(rs.randn(8, 2, 4).astype(np.float32))
+    out2, probs2 = F.flash_attn_unpadded(qa, qa, qa, cu, cu, 8, 8,
+                                         return_softmax=True)
+    assert probs2 is not None and probs2.numpy().shape == (2, 8, 8)
+    # flashmask fallback: dropout zeroes some attention mass → different out
+    idx = P.to_tensor(np.full((1, 1, 8, 1), 8, np.int32))
+    P.seed(123)
+    a = F.flashmask_attention(q, q, q, idx, dropout=0.9)
+    b = F.flashmask_attention(q, q, q, idx, dropout=0.0)
+    assert np.abs(a.numpy() - b.numpy()).max() > 1e-3
+
+
+def test_top_p_sampling_rng_threading():
+    """ADVICE r3: without an explicit seed, consecutive compiled calls must
+    draw different samples (key from the framework RNG cell, not baked)."""
+    from paddle_tpu.ops.sequence_ops import top_p_sampling
+
+    P.seed(7)
+    rs = np.random.RandomState(0)
+    logits = P.to_tensor(rs.randn(64, 50).astype(np.float32))
+    ps = P.to_tensor(np.full((64,), 0.95, np.float32))
+    _, s1 = top_p_sampling(logits, ps)
+    _, s2 = top_p_sampling(logits, ps)
+    assert (s1.numpy() != s2.numpy()).any()
+    # explicit seed → deterministic
+    _, d1 = top_p_sampling(logits, ps, seed=5)
+    _, d2 = top_p_sampling(logits, ps, seed=5)
+    np.testing.assert_array_equal(d1.numpy(), d2.numpy())
 
 
 def test_misc_lu_unpack_and_spectral_norm():
