@@ -354,10 +354,28 @@ def bench_resnet(on_tpu):
     return f"{name}_train_images_per_sec", batch * steps / dt, "images/sec", extras
 
 
+def _enable_compile_cache():
+    """Persistent XLA compilation cache beside this file: the expensive
+    gpt2-small train-step compile happens once per toolchain; later bench
+    runs (the driver's end-of-round run in particular) deserialize the
+    executable and spend the budget measuring instead of compiling."""
+    import jax
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # older jax without the knobs: compile cost stays per-process
+
+
 def _worker():
     """Runs in a subprocess: measure and print the JSON line."""
     import jax
 
+    _enable_compile_cache()
     dev = jax.devices()[0]
     platform = dev.platform
     on_tpu = platform == "tpu"
