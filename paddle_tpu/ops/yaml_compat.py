@@ -1,0 +1,174 @@
+"""YAML-contract adapters for alias-bound ops.
+
+The registry binds some YAML op names to public APIs whose python signature
+differs from the YAML arg spec (reference
+/root/reference/paddle/phi/ops/yaml/ops.yaml) — e.g. the collective kernels
+take (x, ring_id, nranks) in YAML but the public API is
+paddle.distributed.all_gather(tensor_list, tensor). The adapters here
+expose the YAML calling convention over the real implementations so every
+registry name is callable per its spec (verified by
+registry.alias_signature_report / tests/test_registry_sweep.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+
+
+def _comm():
+    from ..distributed import communication
+
+    return communication
+
+
+def all_gather(x, ring_id=0, nranks=0, name=None):
+    """YAML all_gather(x, ring_id, nranks) -> [nranks*B, ...] (kernel:
+    all_gather_kernel.h)."""
+    return _comm().all_gather(None, x)
+
+
+def reduce_scatter(x, ring_id=0, nranks=1, name=None):
+    """YAML reduce_scatter(x, ring_id, nranks) — sum-scatter along dim 0."""
+    dest = Tensor(unwrap(x))
+    return _comm().reduce_scatter(dest, x)
+
+
+def c_concat(x, rank=0, nranks=1, ring_id=0, use_calc_stream=True,
+             use_model_parallel=True, name=None):
+    """YAML c_concat: gather mp shards and concatenate along the LAST dim."""
+    comm = _comm()
+    gathered = comm.all_gather(None, x)  # [n, ...] stacked on a new dim 0
+    from . import manipulation
+
+    g = unwrap(gathered)
+    if g.ndim == unwrap(x).ndim:  # world of 1: all_gather was identity
+        return gathered
+    parts = manipulation.unbind(gathered, 0)
+    return manipulation.concat(list(parts), -1)
+
+
+def c_identity(x, ring_id=0, use_calc_stream=True, use_model_parallel=True,
+               name=None):
+    from . import manipulation
+
+    return manipulation.assign(x)
+
+
+def memory_efficient_attention(query, key, value, bias=None, cu_seqlens_q=None,
+                               cu_seqlens_k=None, causal_diagonal=None,
+                               seqlen_k=None, max_seqlen_q=None,
+                               max_seqlen_k=None, causal=False, dropout_p=0.0,
+                               scale=None, is_test=True, name=None):
+    """YAML memory_efficient_attention → dense flash path (the TPU kernel
+    covers the memory-efficient contract; bias routes through SDPA)."""
+    from ..nn.functional import flash_attention as fa
+    from ..nn.functional.attention import scaled_dot_product_attention
+
+    if bias is not None:
+        return scaled_dot_product_attention(
+            query, key, value, attn_mask=bias, dropout_p=dropout_p,
+            is_causal=causal, training=not is_test)
+    out, _ = fa.flash_attention(query, key, value, dropout=dropout_p,
+                                causal=causal, training=not is_test)
+    return out
+
+
+def full_int_array(value, dtype="int64", place=None, name=None):
+    """YAML full_int_array(value: int64[]) — a 1-D tensor from the literal."""
+    np_dtype = {"DataType::FLOAT32": np.float32}.get(str(dtype), None)
+    if np_dtype is None:
+        try:
+            np_dtype = np.dtype(str(dtype).split("::")[-1].lower())
+        except TypeError:
+            np_dtype = np.int64
+    return Tensor(np.asarray(list(value), np_dtype))
+
+
+def data(name=None, shape=None, dtype="float32", place=None):
+    """YAML data op: an input placeholder — eager analog is a zeros tensor
+    of the declared shape."""
+    from . import creation
+
+    shape = [1] if shape is None else [max(int(s), 1) for s in shape]
+    return creation.zeros(shape, dtype=dtype)
+
+
+def assign_value_(output, shape=None, dtype="float32", values=(), place=None,
+                  name=None):
+    arr = np.asarray(list(values), dtype=np.dtype(str(dtype)))
+    if shape is not None:
+        arr = arr.reshape([int(s) for s in shape])
+    output.set_value(arr)
+    return output
+
+
+def set_value_with_tensor(x, values, starts, ends, steps, axes,
+                          decrease_axes=(), none_axes=(), name=None):
+    """YAML set_value_with_tensor: x[starts:ends:steps (over axes)] = values."""
+    idx = [slice(None)] * unwrap(x).ndim
+    for a, s, e, st in zip(axes, starts, ends, steps):
+        idx[int(a)] = slice(int(s), int(e), int(st))
+    return _set_slice(x, tuple(idx), values)
+
+
+def _set_slice(x, idx, values):
+    v = unwrap(x).at[idx].set(unwrap(values))
+    out = Tensor(v)
+    out.stop_gradient = x.stop_gradient
+    return out
+
+
+def as_strided(input, dims=(), stride=(), offset=0, name=None):
+    from . import manipulation
+
+    return manipulation.as_strided(input, list(dims), list(stride), int(offset))
+
+
+def view_shape(input, dims=(), name=None):
+    from . import manipulation
+
+    return manipulation.view_shape(input, list(dims))
+
+
+def shape(input, name=None):
+    """YAML shape op: the dims as a 1-D int32 tensor."""
+    return Tensor(np.asarray(unwrap(input).shape, np.int32))
+
+
+def enable_check_model_nan_inf(x, flag=1, name=None):
+    from ..base import flags
+
+    flags.enable_check_nan_inf()
+    from . import manipulation
+
+    return manipulation.assign(x)
+
+
+def disable_check_model_nan_inf(x, flag=0, name=None):
+    from ..base import flags
+
+    flags.disable_check_nan_inf()
+    from . import manipulation
+
+    return manipulation.assign(x)
+
+
+def _forwarding(target_path):
+    """Adapter for YAML rows whose arg table is empty in the snapshot
+    (legacy-format entries): forward everything."""
+    def fn(*args, **kwargs):
+        import importlib
+
+        mod, _, attr = target_path.partition(":")
+        return getattr(importlib.import_module(mod), attr)(*args, **kwargs)
+
+    return fn
+
+
+lstm = _forwarding("paddle_tpu.ops.rnn_ops:lstm")
+gru = _forwarding("paddle_tpu.ops.rnn_ops:gru")
+gru_unit = _forwarding("paddle_tpu.ops.rnn_ops:gru_unit")
+attention_lstm = _forwarding("paddle_tpu.ops.rnn_ops:lstm")
+beam_search = _forwarding("paddle_tpu.ops.sequence_ops:beam_search_step")
+uniform_random_batch_size_like = _forwarding("paddle_tpu.ops.random:uniform")
