@@ -442,11 +442,21 @@ def _spawn(env, timeout, want="metric"):
     except subprocess.TimeoutExpired:
         import signal
 
+        # SIGTERM first with a grace period: a TPU client killed with
+        # SIGKILL mid-RPC wedges the single-client tunnel for subsequent
+        # processes (observed r4); TERM lets it close the connection.
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
+            os.killpg(proc.pid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
-            proc.kill()
-        out, err = proc.communicate()
+            proc.terminate()
+        try:
+            out, err = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            out, err = proc.communicate()
         raise subprocess.TimeoutExpired(proc.args, timeout, output=out, stderr=err)
     for line in reversed(out.strip().splitlines()):
         try:

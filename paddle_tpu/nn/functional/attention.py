@@ -17,6 +17,12 @@ from ...core.dispatch import primitive
 from ...core.tensor import unwrap
 
 
+def _seed_from_key(key):
+    """(1,) int32 seed for the in-kernel dropout PRNG, derived from (and
+    threaded through compilation like) the framework RNG stream."""
+    return jax.random.randint(key, (1,), 0, 2**31 - 1, jnp.int32)
+
+
 def _xla_attention(q, k, v, *, causal, scale, bias=None, dropout=0.0, dropout_key=None):
     # q,k,v: [B, S, H, D] -> einsum over head dim
     logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
@@ -72,10 +78,14 @@ def flash_attention(
         out, probs = primitive("flash_attention_xla", fn, [query, key, value])
         return out, probs
 
-    if pallas_fa.available() and dropout == 0.0:
+    if pallas_fa.available():
+        drop_eff = dropout if training else 0.0
+        seed = _seed_from_key(dkey) if drop_eff > 0.0 else None
         out = primitive(
             "flash_attention",
-            lambda q, k, v: pallas_fa.flash_attention_value(q, k, v, causal=causal, scale=scale),
+            lambda q, k, v: pallas_fa.flash_attention_value(
+                q, k, v, causal=causal, scale=scale, dropout=drop_eff,
+                seed=seed),
             [query, key, value],
         )
     else:
@@ -98,10 +108,15 @@ def scaled_dot_product_attention(
     from ...ops.pallas import flash_attention as pallas_fa
 
     scale = 1.0 / math.sqrt(unwrap(query).shape[-1])
-    if attn_mask is None and dropout_p == 0.0 and pallas_fa.available():
+    if attn_mask is None and pallas_fa.available():
+        drop_eff = dropout_p if training else 0.0
+        seed = (_seed_from_key(global_state.default_generator.split())
+                if drop_eff > 0.0 else None)
         return primitive(
             "sdpa_flash",
-            lambda q, k, v: pallas_fa.flash_attention_value(q, k, v, causal=is_causal, scale=scale),
+            lambda q, k, v: pallas_fa.flash_attention_value(
+                q, k, v, causal=is_causal, scale=scale, dropout=drop_eff,
+                seed=seed),
             [query, key, value],
         )
     dkey = global_state.default_generator.split() if (dropout_p > 0.0 and training) else None
