@@ -151,8 +151,9 @@ except ImportError:
 
 try:
     from . import static  # noqa: F401
+    from .static.program import disable_static, enable_static  # noqa: F401
 
-    __all__.append("static")
+    __all__.extend(["static", "enable_static", "disable_static"])
 except ImportError:
     pass
 

@@ -93,6 +93,8 @@ def _primitive_impl(name, fn, tensor_args, attrs):
         outs = _wrap_outputs(name, out, stop_gradient=True)
         if get_flag("check_nan_inf"):
             _check_nan_inf(name, [o._value for o in (outs if isinstance(outs, tuple) else (outs,))])
+        if hooks.static_capture is not None:
+            hooks.static_capture.record(name, fn, tensor_args, attrs, outs)
         return outs
 
     # Partial-application: close over non-diff args, differentiate the rest.
@@ -124,6 +126,8 @@ def _primitive_impl(name, fn, tensor_args, attrs):
 
     if get_flag("check_nan_inf"):
         _check_nan_inf(name, [o._value for o in out_list])
+    if hooks.static_capture is not None:
+        hooks.static_capture.record(name, fn, tensor_args, attrs, outs)
     return outs
 
 
@@ -143,4 +147,6 @@ def passthrough(name: str, fn: Callable, tensor_args: Sequence[Any], attrs: dict
     outs = _wrap_outputs(name, out, stop_gradient=True)
     if get_flag("check_nan_inf"):
         _check_nan_inf(name, [o._value for o in (outs if isinstance(outs, tuple) else (outs,))])
+    if hooks.static_capture is not None:
+        hooks.static_capture.record(name, fn, tensor_args, attrs, outs)
     return outs

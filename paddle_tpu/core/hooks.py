@@ -11,3 +11,9 @@ discovery = None  # Optional[DiscoveryContext]
 # set by paddle_tpu/profiler when a Profiler is in a RECORD state: a callable
 # (op_name) -> context manager recording a host event around op dispatch
 op_profiler = None
+
+# set by paddle_tpu/static/program.py while a Program is recording (static
+# mode / program_guard): an object with record(name, fn, tensor_args, attrs,
+# outputs) — ops execute eagerly on placeholder values AND append a replayable
+# node to the program
+static_capture = None

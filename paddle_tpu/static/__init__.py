@@ -53,25 +53,93 @@ class InputSpec:
         return hash((tuple(self.shape), str(self.dtype), self.name))
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
-    """Maps to jit.save (reference static/io.py::save_inference_model — the
-    program+params export path)."""
-    program = kwargs.get("program")
-    layer = program if program is not None else fetch_vars
-    from ..jit.serialization import save as jit_save
+from . import nn  # noqa: E402,F401
+from .program import (  # noqa: E402,F401
+    Executor,
+    Program,
+    append_backward,
+    data,
+    default_main_program,
+    default_startup_program,
+    disable_static,
+    enable_static,
+    in_static_mode,
+    program_guard,
+)
 
-    jit_save(layer, path_prefix)
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize the recorded program's feed→fetch computation + referenced
+    parameters (reference static/io.py::save_inference_model)."""
+    import pickle
+
+    import os
+
+    prog = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    # the replay closure is fully picklable only via its recorded graph: we
+    # persist (program nodes are closures) by baking the computation into a
+    # StableHLO module through jax.export
+    import jax
+    import numpy as np
+
+    fetch_ids = [id(t) for t in fetch_vars]
+    names = sorted(prog.feeds)
+
+    def fn(*vals):
+        return prog._replay(dict(zip(names, vals)), fetch_ids)
+
+    # None/-1 dims in the declared feed shapes export as symbolic dims so
+    # the loaded program accepts any batch (jax.export shape polymorphism)
+    feed_avals = []
+    for i, n in enumerate(names):
+        shape, np_dtype = prog.feed_specs[n]
+        dims = ",".join(
+            f"b{i}_{j}" if (s is None or int(s) < 0) else str(int(s))
+            for j, s in enumerate(shape))
+        sym = jax.export.symbolic_shape(f"({dims})") if dims else ()
+        feed_avals.append(jax.ShapeDtypeStruct(sym, np.dtype(np_dtype)))
+    exported = jax.export.export(jax.jit(fn))(*feed_avals)
+    payload = {
+        "stablehlo": exported.serialize(),
+        "feed_names": names,
+        "feed_specs": [(tuple(prog.feed_specs[n][0]), str(prog.feed_specs[n][1]))
+                       for n in names],
+    }
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f)
+    return path_prefix
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    from ..jit.serialization import load as jit_load
+    """Returns (program-like callable, feed_names, fetch_count-opaque) in the
+    reference's (program, feed_target_names, fetch_targets) shape."""
+    import pickle
 
-    return jit_load(path_prefix)
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    import jax
+
+    rebuilt = jax.export.deserialize(payload["stablehlo"])
+
+    class _LoadedProgram:
+        feed_names = payload["feed_names"]
+        feed_specs = payload["feed_specs"]
+
+        def __call__(self, feed):
+            import numpy as np
+
+            vals = [np.asarray(feed[n]) for n in self.feed_names]
+            return [np.asarray(o) for o in rebuilt.call(*vals)]
+
+    prog = _LoadedProgram()
+    return prog, payload["feed_names"], None
 
 
-# no-op graph-mode toggles: eager tracing is always live and to_static
-# compiles whole steps, so program guards are identity context managers
-class _NullGuard:
+class name_scope:
     def __init__(self, *a, **k):
         pass
 
@@ -80,15 +148,3 @@ class _NullGuard:
 
     def __exit__(self, *exc):
         return False
-
-
-program_guard = _NullGuard
-name_scope = _NullGuard
-
-
-def default_main_program():
-    return None
-
-
-def default_startup_program():
-    return None

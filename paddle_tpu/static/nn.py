@@ -1,0 +1,112 @@
+"""paddle.static.nn layer builders (reference python/paddle/static/nn/).
+
+Each builder creates its parameters EAGERLY (outside program recording, so
+they are by-reference constants that persist across Executor.run calls) and
+then applies the compute ops, which record into the active Program.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..core import hooks
+from ..core.tensor import Tensor, unwrap
+
+
+@contextlib.contextmanager
+def _no_capture():
+    prev, hooks.static_capture = hooks.static_capture, None
+    try:
+        yield
+    finally:
+        hooks.static_capture = prev
+
+
+def _param(shape, dtype, scale=None):
+    from ..base import global_state
+
+    with _no_capture():
+        import jax
+
+        key = global_state.default_generator.split()
+        if scale is None:
+            scale = float(np.sqrt(2.0 / max(int(shape[0]), 1)))
+        val = jax.random.normal(key, tuple(shape), np.dtype(dtype)) * scale
+        p = Tensor(val, stop_gradient=False)
+    return p
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+       weight_attr=None, bias_attr=None):
+    """Fully-connected layer (reference static/nn/common.py::fc)."""
+    from ..ops import math as om
+
+    in_dim = 1
+    for s in unwrap(x).shape[num_flatten_dims:]:
+        in_dim *= int(s)
+    w = _param((in_dim, size), unwrap(x).dtype)
+    b = _param((size,), unwrap(x).dtype, scale=0.0)
+    from ..ops import manipulation
+
+    flat = x
+    if unwrap(x).ndim > num_flatten_dims + 1:
+        lead = list(unwrap(x).shape[:num_flatten_dims])
+        flat = manipulation.reshape(x, lead + [in_dim])
+    out = om.add(om.matmul(flat, w), b)
+    if activation:
+        from ..ops import activation as act_mod
+
+        out = getattr(act_mod, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, dtype="float32",
+              name=None, param_attr=None):
+    """reference static/nn/common.py::embedding."""
+    table = _param(size, np.dtype(dtype), scale=0.02)
+    from ..nn import functional as F
+
+    return F.embedding(input, table)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, name=None,
+               **kwargs):
+    """Inference-style batch norm over recorded stats (reference
+    static/nn/common.py::batch_norm, is_test path)."""
+    c = int(unwrap(input).shape[1])
+    gamma = _param((c,), unwrap(input).dtype, scale=0.0)
+    with _no_capture():
+        gamma.set_value(np.ones((c,), np.dtype(str(unwrap(input).dtype))))
+    beta = _param((c,), unwrap(input).dtype, scale=0.0)
+    mean = _param((c,), unwrap(input).dtype, scale=0.0)
+    var = _param((c,), unwrap(input).dtype, scale=0.0)
+    with _no_capture():
+        var.set_value(np.ones((c,), np.dtype(str(unwrap(input).dtype))))
+    from ..nn import functional as F
+
+    out = F.batch_norm(input, mean, var, weight=gamma, bias=beta,
+                       training=False, momentum=momentum, epsilon=epsilon)
+    if act:
+        from ..ops import activation as act_mod
+
+        out = getattr(act_mod, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, act=None, name=None, **kwargs):
+    """reference static/nn/common.py::conv2d."""
+    c_in = int(unwrap(input).shape[1])
+    ks = filter_size if isinstance(filter_size, (list, tuple)) else (
+        filter_size, filter_size)
+    w = _param((num_filters, c_in // groups, ks[0], ks[1]), unwrap(input).dtype)
+    from ..nn import functional as F
+
+    out = F.conv2d(input, w, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    if act:
+        from ..ops import activation as act_mod
+
+        out = getattr(act_mod, act)(out)
+    return out

@@ -1,0 +1,109 @@
+"""Static-graph Program/Executor tests (VERDICT r3 #5; reference:
+python/paddle/static/ + base/executor.py — the canonical build → run →
+save_inference_model → load → run flow, modulo imports)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    # fresh default programs per test
+    from paddle_tpu.static import program as prog_mod
+
+    prog_mod._default_main = prog_mod.Program()
+    prog_mod._default_startup = prog_mod.Program()
+    from paddle_tpu.core import hooks
+
+    hooks.static_capture = prog_mod._default_main
+    yield
+    paddle.disable_static()
+
+
+def test_canonical_static_flow():
+    x = paddle.static.data(name="x", shape=[None, 8], dtype="float32")
+    hidden = paddle.static.nn.fc(x, size=4)
+    loss = paddle.mean(hidden)
+
+    main = paddle.static.default_main_program()
+    assert len(main.ops) >= 3  # matmul, add, mean
+    assert "x" in main.feeds
+
+    exe = paddle.static.Executor(paddle.CPUPlace())
+    exe.run(paddle.static.default_startup_program())
+    rs = np.random.RandomState(0)
+    feed_x = rs.randn(16, 8).astype(np.float32)
+    out, hid = exe.run(main, feed={"x": feed_x}, fetch_list=[loss, hidden])
+    assert hid.shape == (16, 4)
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, hid.mean(), rtol=1e-5)
+
+    # feed shape differs from the declared placeholder (None batch): recompile
+    out32, _ = exe.run(main, feed={"x": rs.randn(32, 8).astype(np.float32)},
+                       fetch_list=[loss, hidden])
+    assert np.isfinite(out32).all()
+
+
+def test_executor_reflects_parameter_updates():
+    """Parameters replay by reference: mutating the weight between runs
+    changes the result (the reference's scope semantics)."""
+    x = paddle.static.data(name="x", shape=[4, 4], dtype="float32")
+    y = paddle.static.nn.fc(x, size=2)
+    main = paddle.static.default_main_program()
+    exe = paddle.static.Executor()
+    feed = {"x": np.ones((4, 4), np.float32)}
+    (a,) = exe.run(main, feed=feed, fetch_list=[y])
+    # find the weight parameter (a by-reference constant of the matmul node)
+    consts = [s[2] for n in main.ops for s in n.arg_specs
+              if s[0] == "v" and not s[1] in {i for nn in main.ops for i in nn.out_ids}
+              and s[1] not in main.feeds.values()]
+    w = next(t for t in consts if tuple(t.shape) == (4, 2))
+    w.set_value(np.zeros((4, 2), np.float32))
+    (b,) = exe.run(main, feed=feed, fetch_list=[y])
+    assert np.abs(a).max() >= 0  # first run produced something
+    np.testing.assert_allclose(b, np.zeros_like(b), atol=1e-6)
+
+
+def test_program_guard_routes_recording():
+    from paddle_tpu.static import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = paddle.static.data(name="inp", shape=[2, 3], dtype="float32")
+        out = paddle.tanh(x)
+    assert "inp" in main.feeds and len(main.ops) >= 1
+    exe = paddle.static.Executor()
+    (o,) = exe.run(main, feed={"inp": np.zeros((2, 3), np.float32)},
+                   fetch_list=[out])
+    np.testing.assert_allclose(o, np.zeros((2, 3)), atol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    x = paddle.static.data(name="x", shape=[None, 6], dtype="float32")
+    out = paddle.static.nn.fc(x, size=3, activation="tanh")
+    main = paddle.static.default_main_program()
+    exe = paddle.static.Executor()
+    rs = np.random.RandomState(1)
+    feed_x = rs.randn(5, 6).astype(np.float32)
+    (want,) = exe.run(main, feed={"x": feed_x}, fetch_list=[out])
+
+    prefix = str(tmp_path / "infer")
+    paddle.static.save_inference_model(prefix, [x], [out], exe, program=main)
+    prog, feed_names, _ = paddle.static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    (got,) = exe.run(prog, feed={"x": feed_x})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_eager_mode_unaffected():
+    paddle.disable_static()
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    out = paddle.tanh(t)
+    from paddle_tpu.static import default_main_program
+
+    n_ops = len(default_main_program().ops)
+    _ = paddle.tanh(t)
+    assert len(default_main_program().ops) == n_ops  # nothing recorded
+    assert np.isfinite(out.numpy()).all()
