@@ -17,3 +17,9 @@ op_profiler = None
 # outputs) — ops execute eagerly on placeholder values AND append a replayable
 # node to the program
 static_capture = None
+
+# set by the jit functionalizer around value-dependent branch capture: an
+# object with on_bool(tensor) -> bool. In record mode it logs the concrete
+# predicate; in replay mode (inside the jit trace) it returns the recorded
+# outcome and collects the predicate tracer for the runtime guard.
+branch_trace = None

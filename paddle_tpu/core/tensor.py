@@ -159,6 +159,10 @@ class Tensor:
             raise InvalidArgumentError(
                 "The truth value of a Tensor with more than one element is ambiguous"
             )
+        from . import hooks
+
+        if hooks.branch_trace is not None:
+            return hooks.branch_trace.on_bool(self)
         return bool(self.item())
 
     def __index__(self):

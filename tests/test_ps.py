@@ -1,0 +1,119 @@
+"""Parameter-server tier tests (VERDICT r3 #7; reference
+paddle/fluid/distributed/ps/ + the_one_ps.py — here the host-RAM sparse
+embedding service over the native TCPStore, two shard servers in-process)."""
+import socket
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import (
+    PsClient,
+    PsServer,
+    SparseEmbedding,
+    SparseTable,
+    TableOptimizer,
+)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ep = f"127.0.0.1:{_free_port()}"
+    servers = [PsServer(0, 2, ep).start(), PsServer(1, 2, ep, is_master=False).start()]
+    client = PsClient(2, ep)
+    yield client, servers
+    client.stop_servers()
+    for s in servers:
+        s.stop()
+    client.close()
+
+
+def test_sparse_table_local():
+    t = SparseTable(4, TableOptimizer("sgd", lr=1.0), seed=0)
+    ids = np.array([5, 99999999999, 5], np.int64)  # arbitrary int64 ids, dup
+    rows = t.pull(ids)
+    assert rows.shape == (3, 4)
+    np.testing.assert_allclose(rows[0], rows[2])  # same id → same row
+    grads = np.ones((3, 4), np.float32)
+    t.push(ids, grads)
+    after = t.pull(np.array([5], np.int64))
+    # duplicate id aggregated: row moved by lr * (g + g) = 2
+    np.testing.assert_allclose(after[0], rows[0] - 2.0, rtol=1e-6)
+    assert len(t) == 2
+
+
+def test_table_optimizer_adam_matches_dense_adam():
+    t = SparseTable(3, TableOptimizer("adam", lr=0.1), seed=1)
+    ids = np.array([7], np.int64)
+    row0 = t.pull(ids).copy()
+    g = np.array([[1.0, -2.0, 0.5]], np.float32)
+    t.push(ids, g)
+    row1 = t.pull(ids)
+    # first adam step: row - lr * sign-ish update (mhat/vhat ≈ g/|g|)
+    expect = row0 - 0.1 * g / (np.abs(g) + 1e-8)
+    np.testing.assert_allclose(row1, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_pull_push_across_shards(cluster):
+    client, _ = cluster
+    client.create_table("emb", 8, optimizer="sgd", lr=0.5)
+    ids = np.array([0, 1, 2, 3, 10, 11], np.int64)  # both shards hit
+    rows = client.pull_sparse("emb", ids)
+    assert rows.shape == (6, 8)
+    client.push_sparse("emb", ids, np.ones((6, 8), np.float32))
+    after = client.pull_sparse("emb", ids)
+    np.testing.assert_allclose(after, rows - 0.5, rtol=1e-5)
+    stats = client.save(table_stats_only=True)
+    assert sum(s["emb"] for s in stats) == 6  # rows split across shards
+
+
+def test_save_load_roundtrip(cluster):
+    client, _ = cluster
+    client.create_table("ckpt", 4, optimizer="sgd", lr=1.0)
+    ids = np.arange(10, dtype=np.int64)
+    before = client.pull_sparse("ckpt", ids)
+    states = client.save()
+    client.push_sparse("ckpt", ids, np.ones((10, 4), np.float32))
+    moved = client.pull_sparse("ckpt", ids)
+    assert np.abs(moved - before).max() > 0.5
+    client.load(states)
+    restored = client.pull_sparse("ckpt", ids)
+    np.testing.assert_allclose(restored, before, rtol=1e-6)
+
+
+def test_embedding_model_trains_e2e(cluster):
+    """Recommendation-style model: PS-backed sparse embedding + dense tower
+    on-device. The loss must drop — gradients flow host→PS through the
+    PyLayer backward and the table optimizer."""
+    import paddle_tpu.nn as nn
+
+    client, _ = cluster
+    client.create_table("user_emb", 8, optimizer="adagrad", lr=0.5)
+    emb = SparseEmbedding(client, "user_emb", 8)
+
+    paddle.seed(0)
+    tower = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=tower.parameters())
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 50, (32,)).astype(np.int64)
+    # a learnable mapping: label depends on the id's parity
+    labels = (ids % 2).astype(np.float32).reshape(-1, 1)
+
+    losses = []
+    for _ in range(30):
+        vec = emb(paddle.to_tensor(ids))
+        pred = tower(vec)
+        loss = paddle.mean((pred - paddle.to_tensor(labels)) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.5 * losses[0], losses[::10]
