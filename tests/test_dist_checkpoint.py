@@ -1,0 +1,110 @@
+"""Distributed checkpoint tests: async save, atomic commit, crash safety,
+cross-run restore (VERDICT r3 #8; reference
+distributed/checkpoint/save_state_dict.py:145 / load_state_dict.py)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import checkpoint as ckpt
+
+
+def _model_state():
+    paddle.seed(3)
+    m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    return m, {"model": m.state_dict()}
+
+
+def test_async_save_then_load_roundtrip(tmp_path):
+    m, state = _model_state()
+    d = str(tmp_path / "ck")
+    ckpt.save_state_dict(state, d, async_save=True)
+    ckpt.wait_async_save()
+    assert os.path.exists(os.path.join(d, "metadata.json"))
+    assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+
+    before = {k: v.numpy().copy() for k, v in m.state_dict().items()}
+    for p in m.parameters():
+        p.set_value(np.zeros_like(p.numpy()))
+    ckpt.load_state_dict({"model": m.state_dict()}, d)
+    for k, v in m.state_dict().items():
+        np.testing.assert_allclose(v.numpy(), before[k], rtol=1e-6)
+
+
+def test_crash_during_save_leaves_no_readable_partial(tmp_path, monkeypatch):
+    """A save that dies after writing shard data but BEFORE the metadata
+    commit must leave a directory the loader refuses (no metadata.json) —
+    not a readable-but-partial checkpoint."""
+    m, state = _model_state()
+    d = str(tmp_path / "ck")
+
+    real_replace = os.replace
+
+    def dying_replace(src, dst):
+        if dst.endswith("metadata.json"):
+            raise OSError("simulated crash before metadata commit")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", dying_replace)
+    with pytest.raises(OSError):
+        ckpt.save_state_dict(state, d, async_save=False)
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    assert not os.path.exists(os.path.join(d, "metadata.json"))
+    with pytest.raises(FileNotFoundError):
+        ckpt.load_state_dict({"model": m.state_dict()}, d)
+
+    # a subsequent complete save over the same directory recovers fully
+    ckpt.save_state_dict(state, d, async_save=False)
+    ckpt.load_state_dict({"model": m.state_dict()}, d)
+
+
+def test_crash_mid_shard_write_keeps_previous_checkpoint(tmp_path, monkeypatch):
+    """Crash while re-writing the shard: the previous complete checkpoint
+    stays loadable (tmp files are ignored by the loader)."""
+    m, state = _model_state()
+    d = str(tmp_path / "ck")
+    ckpt.save_state_dict(state, d, async_save=False)
+    golden = {k: v.numpy().copy() for k, v in m.state_dict().items()}
+
+    import numpy as _np
+
+    real_savez = _np.savez
+
+    def dying_savez(f, **kw):
+        real_savez(f, **kw)
+        raise OSError("simulated crash mid shard write")
+
+    # mutate weights, then crash the second save: disk must keep the golden
+    for p in m.parameters():
+        p.set_value(p.numpy() + 1.0)
+    monkeypatch.setattr(_np, "savez", dying_savez)
+    with pytest.raises(OSError):
+        ckpt.save_state_dict({"model": m.state_dict()}, d, async_save=False)
+    monkeypatch.setattr(_np, "savez", real_savez)
+
+    ckpt.load_state_dict({"model": m.state_dict()}, d)
+    for k, v in m.state_dict().items():
+        np.testing.assert_allclose(v.numpy(), golden[k], rtol=1e-6)
+
+
+def test_metadata_written_after_shards(tmp_path):
+    """Commit ordering: when metadata.json exists, every chunk it references
+    must exist too (readable checkpoints are complete by construction)."""
+    _, state = _model_state()
+    d = str(tmp_path / "ck")
+    ckpt.save_state_dict(state, d, async_save=True)
+    ckpt.wait_async_save()
+    with open(os.path.join(d, "metadata.json")) as f:
+        meta = json.load(f)
+    stored = {}
+    for fname in os.listdir(d):
+        if fname.endswith(".npz"):
+            stored.update(np.load(os.path.join(d, fname)))
+    for key, entry in meta["entries"].items():
+        refs = [c["key"] for c in entry["chunks"]] or [key]
+        for r in refs:
+            assert r in stored, r
