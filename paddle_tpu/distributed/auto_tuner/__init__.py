@@ -49,7 +49,10 @@ class AutoTuner:
     def tune(self, build_and_step: Callable[[Plan], Callable[[], None]],
              steps: int = 3, warmup: int = 1) -> Plan:
         """Measure each candidate: build_and_step(plan) returns a zero-arg
-        step callable under that plan's mesh; best wall-clock wins."""
+        step callable under that plan's mesh; best wall-clock wins. When
+        the step exposes a TrainStep (``step.train_step``), the history
+        also records the plan's estimated-vs-actual compiled memory
+        (VERDICT r3 #9: the pruning thresholds stay honest)."""
         best: Optional[Plan] = None
         best_dt = float("inf")
         for plan in self.candidates():
@@ -64,7 +67,17 @@ class AutoTuner:
             except Exception as e:  # candidate failed to build/run: prune it
                 self.history.append({"plan": plan.degrees, "error": repr(e)})
                 continue
-            self.history.append({"plan": plan.degrees, "step_seconds": dt})
+            record = {"plan": plan.degrees, "step_seconds": dt}
+            train_step = getattr(step, "train_step", None)
+            if train_step is not None:
+                try:
+                    from ..auto_parallel.planner import calibrate_against_compiled
+
+                    record["memory"] = calibrate_against_compiled(
+                        train_step, self.spec, self.batch_size, plan.degrees)
+                except Exception as e:
+                    record["memory_error"] = repr(e)
+            self.history.append(record)
             if dt < best_dt:
                 best, best_dt = plan, dt
         if best is None:

@@ -339,7 +339,27 @@ class CompiledFunction:
             c._version += 1
         return out_vals, True
 
+    def memory_analysis(self):
+        """Compiled-memory report of the last-run program (XLA
+        memory_analysis) — the ground truth the planner's HBM estimates
+        calibrate against (VERDICT r3 #9). None when the last call ran
+        eagerly or nothing has run yet."""
+        entry = self.last_entry
+        if not entry or entry.get("eager") or not entry.get("compiled_once"):
+            return None
+        if entry.get("guarded"):
+            entry = entry["entries"][entry["last"]]
+        last = getattr(self, "_last_call", None)
+        if last is None:
+            return None
+        args, kwargs = last
+        cells = entry["cells"]
+        cell_vals = [c._value for c in cells]
+        return entry["jitted"].lower(cell_vals, args, kwargs).compile(
+        ).memory_analysis()
+
     def _run(self, entry, args, kwargs):
+        self._last_call = (args, kwargs)
         cells = entry["cells"]
         cell_vals = [c._value for c in cells]
         if self.donate_cells:

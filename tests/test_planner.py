@@ -111,3 +111,43 @@ def test_spmd_propagation_under_planned_mesh():
     spec = out.sharding.spec
     # batch dim stays dp-sharded, feature dim mp-sharded — GSPMD propagated
     assert tuple(spec)[:2] in ((("dp",), ("mp",)), ("dp", "mp")), spec
+
+
+@pytest.mark.slow
+def test_memory_estimate_calibrated_against_compiled():
+    """VERDICT r3 #9: pin the planner's per-device memory model against the
+    compiled program's memory_analysis for gpt_tiny across 3 mesh shapes.
+    The resident-state component must land within ±30% of XLA's reported
+    argument size (transient temp is scheduler-dependent; the peak estimate
+    is recorded but only sanity-banded)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.auto_parallel.planner import (
+        ModelSpec,
+        calibrate_against_compiled,
+    )
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny
+
+    for dp, mp in ((8, 1), (4, 2), (2, 4)):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        cfg = gpt_tiny(tensor_parallel=(mp > 1))
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        step = TrainStep(model=model, optimizer=opt,
+                         loss_fn=lambda ids: crit(model(ids), ids))
+        batch = 2 * dp
+        rs = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rs.randint(0, cfg.vocab_size, (batch, 32)).astype(np.int64))
+        step(ids)
+        spec = ModelSpec.from_model(model, seq_len=32)
+        cal = calibrate_against_compiled(step, spec, batch,
+                                         {"dp_degree": dp, "mp_degree": mp})
+        assert 0.7 <= cal["state_ratio"] <= 1.3, (dp, mp, cal)
+        # peak stays a planning bound, not a scheduler prediction
+        assert cal["est_peak"] >= 0.5 * cal["measured_state"], (dp, mp, cal)
