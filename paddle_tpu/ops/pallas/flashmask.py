@@ -7,10 +7,12 @@ j, `startend_row_indices` gives the query-row interval(s) that are masked.
 This covers causal-document masks, sliding windows, shared prefixes and
 arbitrary block layouts at O(S) mask storage instead of O(S²).
 
-Kernels mirror ops/pallas/flash_attention.py (online-softmax forward saving
-lse; two-pass recompute backward) with the interval mask applied per tile:
-the (block_q × block_k) start/end slabs load as VMEM vectors and the mask is
-an elementwise compare — no O(S²) mask tensor ever exists in HBM.
+Kernels mirror ops/pallas/flash_attention.py (streamed K/V blocks over a
+(batch, heads, row-blocks, col-blocks) grid, VMEM scratch accumulators,
+online-softmax forward saving lse; two-pass recompute backward) with the
+interval mask applied per tile: the (block_k × ncol) start/end slab loads
+as a VMEM tile and the mask is an elementwise compare — no O(S²) mask
+tensor ever exists in HBM, and K/V never load whole-sequence.
 
 Index layouts (matching the reference contract):
 - causal, last dim 1: [LTS]            — rows >= LTS[j] masked (plus causal)
@@ -27,7 +29,7 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _tile_mask(idx_blk, q_pos, causal, ncol, block_q):
+def _tile_mask(idx_blk, q_pos, causal, ncol):
     """Disallowed-mask for one (block_q, block_k) tile from the column
     intervals idx_blk [block_k, ncol]."""
     if causal:
@@ -47,148 +49,150 @@ def _tile_mask(idx_blk, q_pos, causal, ncol, block_q):
     return masked
 
 
-def _fm_fwd_kernel(q_ref, k_ref, v_ref, idx_ref, o_ref, lse_ref, *, scale,
-                   causal, ncol, block_q, block_k, seq_k):
+def _fm_fwd_kernel(q_ref, k_ref, v_ref, idx_ref, o_ref, lse_ref,
+                   m_scr, l_scr, acc_scr, *, scale, causal, ncol, block_q,
+                   block_k, nk):
     from jax.experimental import pallas as pl
 
-    j = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    d = q.shape[-1]
-    nk = seq_k // block_k
+    iq, ik = pl.program_id(2), pl.program_id(3)
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q_rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    q_rows = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
 
-    def body(i, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        idx = idx_ref[0, pl.dslice(i * block_k, block_k), :]
+    @pl.when((ik * block_k <= iq * block_q + block_q - 1) if causal else (ik >= 0))
+    def _body():
+        q = q_ref[0, :, 0, :].astype(jnp.float32)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        idx = idx_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        disallowed = _tile_mask(idx, q_rows, causal, ncol, block_q)
+        disallowed = _tile_mask(idx, q_rows, causal, ncol)
         if causal:
-            k_pos = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             disallowed = disallowed | (q_rows < k_pos)
         s = jnp.where(disallowed, NEG_INF, s)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         # fully-masked rows: m stays NEG_INF, exp(NEG_INF - NEG_INF)=1 would
         # poison l; zero those columns explicitly
         p = jnp.where(disallowed, 0.0, p)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, 0] = l_prev * alpha + jnp.sum(p, axis=-1)
+        m_scr[:, 0] = m_new
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
-    if causal:
-        nk_eff = jnp.minimum(nk, ((j + 1) * block_q + block_k - 1) // block_k)
-    else:
-        nk_eff = nk
-    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-    # [bh, 1, sq] 3-D lse: block (1, 1, block_q) satisfies the Mosaic
-    # (8, 128) last-two-dims rule (see flash_attention.py note)
-    lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    @pl.when(ik == nk - 1)
+    def _emit():
+        l = l_scr[:, 0]
+        o_ref[0, :, 0, :] = (acc_scr[...] / jnp.maximum(l, 1e-30)[:, None]
+                             ).astype(o_ref.dtype)
+        lse_ref[0, 0, 0, :] = m_scr[:, 0] + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _fm_bwd_dq_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, *, scale, causal, ncol, block_q, block_k, seq_k):
+                      dq_ref, dq_scr, *, scale, causal, ncol, block_q,
+                      block_k, nk):
     from jax.experimental import pallas as pl
 
-    j = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
-    d = q.shape[-1]
-    nk = seq_k // block_k
-    q_rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    iq, ik = pl.program_id(2), pl.program_id(3)
 
-    def body(i, dq):
-        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        idx = idx_ref[0, pl.dslice(i * block_k, block_k), :]
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q_rows = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    @pl.when((ik * block_k <= iq * block_q + block_q - 1) if causal else (ik >= 0))
+    def _body():
+        q = q_ref[0, :, 0, :].astype(jnp.float32)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        do = do_ref[0, :, 0, :].astype(jnp.float32)
+        idx = idx_ref[0, 0]
+        lse = lse_ref[0, 0, 0, :]
+        delta = delta_ref[0, 0, 0, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        disallowed = _tile_mask(idx, q_rows, causal, ncol, block_q)
+        disallowed = _tile_mask(idx, q_rows, causal, ncol)
         if causal:
-            k_pos = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             disallowed = disallowed | (q_rows < k_pos)
         p = jnp.where(disallowed, 0.0, jnp.exp(s - lse[:, None]))
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    nk_eff = jnp.minimum(nk, ((j + 1) * block_q + block_k - 1) // block_k) if causal else nk
-    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(ik == nk - 1)
+    def _emit():
+        dq_ref[0, :, 0, :] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
-def _fm_bwd_dkv_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, *, scale, causal, ncol, block_q,
-                       block_k, seq_q):
+def _fm_bwd_dkv_kernel(q_ref, k_ref, v_ref, idx_ref, do_ref, lse_ref,
+                       delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
+                       causal, ncol, block_q, block_k, nq):
     from jax.experimental import pallas as pl
 
-    i = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    idx = idx_ref[0]
-    d = k.shape[-1]
-    nq = seq_q // block_q
-    k_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    ik, iq = pl.program_id(2), pl.program_id(3)
 
-    def body(jq, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.dslice(jq * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.dslice(jq * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(jq * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.dslice(jq * block_q, block_q)]
-        q_rows = jq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q_rows = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    @pl.when((iq * block_q + block_q - 1 >= ik * block_k) if causal else (iq >= 0))
+    def _body():
+        q = q_ref[0, :, 0, :].astype(jnp.float32)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        do = do_ref[0, :, 0, :].astype(jnp.float32)
+        idx = idx_ref[0, 0]
+        lse = lse_ref[0, 0, 0, :]
+        delta = delta_ref[0, 0, 0, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        disallowed = _tile_mask(idx, q_rows, causal, ncol, block_q)
+        disallowed = _tile_mask(idx, q_rows, causal, ncol)
         if causal:
             disallowed = disallowed | (q_rows < k_pos)
         p = jnp.where(disallowed, 0.0, jnp.exp(s - lse[:, None]))
-        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    jq0 = (i * block_k) // block_q if causal else 0
-    dk, dv = jax.lax.fori_loop(
-        jq0, nq, body,
-        (jnp.zeros((block_k, d), jnp.float32), jnp.zeros((block_k, d), jnp.float32)))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0, :, 0, :] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0, :, 0, :] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _prep(q, k, v, idx):
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+def _prep_idx(idx, b, h, sk):
+    """idx (B, Hm, Sk, ncol) with Hm in {1, h} → int32, kept 4-D; the
+    BlockSpec index map broadcasts Hm==1 across heads."""
     ncol = idx.shape[-1]
-    qt = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
-    kt = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
-    vt = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
-    # idx (B, Hm, Sk, ncol) with Hm in {1, h} → (b*h, sk, ncol)
-    if idx.shape[1] == 1 and h > 1:
-        idx = jnp.broadcast_to(idx, (b, h, sk, ncol))
-    it = idx.reshape(b * h, sk, ncol).astype(jnp.int32)
-    return qt, kt, vt, it, (b, sq, sk, h, d, ncol)
+    return idx.astype(jnp.int32), idx.shape[1], ncol
 
 
 def _fm_blocks(sq, sk, block_q=256, block_k=512):
@@ -204,86 +208,99 @@ def _fm_blocks(sq, sk, block_q=256, block_k=512):
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret"))
 def _fm_fwd(q, k, v, idx, causal, scale, interpret=False):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    qt, kt, vt, it, (b, sq, sk, h, d, ncol) = _prep(q, k, v, idx)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    it, hm, ncol = _prep_idx(idx, b, h, sk)
     block_q, block_k = _fm_blocks(sq, sk)
+    nq, nk = sq // block_q, sk // block_k
+
+    def idx_map(ib, ih, iq, ik):
+        return (ib, ih if hm > 1 else 0, ik, 0)
+
     out, lse = pl.pallas_call(
         functools.partial(_fm_fwd_kernel, scale=scale, causal=causal,
-                          ncol=ncol, block_q=block_q, block_k=block_k, seq_k=sk),
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
-        ],
-        grid=(b * h, sq // block_q),
+                          ncol=ncol, block_q=block_q, block_k=block_k, nk=nk),
+        grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, ncol), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, 1, d), lambda ib, ih, iq, ik: (ib, iq, ih, 0)),
+            pl.BlockSpec((1, block_k, 1, d), lambda ib, ih, iq, ik: (ib, ik, ih, 0)),
+            pl.BlockSpec((1, block_k, 1, d), lambda ib, ih, iq, ik: (ib, ik, ih, 0)),
+            pl.BlockSpec((1, 1, block_k, ncol), idx_map),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, block_q, 1, d), lambda ib, ih, iq, ik: (ib, iq, ih, 0)),
+            pl.BlockSpec((1, 1, 1, block_q), lambda ib, ih, iq, ik: (ib, ih, 0, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, h, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, it)
-    return jnp.moveaxis(out.reshape(b, h, sq, d), 1, 2), lse
+    )(q, k, v, it)
+    return out, lse
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret"))
 def _fm_bwd(q, k, v, idx, o, lse, do, causal, scale, interpret=False):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    qt, kt, vt, it, (b, sq, sk, h, d, ncol) = _prep(q, k, v, idx)
-    ot = jnp.moveaxis(o, 2, 1).reshape(b * h, sq, d)
-    dot_ = jnp.moveaxis(do, 2, 1).reshape(b * h, sq, d)
-    delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32), -1)[:, None, :]
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    it, hm, ncol = _prep_idx(idx, b, h, sk)
     block_q, block_k = _fm_blocks(sq, sk)
+    nq, nk = sq // block_q, sk // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
+    delta = jnp.transpose(delta, (0, 2, 1))[:, :, None, :]
+
+    qspec = pl.BlockSpec((1, block_q, 1, d), lambda ib, ih, iq, ik: (ib, iq, ih, 0))
+    kspec = pl.BlockSpec((1, block_k, 1, d), lambda ib, ih, iq, ik: (ib, ik, ih, 0))
+    rowspec = pl.BlockSpec((1, 1, 1, block_q), lambda ib, ih, iq, ik: (ib, ih, 0, iq))
+    ispec = pl.BlockSpec((1, 1, block_k, ncol),
+                         lambda ib, ih, iq, ik: (ib, ih if hm > 1 else 0, ik, 0))
 
     dq = pl.pallas_call(
         functools.partial(_fm_bwd_dq_kernel, scale=scale, causal=causal,
-                          ncol=ncol, block_q=block_q, block_k=block_k, seq_k=sk),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        grid=(b * h, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, ncol), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                          ncol=ncol, block_q=block_q, block_k=block_k, nk=nk),
+        grid=(b, h, nq, nk),
+        in_specs=[qspec, kspec, kspec, ispec, qspec, rowspec, rowspec],
+        out_specs=pl.BlockSpec((1, block_q, 1, d),
+                               lambda ib, ih, iq, ik: (ib, iq, ih, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, sq, h, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, it, dot_, lse, delta)
+    )(q, k, v, it, do, lse, delta)
 
+    qspec2 = pl.BlockSpec((1, block_q, 1, d), lambda ib, ih, ik, iq: (ib, iq, ih, 0))
+    kspec2 = pl.BlockSpec((1, block_k, 1, d), lambda ib, ih, ik, iq: (ib, ik, ih, 0))
+    rowspec2 = pl.BlockSpec((1, 1, 1, block_q), lambda ib, ih, ik, iq: (ib, ih, 0, iq))
+    ispec2 = pl.BlockSpec((1, 1, block_k, ncol),
+                          lambda ib, ih, ik, iq: (ib, ih if hm > 1 else 0, ik, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_fm_bwd_dkv_kernel, scale=scale, causal=causal,
-                          ncol=ncol, block_q=block_q, block_k=block_k, seq_q=sq),
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
-        ],
-        grid=(b * h, sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, ncol), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),
-        ],
+                          ncol=ncol, block_q=block_q, block_k=block_k, nq=nq),
+        grid=(b, h, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, ispec2, qspec2, rowspec2, rowspec2],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, 1, d), lambda ib, ih, ik, iq: (ib, ik, ih, 0)),
+            pl.BlockSpec((1, block_k, 1, d), lambda ib, ih, ik, iq: (ib, ik, ih, 0)),
         ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sk, h, d), k.dtype),
+            jax.ShapeDtypeStruct((b, sk, h, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, it, dot_, lse, delta)
-
-    unflat = lambda t, s: jnp.moveaxis(t.reshape(b, h, s, d), 1, 2)
-    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
+    )(q, k, v, it, do, lse, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
