@@ -232,11 +232,30 @@ def all_gather(tensor_list: Optional[List], tensor: Tensor, group: Optional[Grou
 
 
 def all_gather_object(object_list: List, obj, group=None):
+    """reference communication/all_gather.py::all_gather_object — one small
+    JSON-serializable object per host, gathered across all hosts. jax-native:
+    two process_allgathers over a padded uint8 encoding (lengths, then
+    payloads) — the host-RPC-free equivalent of torch's pickle gather."""
+    import json
+
+    import numpy as np
+
     object_list.clear()
     if _eager_world() == 1:
         object_list.append(obj)
         return
-    raise NotImplementedError("multi-host object gather requires host RPC; use all_gather on tensors")
+    from jax.experimental import multihost_utils
+
+    data = np.frombuffer(json.dumps(obj).encode(), np.uint8)
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.array([data.size], np.int32))).reshape(-1)
+    cap = int(sizes.max())
+    padded = np.zeros(cap, np.uint8)
+    padded[: data.size] = data
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    gathered = gathered.reshape(len(sizes), cap)
+    object_list.extend(json.loads(bytes(gathered[i, : sizes[i]]).decode())
+                       for i in range(len(sizes)))
 
 
 def reduce_scatter(tensor: Tensor, tensor_or_list, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):

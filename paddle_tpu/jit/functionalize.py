@@ -140,6 +140,17 @@ def _tree_key(tree):
     return treedef, sig
 
 
+
+def _abstract_call(args, kwargs):
+    """(args, kwargs) with every array leaf replaced by its
+    ShapeDtypeStruct: memory_analysis only needs shapes/dtypes to re-lower,
+    and storing live arrays would pin a whole input batch in memory between
+    steps."""
+    return jax.tree_util.tree_map(
+        lambda x: (jax.ShapeDtypeStruct(x.shape, x.dtype)
+                   if hasattr(x, "shape") and hasattr(x, "dtype") else x),
+        (args, kwargs))
+
 def _clear_trace_residue(tensors):
     """Drop autograd residue that closes over tracers after a trace."""
     for t in tensors:
@@ -296,6 +307,7 @@ class CompiledFunction:
         re-runs the right specialization (cells not donated → originals
         intact). Unseen signatures build a new specialization from a fresh
         side-effect-free discovery — no committed eager steps."""
+        self._last_call = _abstract_call(args, kwargs)
         guard = family["last"]
         entry = family["entries"][guard]
         try:
@@ -345,10 +357,14 @@ class CompiledFunction:
         calibrate against (VERDICT r3 #9). None when the last call ran
         eagerly or nothing has run yet."""
         entry = self.last_entry
-        if not entry or entry.get("eager") or not entry.get("compiled_once"):
+        if not entry or entry.get("eager"):
             return None
         if entry.get("guarded"):
+            # unwrap to the active specialization; compiled_once lives there,
+            # not on the family dict
             entry = entry["entries"][entry["last"]]
+        if not entry.get("compiled_once"):
+            return None
         last = getattr(self, "_last_call", None)
         if last is None:
             return None
@@ -359,7 +375,7 @@ class CompiledFunction:
         ).memory_analysis()
 
     def _run(self, entry, args, kwargs):
-        self._last_call = (args, kwargs)
+        self._last_call = _abstract_call(args, kwargs)
         cells = entry["cells"]
         cell_vals = [c._value for c in cells]
         if self.donate_cells:

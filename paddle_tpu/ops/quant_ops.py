@@ -217,8 +217,9 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
             q = jnp.clip(jnp.round(w / scale[None, :] * 127.0), -127, 127).astype(jnp.int8)
             return q, scale
         if algo == "weight_only_int4":
-            # Full [-8, 7] int4 range (the max element clips 8→7: ≤1/16
-            # relative error on one value, standard for symmetric int4) and
+            # Full [-8, 7] int4 range (the max-magnitude element clips 8→7:
+            # a 1/8 relative error on that one value — the standard
+            # symmetric-int4 tradeoff for keeping -8 reachable) and
             # two nibbles packed per int8 byte along the input dim — the
             # stored weight really is half the int8 bytes, matching the
             # reference's packed weight_quantize layout.

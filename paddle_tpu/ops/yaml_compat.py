@@ -154,21 +154,53 @@ def disable_check_model_nan_inf(x, flag=0, name=None):
     return manipulation.assign(x)
 
 
-def _forwarding(target_path):
-    """Adapter for YAML rows whose arg table is empty in the snapshot
-    (legacy-format entries): forward everything."""
-    def fn(*args, **kwargs):
-        import importlib
+# Adapters for YAML rows whose arg table is empty in the snapshot
+# (legacy-format entries). Each pins an explicit parameter list mirroring
+# the implementation's contract — blind *args forwarding let positional
+# mis-bindings pass the signature sweep silently (advisor r4).
 
-        mod, _, attr = target_path.partition(":")
-        return getattr(importlib.import_module(mod), attr)(*args, **kwargs)
+def lstm(x, wx, wh, b, init_h=None, init_c=None, time_major=False, name=None):
+    from . import rnn_ops
 
-    return fn
+    return rnn_ops.lstm(x, wx, wh, b, init_h=init_h, init_c=init_c,
+                        time_major=time_major, name=name)
 
 
-lstm = _forwarding("paddle_tpu.ops.rnn_ops:lstm")
-gru = _forwarding("paddle_tpu.ops.rnn_ops:gru")
-gru_unit = _forwarding("paddle_tpu.ops.rnn_ops:gru_unit")
-attention_lstm = _forwarding("paddle_tpu.ops.rnn_ops:lstm")
-beam_search = _forwarding("paddle_tpu.ops.sequence_ops:beam_search_step")
-uniform_random_batch_size_like = _forwarding("paddle_tpu.ops.random:uniform")
+def gru(x, wx, wh, b, init_h=None, time_major=False, name=None):
+    from . import rnn_ops
+
+    return rnn_ops.gru(x, wx, wh, b, init_h=init_h, time_major=time_major,
+                       name=name)
+
+
+def gru_unit(input, hidden_prev, weight, bias=None, activation="tanh",
+             gate_activation="sigmoid", name=None):
+    from . import rnn_ops
+
+    return rnn_ops.gru_unit(input, hidden_prev, weight, bias=bias,
+                            activation=activation,
+                            gate_activation=gate_activation, name=name)
+
+
+attention_lstm = lstm
+
+
+def beam_search(log_probs, prev_scores, beam_size, end_id=0, name=None):
+    from . import sequence_ops
+
+    return sequence_ops.beam_search_step(log_probs, prev_scores, beam_size,
+                                         end_id=end_id, name=name)
+
+
+def uniform_random_batch_size_like(input, shape, input_dim_idx=0,
+                                   output_dim_idx=0, min=-1.0, max=1.0,
+                                   seed=0, dtype=None, name=None):
+    """Legacy uniform_random_batch_size_like: `shape` with dim
+    ``output_dim_idx`` replaced by input's dim ``input_dim_idx`` (reference
+    kernel: uniform_random_batch_size_like_op)."""
+    from . import random as random_ops
+
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = unwrap(input).shape[input_dim_idx]
+    return random_ops.uniform(out_shape, dtype=dtype, min=min, max=max,
+                              seed=seed, name=name)

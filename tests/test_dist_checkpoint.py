@@ -108,3 +108,151 @@ def test_metadata_written_after_shards(tmp_path):
         refs = [c["key"] for c in entry["chunks"]] or [key]
         for r in refs:
             assert r in stored, r
+
+
+# ---- multi-host chunked commit protocol (simulated; advisor r4 medium + the
+# r5 review: merged metadata, rank-qualified keys, per-save nonce acks) ------
+
+class _FakeShard:
+    def __init__(self, data, index, replica_id=0):
+        self.data, self.index, self.replica_id = data, index, replica_id
+
+
+class _FakeGlobalArray:
+    """Stands in for a multi-host jax.Array: 2 row-chunks, only one
+    addressable from this process."""
+    is_fully_addressable = False
+
+    def __init__(self, full, lo, hi):
+        self._full = full
+        self.shape = full.shape
+        self.dtype = full.dtype
+        self.addressable_shards = [
+            _FakeShard(full[lo:hi], (slice(lo, hi), slice(0, full.shape[1])))]
+
+
+def _chunked_state(rank):
+    full = np.arange(8, dtype=np.float32).reshape(4, 2)
+    t = paddle.to_tensor(np.zeros((4, 2), np.float32))
+    t._value = _FakeGlobalArray(full, 2 * rank, 2 * rank + 2)
+    return full, {"w": t}
+
+
+def test_chunked_save_merges_all_ranks_metadata(tmp_path, monkeypatch):
+    """Simulated 2-rank chunked save: the committed metadata must reference
+    BOTH ranks' (rank-qualified) chunks and the loader must reassemble the
+    full global array from the two shard files."""
+    import uuid as uuid_mod
+
+    import importlib
+    ssd = importlib.import_module("paddle_tpu.distributed.checkpoint.save_state_dict")
+
+    d = str(tmp_path / "ck")
+    os.makedirs(d)
+    full, state = _chunked_state(rank=0)
+
+    class _FixedUUID:
+        hex = "cafebabe"
+
+    monkeypatch.setattr(uuid_mod, "uuid4", lambda: _FixedUUID)
+
+    # rank 1's side of the save, pre-staged: its shard file + durable ack,
+    # plus stale artifacts from a superseded save that the commit must GC
+    np.savez(os.path.join(d, "shard_1_cafebabe.npz"),
+             **{"w__r1c0_cafebabe": full[2:4]})
+    open(os.path.join(d, "ack_1_cafebabe"), "w").close()
+    np.savez(os.path.join(d, "shard_1_00000000.npz"),
+             **{"w__r1c0_00000000": np.zeros((2, 2), np.float32)})
+
+    # gather returns both payloads (rank 1's chunk indices ride the gather)
+    def fake_gather(payload):
+        other = {"rank": 1, "nonce": None,
+                 "chunks": {"w": [[0, [[2, 4], [0, 2]]]]}}
+        return [payload, other]
+
+    monkeypatch.setattr(ssd, "_gather_object", fake_gather)
+    ssd.save_state_dict(state, d, async_save=False)
+
+    with open(os.path.join(d, "metadata.json")) as f:
+        meta = json.load(f)
+    keys = sorted(c["key"] for c in meta["entries"]["w"]["chunks"])
+    assert keys == ["w__r0c0_cafebabe", "w__r1c0_cafebabe"]
+    assert not os.path.exists(os.path.join(d, "shard_1_00000000.npz"))
+
+    out = paddle.to_tensor(np.zeros((4, 2), np.float32))
+    ckpt.load_state_dict({"w": out}, d)
+    np.testing.assert_allclose(out.numpy(), full)
+
+
+def test_chunked_save_stale_ack_blocks_commit(tmp_path, monkeypatch):
+    """An ack from a PREVIOUS save (different nonce) must not satisfy the
+    commit wait: the save raises and metadata.json stays unwritten."""
+    import importlib
+    ssd = importlib.import_module("paddle_tpu.distributed.checkpoint.save_state_dict")
+
+    d = str(tmp_path / "ck")
+    os.makedirs(d)
+    _, state = _chunked_state(rank=0)
+
+    # stale artifacts from an older save into the same directory
+    np.savez(os.path.join(d, "shard_1_00000000.npz"),
+             **{"w__r1c0_00000000": np.zeros((2, 2), np.float32)})
+    open(os.path.join(d, "ack_1_00000000"), "w").close()
+
+    def fake_gather(payload):
+        other = {"rank": 1, "nonce": None,
+                 "chunks": {"w": [[0, [[2, 4], [0, 2]]]]}}
+        return [payload, other]
+
+    monkeypatch.setattr(ssd, "_gather_object", fake_gather)
+    monkeypatch.setenv("PADDLE_CKPT_COMMIT_TIMEOUT_S", "0.3")
+    with pytest.raises(RuntimeError, match="NOT committed"):
+        ssd.save_state_dict(state, d, async_save=False)
+    assert not os.path.exists(os.path.join(d, "metadata.json"))
+
+
+def test_async_commit_failure_surfaces_in_wait(tmp_path, monkeypatch):
+    """async_save=True: a commit failure is re-raised by wait_async_save,
+    not swallowed on the writer thread."""
+    import importlib
+    ssd = importlib.import_module("paddle_tpu.distributed.checkpoint.save_state_dict")
+
+    d = str(tmp_path / "ck")
+    os.makedirs(d)
+    _, state = _chunked_state(rank=0)
+
+    def fake_gather(payload):
+        other = {"rank": 1, "nonce": None, "chunks": {}}
+        return [payload, other]
+
+    monkeypatch.setattr(ssd, "_gather_object", fake_gather)
+    monkeypatch.setenv("PADDLE_CKPT_COMMIT_TIMEOUT_S", "0.3")
+    ssd.save_state_dict(state, d, async_save=True)
+    with pytest.raises(RuntimeError, match="NOT committed"):
+        ssd.wait_async_save(d)
+
+
+def test_gather_object_single_process_identity():
+    from paddle_tpu.distributed.checkpoint.save_state_dict import _gather_object
+
+    obj = {"rank": 0, "chunks": {"a": [1, 2]}}
+    assert _gather_object(obj) == [obj]
+
+
+def test_overlapping_async_saves_serialize(tmp_path):
+    """Two async saves into the same path chain (never interleave); the
+    final committed checkpoint is the later save's data."""
+    d = str(tmp_path / "ck")
+    w = paddle.to_tensor(np.full(4, 1.0, np.float32))
+    ckpt.save_state_dict({"w": w}, d, async_save=True)
+    w2 = paddle.to_tensor(np.full(4, 2.0, np.float32))
+    ckpt.save_state_dict({"w": w2}, d, async_save=True)
+    ckpt.wait_async_save(d)
+    out = paddle.to_tensor(np.zeros(4, np.float32))
+    ckpt.load_state_dict({"w": out}, d)
+    np.testing.assert_allclose(out.numpy(), np.full(4, 2.0))
+    # a sync save right after joins any stragglers and commits cleanly
+    w3 = paddle.to_tensor(np.full(4, 3.0, np.float32))
+    ckpt.save_state_dict({"w": w3}, d, async_save=False)
+    ckpt.load_state_dict({"w": out}, d)
+    np.testing.assert_allclose(out.numpy(), np.full(4, 3.0))
