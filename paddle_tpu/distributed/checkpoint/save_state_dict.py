@@ -98,6 +98,7 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
             arrays[k] = np.asarray(v)  # device->host once, before any disk IO
         meta["entries"][k] = entry
 
+    t_start = time.time()  # GC horizon: never collect files newer than this
     nonce: Optional[str] = None
     ack_ranks: list = []
     if chunked:
@@ -197,7 +198,10 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
             # for non-chunked commits too — a single-host save into a dir
             # that previously held a chunked save must clear the stale
             # nonce-shards, or the loader's merge would let their plain keys
-            # shadow the fresh ones.
+            # shadow the fresh ones. Only files older than THIS save's start
+            # are collected: other hosts' writers chain per-process, so an
+            # overlapping save N+1 may already have durable files here —
+            # they are newer than t_start and must survive save N's GC.
             for old in os.listdir(path):
                 if old.endswith(".tmp"):
                     continue
@@ -205,7 +209,9 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
                 if (len(parts) == 3 and parts[0] in ("shard", "ack")
                         and parts[2] != nonce):
                     try:
-                        os.remove(os.path.join(path, old))
+                        full = os.path.join(path, old)
+                        if os.path.getmtime(full) < t_start:
+                            os.remove(full)
                     except OSError:
                         pass
 

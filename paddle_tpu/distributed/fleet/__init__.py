@@ -38,8 +38,11 @@ from .pipeline import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .pipeline_schedules import (  # noqa: F401
     PipelinedStack,
     forward_backward_pipeline_1f1b,
+    forward_backward_pipeline_eager_1f1b,
     forward_backward_pipeline_interleave,
     forward_backward_pipeline_rotation,
+    forward_backward_pipeline_zero_bubble,
+    schedule_cost_report,
 )
 
 meta_parallel = mpu  # submodule alias: fleet.meta_parallel.* layer surface

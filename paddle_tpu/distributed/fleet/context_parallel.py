@@ -104,12 +104,22 @@ def _cp_call(body_builder, q, k, v, axis: str, extra_check=None):
 
             scale = 1.0 / math.sqrt(qq.shape[-1])
             return _xla_attention(qq, kk, vv, causal=body_builder.keywords["causal"], scale=scale)
+        # Nested-manual support (pp pipeline shard_map around a cp block):
+        # when tracing inside an enclosing shard_map, the inner shard_map
+        # must be built on the CONTEXT's abstract mesh, and axes the outer
+        # region already made Manual (pp, dp) must not appear in the specs —
+        # the operands are already per-shard along them.
+        from .mpu import _manual_axes
+
+        manual = _manual_axes()
+        use_mesh = jax.sharding.get_abstract_mesh() if manual else mesh
         dp = mesh.shape.get("dp", 1)
-        batch_axis = "dp" if (dp > 1 and qv.shape[0] % dp == 0) else None
+        batch_axis = ("dp" if (dp > 1 and qv.shape[0] % dp == 0
+                               and "dp" not in manual) else None)
         spec = P(batch_axis, axis, None, None)
         shmap = jax.shard_map(
             body_builder,
-            mesh=mesh,
+            mesh=use_mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
             check_vma=False,

@@ -57,11 +57,39 @@ def _sanitize_spec(spec: P, shape, mesh) -> P:
     return P(*entries)
 
 
+def _manual_axes() -> frozenset:
+    """Axes the enclosing shard_map (if any) already made Manual — a
+    sharding constraint inside that region must not mention them (the
+    operand is already per-shard along them)."""
+    ctx = jax.sharding.get_abstract_mesh()
+    if getattr(ctx, "axis_names", None):
+        from jax.sharding import AxisType
+
+        return frozenset(n for n, t in zip(ctx.axis_names, ctx.axis_types)
+                         if t == AxisType.Manual)
+    return frozenset()
+
+
+def _strip_manual(spec: P, manual: frozenset) -> P:
+    entries = []
+    for entry in spec:
+        if entry is None:
+            entries.append(None)
+            continue
+        axes = tuple(a for a in (entry if isinstance(entry, tuple) else (entry,))
+                     if a not in manual)
+        entries.append(axes if len(axes) > 1 else (axes[0] if axes else None))
+    return P(*entries)
+
+
 def _constrain(x: Tensor, spec: P) -> Tensor:
     """Sharding constraint on an activation (the c_identity/c_split analog)."""
     mesh = _mesh()
     if mesh.shape.get(_MP_AXIS, 1) == 1:
         return x
+    manual = _manual_axes()
+    if manual:
+        spec = _strip_manual(spec, manual)
     spec = _sanitize_spec(spec, x.shape, mesh)
     sharding = NamedSharding(mesh, spec)
     if isinstance(x._value, jax.core.Tracer):

@@ -147,14 +147,15 @@ def pipeline_spmd(
 
     has_rng = rng_key is not None
 
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "eager_1f1b", "zb", "zbh1"):
         if v != 1:
             raise ValueError(
-                "schedule='1f1b' requires num_chunks == 1; interleaved VPP "
-                "stacks use the rotation schedule")
+                f"schedule={schedule!r} requires num_chunks == 1; interleaved "
+                "VPP stacks use the rotation schedule")
         return _pipeline_1f1b(
             apply_layer, stacked_leaves, x, p=p, m=m, mesh=mesh, axis=axis,
-            batch_axis=batch_axis, rng_key=rng_key)
+            batch_axis=batch_axis, rng_key=rng_key,
+            variant="zb" if schedule in ("zb", "zbh1") else "combined")
     if schedule != "rotation":
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
 
@@ -261,7 +262,7 @@ def pipeline_spmd(
 
 
 def _pipeline_1f1b(apply_layer, stacked_leaves, x, *, p, m, mesh, axis,
-                   batch_axis, rng_key):
+                   batch_axis, rng_key, variant="combined"):
     """True tick-interleaved 1F1B (reference:
     fleet/meta_parallel/pipeline_parallel.py:575 — in-flight microbatches
     capped per stage, unlike the rotation schedule's O(m) scan residuals).
@@ -294,7 +295,7 @@ def _pipeline_1f1b(apply_layer, stacked_leaves, x, *, p, m, mesh, axis,
     rng = rng_key if has_rng else jax.random.PRNGKey(0)
 
     cache_key = (
-        "1f1b", apply_layer, p, m, axis, batch_axis, mesh, has_rng,
+        "1f1b", variant, apply_layer, p, m, axis, batch_axis, mesh, has_rng,
         tuple(mb_shape), str(x_mb.dtype),
         tuple((tuple(a.shape), str(a.dtype)) for a in stacked_leaves),
     )
@@ -357,7 +358,8 @@ def _pipeline_1f1b(apply_layer, stacked_leaves, x, *, p, m, mesh, axis,
             d = jax.lax.axis_index(axis)
             leaves = list(leaves)
             stage_rng = jax.random.fold_in(rng, d) if has_rng else None
-            T2 = m + 2 * (p - 1) + 1
+            # last active tick: B(0, m-1) at u = m-1 + 2(p-1)
+            T2 = m + 2 * (p - 1)
             nbuf = 2 * p
             fbuf0 = jnp.zeros((nbuf,) + x_mb.shape[1:], x_mb.dtype)
             fcur0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
@@ -411,6 +413,146 @@ def _pipeline_1f1b(apply_layer, stacked_leaves, x, *, p, m, mesh, axis,
                 gacc = [jax.lax.psum(ga, batch_axis) for ga in gacc]
             return (dxout, *gacc)
 
+        def bwd_body_zb(g, x_mb, rng, *leaves):
+            """ZB-H1 backward (reference pipeline_zero_bubble.py:66 —
+            BACKWARD split into _b (input-grad, critical path) and _w
+            (weight-grad, bubble filler)), re-designed for the lockstep SPMD
+            tick loop. Here every traced tick costs its full body whether a
+            stage is active or not, so "filling the bubble" means *shrinking
+            the traced body of bubble ticks*, not reordering async jobs:
+
+            - warmup scan (p-1 ticks): forward units only — no stage has a
+              backward yet, so no vjp is traced at all (the combined 1f1b
+              body pays a full predicated vjp here);
+            - steady scan (m ticks): F + combined vjp, as 1f1b — dx and dW
+              share one chunk recompute, which a dB/dW split would double;
+            - drain scan (p-1 ticks): dx-only vjp keeps the inter-stage
+              cotangent ring (the critical path) moving; the cotangents are
+              parked (the chunk inputs are still in the forward ring buffer);
+            - dW epilogue scan (p-1 ticks): the parked (input, cotangent)
+              pairs' weight-grads — the reference's deferred _w jobs — run
+              as one contiguous MXU-friendly block.
+
+            Per-stage activation memory stays O(p) (the 2p-slot forward ring
+            plus a (p-1)-slot cotangent park). Traced-unit accounting vs the
+            combined schedule: schedule_cost_report()."""
+            d = jax.lax.axis_index(axis)
+            leaves = list(leaves)
+            stage_rng = jax.random.fold_in(rng, d) if has_rng else None
+            nbuf = 2 * p
+            fbuf0 = jnp.zeros((nbuf,) + x_mb.shape[1:], x_mb.dtype)
+            fcur0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+            bcur0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+            gacc0 = [jnp.zeros_like(a) for a in leaves]
+            dx0 = jnp.zeros(x_mb.shape, x_mb.dtype)
+
+            def f_subtick(fbuf, fcur, u):
+                """F(d, i_f) at u = i_f + d; parks the chunk input."""
+                i_f = u - d
+                act_f = (i_f >= 0) & (i_f < m)
+                icf = jnp.clip(i_f, 0, m - 1)
+                x_in = jnp.where(
+                    d == 0,
+                    jax.lax.dynamic_index_in_dim(x_mb, icf, 0, keepdims=False),
+                    fcur)
+                slot_f = jnp.mod(icf, nbuf)
+                old = jax.lax.dynamic_index_in_dim(fbuf, slot_f, 0, keepdims=False)
+                fbuf = jax.lax.dynamic_update_index_in_dim(
+                    fbuf, jnp.where(act_f, x_in, old), slot_f, 0)
+                key_f = (jax.random.fold_in(stage_rng, icf) if has_rng else None)
+                y = chunk_run(leaves, x_in, key_f)
+                return fbuf, jax.lax.ppermute(y, axis, ring_fwd)
+
+            def b_inputs(fbuf, bcur, u):
+                """Cotangent + parked input for B(d, i_b) at
+                u = i_b + 2(p-1) - d."""
+                i_b = u - 2 * (p - 1) + d
+                act_b = (i_b >= 0) & (i_b < m)
+                icb = jnp.clip(i_b, 0, m - 1)
+                ct = jnp.where(
+                    d == p - 1,
+                    jax.lax.dynamic_index_in_dim(g, icb, 0, keepdims=False),
+                    bcur).astype(x_mb.dtype)
+                x_b = jax.lax.dynamic_index_in_dim(
+                    fbuf, jnp.mod(icb, nbuf), 0, keepdims=False)
+                key_b = (jax.random.fold_in(stage_rng, icb) if has_rng else None)
+                return act_b, icb, ct, x_b, key_b
+
+            def warmup_tick(carry, u):
+                fbuf, fcur = carry
+                fbuf, fcur = f_subtick(fbuf, fcur, u)
+                return (fbuf, fcur), None
+
+            def steady_tick(carry, u):
+                fbuf, fcur, bcur, gacc, dxout = carry
+                fbuf, fcur = f_subtick(fbuf, fcur, u)
+                act_b, icb, ct, x_b, key_b = b_inputs(fbuf, bcur, u)
+                _, vjp_fn = jax.vjp(
+                    lambda cl, xx: chunk_run(cl, xx, key_b), leaves, x_b)
+                dleaves, dx = vjp_fn(ct)
+                gacc = [ga + jnp.where(act_b, dl, jnp.zeros_like(dl))
+                        for ga, dl in zip(gacc, dleaves)]
+                cur_slot = jax.lax.dynamic_index_in_dim(dxout, icb, 0, keepdims=False)
+                dxout = jax.lax.dynamic_update_index_in_dim(
+                    dxout, jnp.where(act_b & (d == 0), dx, cur_slot), icb, 0)
+                bcur = jax.lax.ppermute(dx, axis, ring_bwd)
+                return (fbuf, fcur, bcur, gacc, dxout), None
+
+            def drain_tick(carry, u):
+                fbuf, bcur, gacc, dxout, wq_ct = carry
+                act_b, icb, ct, x_b, key_b = b_inputs(fbuf, bcur, u)
+                # dx-only vjp: the dW half of this microbatch's backward is
+                # deferred to the epilogue (the chunk input stays parked in
+                # fbuf; only the cotangent needs a slot)
+                _, vjp_x = jax.vjp(lambda xx: chunk_run(leaves, xx, key_b), x_b)
+                (dx,) = vjp_x(ct)
+                j = u - (m + p - 1)
+                old_ct = jax.lax.dynamic_index_in_dim(wq_ct, j, 0, keepdims=False)
+                wq_ct = jax.lax.dynamic_update_index_in_dim(
+                    wq_ct, jnp.where(act_b, ct, old_ct), j, 0)
+                cur_slot = jax.lax.dynamic_index_in_dim(dxout, icb, 0, keepdims=False)
+                dxout = jax.lax.dynamic_update_index_in_dim(
+                    dxout, jnp.where(act_b & (d == 0), dx, cur_slot), icb, 0)
+                bcur = jax.lax.ppermute(dx, axis, ring_bwd)
+                return (fbuf, bcur, gacc, dxout, wq_ct), None
+
+            def dw_tick(carry, j):
+                fbuf, gacc, wq_ct = carry
+                # deferred _w job j of this stage: B(d, i) drained at
+                # u = m+p-1+j ⇒ i = m + j + d - (p-1); active while
+                # j < p-1-d (stage p-1 deferred nothing)
+                i = m + j + d - (p - 1)
+                act = (i >= 0) & (i < m)
+                ic = jnp.clip(i, 0, m - 1)
+                x_b = jax.lax.dynamic_index_in_dim(
+                    fbuf, jnp.mod(ic, nbuf), 0, keepdims=False)
+                ct = jax.lax.dynamic_index_in_dim(wq_ct, j, 0, keepdims=False)
+                key_b = (jax.random.fold_in(stage_rng, ic) if has_rng else None)
+                _, vjp_w = jax.vjp(lambda cl: chunk_run(cl, x_b, key_b), leaves)
+                (dleaves,) = vjp_w(ct)
+                gacc = [ga + jnp.where(act, dl, jnp.zeros_like(dl))
+                        for ga, dl in zip(gacc, dleaves)]
+                return (fbuf, gacc, wq_ct), None
+
+            wq_ct0 = jnp.zeros((max(p - 1, 1),) + x_mb.shape[1:], x_mb.dtype)
+            (fbuf, fcur), _ = jax.lax.scan(
+                warmup_tick, (fbuf0, fcur0), jnp.arange(p - 1))
+            (fbuf, fcur, bcur, gacc, dxout), _ = jax.lax.scan(
+                steady_tick, (fbuf, fcur, bcur0, gacc0, dx0),
+                jnp.arange(p - 1, m + p - 1))
+            (fbuf, bcur, gacc, dxout, wq_ct), _ = jax.lax.scan(
+                drain_tick, (fbuf, bcur, gacc, dxout, wq_ct0),
+                jnp.arange(m + p - 1, m + 2 * (p - 1)))
+            (_, gacc, _), _ = jax.lax.scan(
+                dw_tick, (fbuf, gacc, wq_ct), jnp.arange(p - 1))
+            dxout = jax.lax.psum(dxout, axis)  # only stage 0 wrote real rows
+            if batch_axis:
+                gacc = [jax.lax.psum(ga, batch_axis) for ga in gacc]
+            return (dxout, *gacc)
+
+        if variant == "zb":
+            bwd_body = bwd_body_zb
+
         manual = {axis} | ({batch_axis} if batch_axis else set())
         fwd_shmap = jax.shard_map(
             fwd_body, mesh=mesh,
@@ -447,6 +589,34 @@ def _pipeline_1f1b(apply_layer, stacked_leaves, x, *, p, m, mesh, axis,
     return out.reshape(x.shape)
 
 
+def schedule_cost_report(p: int, m: int, schedule: str) -> dict:
+    """Traced-unit accounting for one train step of the tick-interleaved
+    schedules (the SPMD analog of the reference's per-stage job-list bubble
+    accounting). Unit model, with per-chunk remat: F = 1 unit,
+    combined vjp = 3 (recompute + dx + dW), dx-only vjp = 2, dW-only
+    vjp = 2. In the lockstep tick loop every traced tick costs its full
+    body on every stage, active or not, so wasted = total − useful is the
+    bubble — the quantity ZB-H1 shrinks by giving warmup ticks an F-only
+    body and bubble-filling the deferred dW jobs.
+    """
+    useful = 4 * m  # per stage: m forwards + m combined backwards
+    if schedule in ("1f1b", "eager_1f1b"):
+        total = (m + 2 * (p - 1)) * 4  # every tick: F + combined vjp
+    elif schedule in ("zb", "zbh1"):
+        total = ((p - 1) * 1          # warmup: F only
+                 + m * 4              # steady: F + combined vjp
+                 + (p - 1) * 2        # drain: dx-only vjp
+                 + (p - 1) * 2)       # epilogue: deferred dW block
+    else:
+        raise ValueError(f"no cost model for schedule {schedule!r}")
+    return {
+        "schedule": schedule, "p": p, "m": m,
+        "total_units": total, "useful_units": useful,
+        "wasted_units": total - useful,
+        "bubble_fraction": (total - useful) / total,
+    }
+
+
 import collections
 
 _COMPILED: "collections.OrderedDict" = collections.OrderedDict()
@@ -476,10 +646,10 @@ class PipelinedStack(Layer):
         self.num_chunks = num_chunks
         self.num_layers = num_layers
         self.remat = remat
-        if schedule not in ("rotation", "1f1b"):
+        if schedule not in ("rotation", "1f1b", "eager_1f1b", "zb", "zbh1"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
-        if schedule == "1f1b" and num_chunks != 1:
-            raise ValueError("schedule='1f1b' requires num_chunks == 1")
+        if schedule != "rotation" and num_chunks != 1:
+            raise ValueError(f"schedule={schedule!r} requires num_chunks == 1")
         self.schedule = schedule
         if num_layers % (self.num_stages * num_chunks) != 0:
             raise ValueError(
@@ -610,6 +780,36 @@ def forward_backward_pipeline_1f1b(stack: PipelinedStack, x):
     forward with the 1f1b schedule regardless of its configured default."""
     assert stack.num_chunks == 1
     prev, stack.schedule = stack.schedule, "1f1b"
+    try:
+        return stack(x)
+    finally:
+        stack.schedule = prev
+
+
+def forward_backward_pipeline_zero_bubble(stack: PipelinedStack, x):
+    """ZB-H1 (reference pipeline_zero_bubble.py:66): backward split into
+    dB (input-grad, kept on the inter-stage critical path) and dW
+    (weight-grad, deferred into the drain bubble as a batched epilogue).
+    See bwd_body_zb for the lockstep-SPMD redesign; schedule_cost_report
+    quantifies the traced-unit saving vs the combined 1F1B body."""
+    assert stack.num_chunks == 1
+    prev, stack.schedule = stack.schedule, "zb"
+    try:
+        return stack(x)
+    finally:
+        stack.schedule = prev
+
+
+def forward_backward_pipeline_eager_1f1b(stack: PipelinedStack, x):
+    """Eager 1F1B (reference pipeline_eager_1f1b.py:36: warmup runs
+    2(p−s)−1 forwards instead of p−s, trading in-flight activations for
+    overlap). In the lockstep SPMD tick loop F(s, i) already runs at the
+    earliest dependency-feasible tick u = i + s and each stage parks
+    ≤ 2(p−1−s) inputs — exactly the eager profile — so this IS the 1f1b
+    tick mapping; the lazy/standard variant would park the same-sized
+    tensors one hop later with zero memory or tick difference here."""
+    assert stack.num_chunks == 1
+    prev, stack.schedule = stack.schedule, "eager_1f1b"
     try:
         return stack(x)
     finally:

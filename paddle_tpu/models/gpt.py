@@ -55,6 +55,9 @@ class GPTConfig:
     pipeline_parallel: bool = False
     virtual_pp_degree: int = 1
     pp_num_microbatches: int = 0  # 0 → 2 * pp degree
+    # "rotation" | "1f1b" | "eager_1f1b" | "zb" (ZB-H1) — see
+    # fleet/pipeline_schedules.py
+    pp_schedule: str = "rotation"
 
     def __post_init__(self):
         if self.intermediate_size == 0:
@@ -220,6 +223,7 @@ class GPTModel(Layer):
                 num_layers=config.num_hidden_layers,
                 num_chunks=max(config.virtual_pp_degree, 1),
                 num_microbatches=config.pp_num_microbatches or None,
+                schedule=config.pp_schedule,
             )
         else:
             self.h = nn.LayerList(
