@@ -1,5 +1,5 @@
 """paddle.amp surface (reference: python/paddle/amp/__init__.py)."""
-from . import amp_lists  # noqa: F401
+from . import amp_lists, debugging  # noqa: F401
 from .auto_cast import amp_guard, auto_cast, decorate, get_amp_dtype, is_auto_cast_enabled  # noqa: F401
 from .grad_scaler import GradScaler  # noqa: F401
 

@@ -52,6 +52,16 @@ def _check_nan_inf(name, values):
                 raise PreconditionNotMetError(f"op '{name}' produced NaN/Inf output")
 
 
+
+def _observe(name, out_list):
+    """Post-dispatch output taps: nan/inf scan (FLAGS_check_nan_inf) and the
+    amp.debugging observer (tensor checker / operator stats)."""
+    if get_flag("check_nan_inf"):
+        _check_nan_inf(name, [o._value for o in out_list])
+    if hooks.op_observer is not None:
+        hooks.op_observer(name, [o._value for o in out_list])
+
+
 def primitive(
     name: str,
     fn: Callable,
@@ -91,8 +101,7 @@ def _primitive_impl(name, fn, tensor_args, attrs):
     if not diff_idx:
         out = fn(*values, **attrs)
         outs = _wrap_outputs(name, out, stop_gradient=True)
-        if get_flag("check_nan_inf"):
-            _check_nan_inf(name, [o._value for o in (outs if isinstance(outs, tuple) else (outs,))])
+        _observe(name, outs if isinstance(outs, tuple) else (outs,))
         if hooks.static_capture is not None:
             hooks.static_capture.record(name, fn, tensor_args, attrs, outs)
         return outs
@@ -124,8 +133,7 @@ def _primitive_impl(name, fn, tensor_args, attrs):
         o._grad_node = node
         o._output_index = i
 
-    if get_flag("check_nan_inf"):
-        _check_nan_inf(name, [o._value for o in out_list])
+    _observe(name, out_list)
     if hooks.static_capture is not None:
         hooks.static_capture.record(name, fn, tensor_args, attrs, outs)
     return outs
@@ -145,8 +153,7 @@ def passthrough(name: str, fn: Callable, tensor_args: Sequence[Any], attrs: dict
     values = [unwrap(a) for a in tensor_args]
     out = fn(*values, **attrs)
     outs = _wrap_outputs(name, out, stop_gradient=True)
-    if get_flag("check_nan_inf"):
-        _check_nan_inf(name, [o._value for o in (outs if isinstance(outs, tuple) else (outs,))])
+    _observe(name, outs if isinstance(outs, tuple) else (outs,))
     if hooks.static_capture is not None:
         hooks.static_capture.record(name, fn, tensor_args, attrs, outs)
     return outs

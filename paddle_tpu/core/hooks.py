@@ -23,3 +23,9 @@ static_capture = None
 # predicate; in replay mode (inside the jit trace) it returns the recorded
 # outcome and collects the predicate tracer for the runtime guard.
 branch_trace = None
+
+# set by paddle_tpu/amp/debugging.py while a tensor checker or operator-stats
+# collection is active: a callable (op_name, out_values) invoked after every
+# dispatched op with the raw output values (reference analog: the per-kernel
+# nan_inf_utils / low_precision_op_list hooks in paddle/fluid/eager).
+op_observer = None

@@ -198,10 +198,14 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
             # for non-chunked commits too — a single-host save into a dir
             # that previously held a chunked save must clear the stale
             # nonce-shards, or the loader's merge would let their plain keys
-            # shadow the fresh ones. Only files older than THIS save's start
-            # are collected: other hosts' writers chain per-process, so an
-            # overlapping save N+1 may already have durable files here —
-            # they are newer than t_start and must survive save N's GC.
+            # shadow the fresh ones. Only files comfortably older than THIS
+            # save's start are collected: other hosts' writers chain
+            # per-process, so an overlapping save N+1 may already have
+            # durable files here — they are newer than t_start and must
+            # survive save N's GC. The skew margin absorbs NFS server clock
+            # offset and coarse mtime granularity; a file that survives one
+            # GC for being too new is collected by a later save's.
+            skew = float(os.environ.get("PADDLE_CKPT_GC_SKEW_S", "60"))
             for old in os.listdir(path):
                 if old.endswith(".tmp"):
                     continue
@@ -210,7 +214,7 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
                         and parts[2] != nonce):
                     try:
                         full = os.path.join(path, old)
-                        if os.path.getmtime(full) < t_start:
+                        if os.path.getmtime(full) < t_start - skew:
                             os.remove(full)
                     except OSError:
                         pass

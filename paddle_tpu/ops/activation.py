@@ -8,11 +8,12 @@ from ..core.dispatch import primitive
 from ..core.tensor import unwrap
 
 
-def _unop(name, fn):
+def _unop(op_name, fn):
+    # keep the API `name=` kwarg from shadowing the dispatched op name
     def op(x, name=None):
-        return primitive(name, fn, [x])
+        return primitive(op_name, fn, [x])
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

@@ -7,11 +7,12 @@ from ..core.dispatch import passthrough
 from ..core.tensor import Tensor, unwrap
 
 
-def _cmp(name, fn):
+def _cmp(op_name, fn):
+    # keep the API `name=` kwarg from shadowing the dispatched op name
     def op(x, y, name=None):
-        return passthrough(name, fn, [x, y])
+        return passthrough(op_name, fn, [x, y])
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

@@ -24,11 +24,13 @@ def _axis(axis):
 
 
 # ---------------------------------------------------------------- binary
-def _binop(name, fn):
+def _binop(op_name, fn):
+    # the paddle-API `name=` kwarg must not shadow the OP name: AMP lists,
+    # profiler tags and nan-check messages are all keyed by it
     def op(x, y, name=None):
-        return primitive(name, fn, [x, y])
+        return primitive(op_name, fn, [x, y])
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -104,11 +106,11 @@ def multiplex(inputs, index, name=None):
 
 
 # ---------------------------------------------------------------- unary
-def _unop(name, fn):
+def _unop(op_name, fn):
     def op(x, name=None):
-        return primitive(name, fn, [x])
+        return primitive(op_name, fn, [x])
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

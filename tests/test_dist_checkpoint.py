@@ -163,6 +163,11 @@ def test_chunked_save_merges_all_ranks_metadata(tmp_path, monkeypatch):
     open(os.path.join(d, "ack_1_cafebabe"), "w").close()
     np.savez(os.path.join(d, "shard_1_00000000.npz"),
              **{"w__r1c0_00000000": np.zeros((2, 2), np.float32)})
+    # backdate past the GC skew margin so it reads as a superseded save
+    import time as _time
+
+    old_t = _time.time() - 600
+    os.utime(os.path.join(d, "shard_1_00000000.npz"), (old_t, old_t))
 
     # gather returns both payloads (rank 1's chunk indices ride the gather)
     def fake_gather(payload):
