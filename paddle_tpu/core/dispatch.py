@@ -55,11 +55,16 @@ def _check_nan_inf(name, values):
 
 def _observe(name, out_list):
     """Post-dispatch output taps: nan/inf scan (FLAGS_check_nan_inf) and the
-    amp.debugging observer (tensor checker / operator stats)."""
+    amp.debugging observer (tensor checker / operator stats). Tracer outputs
+    (ops dispatched inside a lax trace, e.g. static control-flow callables)
+    are skipped — host-side value inspection cannot run under tracing."""
+    vals = [o._value for o in out_list]
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        return
     if get_flag("check_nan_inf"):
-        _check_nan_inf(name, [o._value for o in out_list])
+        _check_nan_inf(name, vals)
     if hooks.op_observer is not None:
-        hooks.op_observer(name, [o._value for o in out_list])
+        hooks.op_observer(name, vals)
 
 
 def primitive(

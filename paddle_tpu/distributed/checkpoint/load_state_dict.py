@@ -25,10 +25,18 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
     resharding to each tensor's current placement."""
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
+    # Resolution is metadata-driven: chunk keys are save-nonce-qualified
+    # (collision-free across saves), and PLAIN keys resolve from the
+    # committed save's coordinator shard first — a stale shard file that GC
+    # has not collected yet can never shadow the committed values.
     shards = {}
+    coord = meta.get("coordinator_shard")
     for fname in sorted(os.listdir(path)):
-        if fname.startswith("shard_") and fname.endswith(".npz"):
+        if (fname.startswith("shard_") and fname.endswith(".npz")
+                and fname != coord):
             shards.update(np.load(os.path.join(path, fname)))
+    if coord and os.path.exists(os.path.join(path, coord)):
+        shards.update(np.load(os.path.join(path, coord)))  # authoritative last
     flat = _flatten_state(state_dict)
     entries = meta.get("entries", {})
     missing = [k for k in flat if k not in shards and not entries.get(k, {}).get("chunks")]

@@ -157,6 +157,11 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
         # its atomic replace is already sound.
         shard_final = os.path.join(
             path, f"shard_{rank}_{nonce}.npz" if chunked else f"shard_{rank}.npz")
+        if rank == coordinator_rank:
+            # the loader resolves PLAIN (non-chunked) keys from this file
+            # specifically, so stale same-named keys in other shard files
+            # can never shadow a committed save's values
+            meta["coordinator_shard"] = os.path.basename(shard_final)
         shard_tmp = shard_final + ".tmp"
         with open(shard_tmp, "wb") as f:
             np.savez(f, **arrays)

@@ -1,0 +1,349 @@
+"""Static-mode control flow (reference python/paddle/static/nn/control_flow.py
+— while_loop :1126, cond :943, case :1372, switch_case :1436).
+
+TPU-native design: three execution modes per construct —
+
+- **recording** (program_guard / enable_static): records as ONE replayable
+  node whose fn is the matching `lax` structured-control primitive, so a
+  data-dependent loop compiles into the Executor's single XLA program.
+  A discovery pass collects every EXISTING tensor the user callables read
+  (closures over feeds, earlier op outputs, parameters); those become
+  explicit node args so they resolve through the replay env / the
+  by-reference constants path instead of freezing at record-time values.
+- **concrete dygraph**: plain Python control flow on concrete values. When
+  an enclosing construct's discovery pass is active, BOTH branches run (so
+  their reads are discovered) and control values are reported as reads.
+- **inline traced**: a construct whose control value is already a tracer
+  (it is nested inside another construct's traced callable) executes the
+  `lax` primitive directly without recording — nested cond/while compose
+  into one program.
+
+The user's callables always run with recording suspended and the autograd
+tape off (their inner ops belong to the control-flow node, not the
+program); they must be side-effect-free — they run once for discovery and
+again under trace, the same constraint the reference's block-capture
+imposes.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import hooks
+from ..core.dispatch import passthrough
+from ..core.tensor import Tensor, unwrap
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+@contextlib.contextmanager
+def _suspend_capture():
+    prev, hooks.static_capture = hooks.static_capture, None
+    try:
+        from ..base import global_state
+
+        with global_state.no_grad_guard():
+            yield
+    finally:
+        hooks.static_capture = prev
+
+
+def _run_fn(fn, *tensor_args):
+    """Run a user callable with capture + tape suspended."""
+    with _suspend_capture():
+        return fn(*tensor_args)
+
+
+class _ReadRecorder:
+    """Discovery hook: which EXISTING tensors do the user callables read?"""
+
+    def __init__(self):
+        self.reads = {}
+        self.created = set()
+
+    def record_create(self, t):
+        self.created.add(id(t))
+
+    def record_reads(self, args):
+        for a in args:
+            if (isinstance(a, Tensor) and id(a) not in self.created
+                    and id(a) not in self.reads):
+                self.reads[id(a)] = a
+
+    def record_write(self, t):
+        pass
+
+    def prune_tracer_cells(self):
+        pass
+
+
+@contextlib.contextmanager
+def _discover_reads():
+    rec = _ReadRecorder()
+    prev, hooks.discovery = hooks.discovery, rec
+    try:
+        yield rec
+    finally:
+        hooks.discovery = prev
+        if prev is not None:
+            # propagate to the enclosing discovery so nested constructs'
+            # closure reads surface in the OUTER construct's capture set
+            prev.record_reads(list(rec.reads.values()))
+
+
+def _report_read(*tensors):
+    if hooks.discovery is not None:
+        hooks.discovery.record_reads([t for t in tensors if _is_tensor(t)])
+
+
+def _swapped(captured, cap_vals, g):
+    """Run g() with each captured tensor's payload swapped to the traced
+    value (restored afterwards)."""
+    saved = [t._value for t in captured]
+    for t, v in zip(captured, cap_vals):
+        t._value = v
+    try:
+        return g()
+    finally:
+        for t, s in zip(captured, saved):
+            t._value = s
+
+
+def _flatten(struct):
+    """Flatten with Tensors as OPAQUE leaves (not pytree nodes), so recorded
+    node args stay Tensor objects that bind by id into the program, and
+    structure comparison ignores Tensor aux metadata."""
+    return jax.tree_util.tree_flatten(struct, is_leaf=_is_tensor)
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _wrap_leaves(treedef, vals):
+    return jax.tree_util.tree_unflatten(
+        treedef, [Tensor(v, stop_gradient=True) for v in vals])
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test: bool = False, name: Optional[str] = None) -> List:
+    """reference control_flow.py:1126. ``loop_vars`` is a sequence (any
+    pytree) of Tensors; ``body`` must return the same structure with the
+    same shapes/dtypes (lax.while_loop's contract, which the reference's
+    shape-match check mirrors)."""
+    loop_vars = list(loop_vars)
+    recording = hooks.static_capture is not None
+    leaves, treedef = _flatten(loop_vars)
+    _report_read(*leaves)
+    traced = any(_is_tracer(unwrap(l)) for l in leaves)
+
+    if not recording and not traced:
+        # concrete dygraph: plain python loop
+        while bool(np.asarray(unwrap(_run_fn(cond, *loop_vars)))):
+            out = _run_fn(body, *loop_vars)
+            loop_vars = list(out) if isinstance(out, (tuple, list)) else [out]
+        return loop_vars
+
+    if recording:
+        with _discover_reads() as rec:
+            _run_fn(cond, *loop_vars)
+            _run_fn(body, *loop_vars)
+        loop_ids = {id(l) for l in leaves}
+        captured = [t for i, t in rec.reads.items() if i not in loop_ids]
+    else:
+        captured = []
+    n = len(leaves)
+
+    def fn(*all_vals):
+        leaf_vals, cap_vals = all_vals[:n], all_vals[n:]
+
+        def cond_v(vals):
+            # flatten/unwrap INSIDE the swap: a callable may return a
+            # captured tensor verbatim, whose payload is only the traced
+            # value while the swap is in effect
+            def go():
+                return jnp.reshape(
+                    unwrap(_run_fn(cond, *_wrap_leaves(treedef, vals))),
+                    ()).astype(bool)
+
+            return _swapped(captured, cap_vals, go)
+
+        def body_v(vals):
+            def go():
+                out = _run_fn(body, *_wrap_leaves(treedef, vals))
+                out = list(out) if isinstance(out, (tuple, list)) else [out]
+                out_leaves, out_def = _flatten(out)
+                if out_def != treedef:
+                    raise ValueError(
+                        f"while_loop body returned structure {out_def}, "
+                        f"expected {treedef}")
+                return [jnp.asarray(unwrap(o), jnp.asarray(v).dtype)
+                        for o, v in zip(out_leaves, vals)]
+
+            return _swapped(captured, cap_vals, go)
+
+        return tuple(jax.lax.while_loop(cond_v, body_v, list(leaf_vals)))
+
+    if recording:
+        outs = passthrough("while_loop", fn, list(leaves) + captured)
+        out_list = list(outs) if isinstance(outs, tuple) else [outs]
+        return jax.tree_util.tree_unflatten(treedef, out_list)
+    # inline traced (nested inside another construct's callable)
+    out = fn(*[unwrap(l) for l in leaves])
+    return _wrap_leaves(treedef, out)
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name: Optional[str] = None,
+         return_names=None):
+    """reference control_flow.py:943 — both branches must return the same
+    structure (lax.cond's contract; the reference raises the same way)."""
+    recording = hooks.static_capture is not None
+    _report_read(pred)
+    pv = unwrap(pred)
+
+    if not recording and not _is_tracer(pv):
+        if hooks.discovery is not None:
+            # enclosing discovery pass: visit BOTH branches so their reads
+            # are captured, then return the concretely-taken one
+            t_out = _run_fn(true_fn) if true_fn is not None else None
+            f_out = _run_fn(false_fn) if false_fn is not None else None
+            return t_out if bool(np.asarray(pv)) else f_out
+        taken = true_fn if bool(np.asarray(pv)) else false_fn
+        return _run_fn(taken) if taken is not None else None
+
+    with _discover_reads() as rec:
+        t_out = _run_fn(true_fn) if true_fn is not None else None
+        f_out = _run_fn(false_fn) if false_fn is not None else None
+    t_leaves, t_def = _flatten(t_out)
+    _, f_def = _flatten(f_out)
+    if t_def != f_def:
+        raise ValueError(
+            f"cond branches returned different structures: {t_def} vs {f_def}")
+    if t_out is None:
+        return None
+    captured = list(rec.reads.values()) if recording else []
+    ref_dtypes = [jnp.asarray(unwrap(l)).dtype for l in t_leaves]
+
+    def fn(pred_v, *cap_vals):
+        def branch(f):
+            def run(_):
+                def go():
+                    out_leaves, _ = _flatten(_run_fn(f))
+                    # identical output avals required by lax.cond; the
+                    # reference casts the same way
+                    return [jnp.asarray(unwrap(o), dt)
+                            for o, dt in zip(out_leaves, ref_dtypes)]
+
+                return _swapped(captured, cap_vals, go)
+
+            return run
+
+        out = jax.lax.cond(jnp.reshape(pred_v, ()).astype(bool),
+                           branch(true_fn), branch(false_fn), None)
+        return tuple(out)
+
+    if recording:
+        outs = passthrough("cond", fn, [pred] + captured)
+        out_list = list(outs) if isinstance(outs, tuple) else [outs]
+        return jax.tree_util.tree_unflatten(t_def, out_list)
+    out = fn(pv)
+    return jax.tree_util.tree_unflatten(
+        t_def, [Tensor(v, stop_gradient=True) for v in out])
+
+
+def case(pred_fn_pairs, default: Optional[Callable] = None,
+         name: Optional[str] = None):
+    """reference control_flow.py:1372 — first true predicate wins; compiles
+    to nested lax.cond. With ``default=None`` the last pair's fn is the
+    fallback (reference semantics)."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("case expects at least one (pred, fn) pair")
+    if default is None:
+        pairs, default = pairs[:-1], pairs[-1][1]
+        if not pairs:  # single pair: unconditional — record its ops directly
+            return default()
+
+    def chain(i):
+        if i == len(pairs):
+            return default
+        pred, fn = pairs[i]
+        return lambda: cond(pred, fn, chain(i + 1))
+
+    return chain(0)()
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name: Optional[str] = None):
+    """reference control_flow.py:1436 — integer dispatch over branches
+    (lax.switch); unmatched indices take the default (reference semantics:
+    default, or the last branch when default is None)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [int(k) for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+
+    recording = hooks.static_capture is not None
+    _report_read(branch_index)
+    iv = unwrap(branch_index)
+
+    if not recording and not _is_tracer(iv):
+        if hooks.discovery is not None:
+            outs = [_run_fn(f) for f in fns]
+            d_out = _run_fn(default)
+            idx = int(np.asarray(iv))
+            return dict(zip(keys, outs)).get(idx, d_out)
+        idx = int(np.asarray(iv))
+        return _run_fn(dict(zip(keys, fns)).get(idx, default))
+
+    with _discover_reads() as rec:
+        ref_out = _run_fn(fns[0])
+        for f in fns[1:]:
+            _run_fn(f)
+        _run_fn(default)
+    captured = list(rec.reads.values()) if recording else []
+    ref_leaves, ref_def = _flatten(ref_out)
+    ref_dtypes = [jnp.asarray(unwrap(l)).dtype for l in ref_leaves]
+
+    def fn(idx_v, *cap_vals):
+        # map the branch key to a dense lax.switch slot; unmatched keys
+        # route to the trailing default slot
+        idx_v = jnp.reshape(idx_v, ()).astype(jnp.int32)
+        dense = jnp.int32(len(keys))
+        for pos, k in enumerate(keys):
+            dense = jnp.where(idx_v == k, jnp.int32(pos), dense)
+
+        def make(f):
+            def branch(_):
+                def go():
+                    out_leaves, _ = _flatten(_run_fn(f))
+                    return [jnp.asarray(unwrap(o), dt)
+                            for o, dt in zip(out_leaves, ref_dtypes)]
+
+                return _swapped(captured, cap_vals, go)
+
+            return branch
+
+        out = jax.lax.switch(dense, [make(f) for f in fns] + [make(default)],
+                             None)
+        return tuple(out)
+
+    if recording:
+        outs = passthrough("switch_case", fn, [branch_index] + captured)
+        out_list = list(outs) if isinstance(outs, tuple) else [outs]
+        return jax.tree_util.tree_unflatten(ref_def, out_list)
+    out = fn(iv)
+    return jax.tree_util.tree_unflatten(
+        ref_def, [Tensor(v, stop_gradient=True) for v in out])

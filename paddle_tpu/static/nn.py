@@ -54,11 +54,7 @@ def fc(x, size, num_flatten_dims=1, activation=None, name=None,
         lead = list(unwrap(x).shape[:num_flatten_dims])
         flat = manipulation.reshape(x, lead + [in_dim])
     out = om.add(om.matmul(flat, w), b)
-    if activation:
-        from ..ops import activation as act_mod
-
-        out = getattr(act_mod, activation)(out)
-    return out
+    return _maybe_act(out, activation)
 
 
 def embedding(input, size, is_sparse=False, padding_idx=None, dtype="float32",
@@ -87,11 +83,7 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, name=None,
 
     out = F.batch_norm(input, mean, var, weight=gamma, bias=beta,
                        training=False, momentum=momentum, epsilon=epsilon)
-    if act:
-        from ..ops import activation as act_mod
-
-        out = getattr(act_mod, act)(out)
-    return out
+    return _maybe_act(out, act)
 
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
@@ -105,8 +97,166 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 
     out = F.conv2d(input, w, stride=stride, padding=padding,
                    dilation=dilation, groups=groups)
+    return _maybe_act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, act=None, name=None, **kwargs):
+    """reference static/nn/common.py::layer_norm (normalizes over dims
+    [begin_norm_axis:])."""
+    from ..nn import functional as F
+
+    shape = [int(s) for s in unwrap(input).shape[begin_norm_axis:]]
+    g = _param(shape, unwrap(input).dtype, scale=0.0) if scale else None
+    if g is not None:
+        with _no_capture():
+            g.set_value(np.ones(shape, np.dtype(str(unwrap(input).dtype))))
+    b = _param(shape, unwrap(input).dtype, scale=0.0) if shift else None
+    out = F.layer_norm(input, shape, weight=g, bias=b, epsilon=epsilon)
+    return _maybe_act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, act=None, name=None, **kwargs):
+    """reference static/nn/common.py::group_norm."""
+    from ..nn import functional as F
+
+    c = int(unwrap(input).shape[1])
+    g = _param((c,), unwrap(input).dtype, scale=0.0)
+    with _no_capture():
+        g.set_value(np.ones((c,), np.dtype(str(unwrap(input).dtype))))
+    b = _param((c,), unwrap(input).dtype, scale=0.0)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=g, bias=b)
+    return _maybe_act(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, name=None, **kwargs):
+    """reference static/nn/common.py::instance_norm."""
+    from ..nn import functional as F
+
+    c = int(unwrap(input).shape[1])
+    g = _param((c,), unwrap(input).dtype, scale=0.0)
+    with _no_capture():
+        g.set_value(np.ones((c,), np.dtype(str(unwrap(input).dtype))))
+    b = _param((c,), unwrap(input).dtype, scale=0.0)
+    return F.instance_norm(input, weight=g, bias=b, eps=epsilon)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, act=None, name=None, **kwargs):
+    """reference static/nn/common.py::conv3d."""
+    from ..nn import functional as F
+
+    c_in = int(unwrap(input).shape[1])
+    ks = filter_size if isinstance(filter_size, (list, tuple)) else (
+        filter_size,) * 3
+    w = _param((num_filters, c_in // groups, *ks), unwrap(input).dtype)
+    out = F.conv3d(input, w, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    return _maybe_act(out, act)
+
+
+
+def _transpose_ks(v_shape, filter_size, output_size, stride, padding, nd):
+    """filter_size, or derived from output_size (reference conv*d_transpose:
+    ks = out - (in - 1) * stride + 2 * pad per spatial dim)."""
+    if filter_size is not None:
+        return (tuple(filter_size) if isinstance(filter_size, (list, tuple))
+                else (filter_size,) * nd)
+    if output_size is None:
+        raise ValueError("one of filter_size / output_size is required")
+    outs = (tuple(output_size) if isinstance(output_size, (list, tuple))
+            else (output_size,) * nd)
+    strides = (tuple(stride) if isinstance(stride, (list, tuple))
+               else (stride,) * nd)
+    pads = (tuple(padding) if isinstance(padding, (list, tuple))
+            else (padding,) * nd)
+    ins = v_shape[2:2 + nd]
+    ks = tuple(int(o) - (int(i) - 1) * int(s) + 2 * int(p)
+               for o, i, s, p in zip(outs, ins, strides, pads))
+    if any(k < 1 for k in ks):
+        raise ValueError(
+            f"output_size {outs} unreachable from input {tuple(ins)} with "
+            f"stride {strides} / padding {pads}")
+    return ks
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1, act=None,
+                     name=None, **kwargs):
+    """reference static/nn/common.py::conv2d_transpose."""
+    from ..nn import functional as F
+
+    c_in = int(unwrap(input).shape[1])
+    ks = _transpose_ks(unwrap(input).shape, filter_size, output_size,
+                       stride, padding, 2)
+    w = _param((c_in, num_filters // groups, ks[0], ks[1]),
+               unwrap(input).dtype)
+    out = F.conv2d_transpose(input, w, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size)
+    return _maybe_act(out, act)
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1, act=None,
+                     name=None, **kwargs):
+    """reference static/nn/common.py::conv3d_transpose."""
+    from ..nn import functional as F
+
+    c_in = int(unwrap(input).shape[1])
+    ks = _transpose_ks(unwrap(input).shape, filter_size, output_size,
+                       stride, padding, 3)
+    w = _param((c_in, num_filters // groups, *ks), unwrap(input).dtype)
+    out = F.conv3d_transpose(input, w, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size)
+    return _maybe_act(out, act)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    """reference static/nn/common.py::prelu; mode in {'all','channel',
+    'element'} sizes the slope parameter."""
+    from ..ops import activation as act_mod
+
+    v = unwrap(x)
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        shape = (int(v.shape[1]),)
+    elif mode == "element":
+        shape = tuple(int(s) for s in v.shape[1:])
+    else:
+        raise ValueError(f"prelu mode {mode!r}")
+    w = _param(shape, v.dtype, scale=0.0)
+    with _no_capture():
+        w.set_value(np.full(shape, 0.25, np.dtype(str(v.dtype))))
+    return act_mod.prelu(x, w, data_format=data_format)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference static/nn/common.py::spectral_norm — weight normalized by
+    its largest singular value (power iteration over persistent u/v)."""
+    from ..ops import misc_ops
+
+    v = unwrap(weight)
+    h = int(v.shape[dim])
+    w = 1
+    for i, s in enumerate(v.shape):
+        if i != dim:
+            w *= int(s)
+    u_vec = _param((h,), v.dtype, scale=1.0)
+    v_vec = _param((w,), v.dtype, scale=1.0)
+    return misc_ops.spectral_norm(weight, u_vec, v_vec, dim=dim,
+                                  power_iters=power_iters, eps=eps)
+
+
+def _maybe_act(out, act):
     if act:
         from ..ops import activation as act_mod
 
-        out = getattr(act_mod, act)(out)
+        return getattr(act_mod, act)(out)
     return out
+
+
+# static-mode structured control flow (reference static/nn/control_flow.py)
+from .control_flow import case, cond, switch_case, while_loop  # noqa: E402,F401

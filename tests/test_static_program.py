@@ -107,3 +107,170 @@ def test_eager_mode_unaffected():
     _ = paddle.tanh(t)
     assert len(default_main_program().ops) == n_ops  # nothing recorded
     assert np.isfinite(out.numpy()).all()
+
+
+# ---- static control flow (VERDICT r4 #9; reference
+# python/paddle/static/nn/control_flow.py:943 cond, :1126 while_loop,
+# :1372 case, :1436 switch_case) ---------------------------------------------
+
+def test_static_while_loop_data_dependent():
+    """A data-dependent loop records as ONE lax.while_loop node, replays
+    under the Executor's jit, and its trip count follows the FEED value."""
+    x = paddle.static.data(name="x", shape=[1], dtype="float32")
+    i = paddle.static.data(name="i", shape=[1], dtype="float32")
+
+    out_i, out_x = paddle.static.nn.while_loop(
+        cond=lambda i, x: paddle.sum(i) < 5.0,
+        body=lambda i, x: [i + 1.0, x * 2.0],
+        loop_vars=[i, x])
+
+    main = paddle.static.default_main_program()
+    assert "while_loop" in main.op_types()
+    exe = paddle.static.Executor()
+    # i starts at 0: 5 iterations, x doubles 5 times
+    got_i, got_x = exe.run(main, feed={"x": np.ones(1, np.float32),
+                                       "i": np.zeros(1, np.float32)},
+                           fetch_list=[out_i, out_x])
+    assert got_i[0] == 5.0 and got_x[0] == 32.0
+    # i starts at 3: 2 iterations — same compiled program, different feed
+    got_i, got_x = exe.run(main, feed={"x": np.ones(1, np.float32),
+                                       "i": np.full(1, 3.0, np.float32)},
+                           fetch_list=[out_i, out_x])
+    assert got_i[0] == 5.0 and got_x[0] == 4.0
+
+
+def test_static_while_loop_clone_for_test():
+    """clone(for_test=True) keeps the recorded loop replayable."""
+    x = paddle.static.data(name="x", shape=[1], dtype="float32")
+    (out,) = paddle.static.nn.while_loop(
+        cond=lambda x: paddle.sum(x) < 10.0,
+        body=lambda x: [x + 3.0],
+        loop_vars=[x])
+    test_prog = paddle.static.default_main_program().clone(for_test=True)
+    exe = paddle.static.Executor()
+    (got,) = exe.run(test_prog, feed={"x": np.zeros(1, np.float32)},
+                     fetch_list=[out])
+    assert got[0] == 12.0
+
+
+def test_static_cond_and_case():
+    x = paddle.static.data(name="x", shape=[1], dtype="float32")
+    pred = paddle.sum(x) > 0.0
+    out = paddle.static.nn.cond(pred,
+                                lambda: paddle.sum(x) * 2.0,
+                                lambda: paddle.sum(x) - 1.0)
+    exe = paddle.static.Executor()
+    main = paddle.static.default_main_program()
+    (got,) = exe.run(main, feed={"x": np.full(1, 3.0, np.float32)},
+                     fetch_list=[out])
+    assert got == 6.0
+    (got,) = exe.run(main, feed={"x": np.full(1, -3.0, np.float32)},
+                     fetch_list=[out])
+    assert got == -4.0
+
+
+def test_static_case_chain():
+    x = paddle.static.data(name="x", shape=[1], dtype="float32")
+    s = paddle.sum(x)
+    out = paddle.static.nn.case(
+        [(s < 0.0, lambda: s * 0.0),
+         (s < 10.0, lambda: s + 100.0)],
+        default=lambda: s - 100.0)
+    exe = paddle.static.Executor()
+    main = paddle.static.default_main_program()
+    for feed, want in ((-5.0, 0.0), (5.0, 105.0), (50.0, -50.0)):
+        (got,) = exe.run(main, feed={"x": np.full(1, feed, np.float32)},
+                         fetch_list=[out])
+        assert got == want, (feed, got, want)
+
+
+def test_static_switch_case():
+    idx = paddle.static.data(name="idx", shape=[1], dtype="int32")
+    x = paddle.static.data(name="x", shape=[1], dtype="float32")
+    s = paddle.sum(x)
+    out = paddle.static.nn.switch_case(
+        paddle.sum(idx), {1: lambda: s + 1.0, 3: lambda: s + 3.0},
+        default=lambda: s)
+    exe = paddle.static.Executor()
+    main = paddle.static.default_main_program()
+    for i, want in ((1, 3.0), (3, 5.0), (7, 2.0)):
+        (got,) = exe.run(main,
+                         feed={"idx": np.full(1, i, np.int32),
+                               "x": np.full(1, 2.0, np.float32)},
+                         fetch_list=[out])
+        assert got == want, (i, got, want)
+
+
+def test_static_dygraph_control_flow_fallback():
+    """Outside static mode the constructs run plain python control flow."""
+    paddle.disable_static()
+    try:
+        i = paddle.to_tensor(np.zeros(1, np.float32))
+        x = paddle.to_tensor(np.ones(1, np.float32))
+        i2, x2 = paddle.static.nn.while_loop(
+            lambda i, x: paddle.sum(i) < 3.0,
+            lambda i, x: [i + 1.0, x * 2.0], [i, x])
+        assert float(x2.numpy()[0]) == 8.0
+        got = paddle.static.nn.cond(
+            paddle.sum(x2) > 0, lambda: 1, lambda: 2)
+        assert got == 1
+    finally:
+        paddle.enable_static()
+
+
+def test_static_nn_new_builders():
+    """The widened static.nn builder set records and replays."""
+    img = paddle.static.data(name="img", shape=[2, 4, 8, 8], dtype="float32")
+    h = paddle.static.nn.conv2d_transpose(img, num_filters=3, filter_size=3)
+    h = paddle.static.nn.group_norm(h, groups=3)
+    h = paddle.static.nn.prelu(h, mode="channel")
+    h = paddle.static.nn.instance_norm(h)
+    out = paddle.mean(h)
+    vol = paddle.static.data(name="vol", shape=[1, 2, 4, 4, 4],
+                             dtype="float32")
+    v = paddle.static.nn.conv3d(vol, num_filters=2, filter_size=3, padding=1)
+    vout = paddle.mean(v)
+    seq = paddle.static.data(name="seq", shape=[2, 6], dtype="float32")
+    ln = paddle.static.nn.layer_norm(seq)
+    lout = paddle.mean(ln)
+
+    exe = paddle.static.Executor()
+    rs = np.random.RandomState(0)
+    o1, o2, o3 = exe.run(
+        paddle.static.default_main_program(),
+        feed={"img": rs.randn(2, 4, 8, 8).astype(np.float32),
+              "vol": rs.randn(1, 2, 4, 4, 4).astype(np.float32),
+              "seq": rs.randn(2, 6).astype(np.float32)},
+        fetch_list=[out, vout, lout])
+    for o in (o1, o2, o3):
+        assert np.isfinite(o).all()
+
+
+def test_static_conv2d_transpose_output_size_only():
+    img = paddle.static.data(name="im2", shape=[1, 2, 8, 8], dtype="float32")
+    out = paddle.static.nn.conv2d_transpose(img, num_filters=3,
+                                            output_size=[10, 10])
+    exe = paddle.static.Executor()
+    (got,) = exe.run(paddle.static.default_main_program(),
+                     feed={"im2": np.zeros((1, 2, 8, 8), np.float32)},
+                     fetch_list=[out])
+    assert got.shape == (1, 3, 10, 10)
+
+
+def test_static_while_loop_with_nan_check_enabled():
+    """FLAGS_check_nan_inf must not break recording (traced callables
+    dispatch ops with Tracer outputs; the scan skips them)."""
+    from paddle_tpu.base import flags
+
+    flags.enable_check_nan_inf()
+    try:
+        x = paddle.static.data(name="xn", shape=[1], dtype="float32")
+        (out,) = paddle.static.nn.while_loop(
+            lambda x: paddle.sum(x) < 4.0, lambda x: [x + 1.0], [x])
+        exe = paddle.static.Executor()
+        (got,) = exe.run(paddle.static.default_main_program(),
+                         feed={"xn": np.zeros(1, np.float32)},
+                         fetch_list=[out])
+        assert got[0] == 4.0
+    finally:
+        flags.disable_check_nan_inf()
