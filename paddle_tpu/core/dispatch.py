@@ -58,6 +58,8 @@ def _observe(name, out_list):
     amp.debugging observer (tensor checker / operator stats). Tracer outputs
     (ops dispatched inside a lax trace, e.g. static control-flow callables)
     are skipped — host-side value inspection cannot run under tracing."""
+    if not get_flag("check_nan_inf") and hooks.op_observer is None:
+        return
     vals = [o._value for o in out_list]
     if any(isinstance(v, jax.core.Tracer) for v in vals):
         return

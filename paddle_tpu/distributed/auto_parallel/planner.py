@@ -60,13 +60,21 @@ class Plan:
     mp: int
     pp: int
     sep: int = 1
+    sharding: int = 1  # ZeRO optimizer-state sharding degree (over dp)
     per_device_bytes: int = 0
     reason: str = ""
 
     @property
     def degrees(self) -> dict:
+        """MESH axis degrees (feed these to hybrid_configs). ZeRO sharding
+        rides the dp axis (group_sharded shards over "dp"), so it is NOT a
+        mesh axis here — read ``plan.sharding`` separately."""
         return {"dp_degree": self.dp, "mp_degree": self.mp,
                 "pp_degree": self.pp, "sep_degree": self.sep}
+
+    @property
+    def describe(self) -> dict:
+        return dict(self.degrees, zero_sharding=self.sharding)
 
 
 def _factorizations(n: int) -> List[tuple]:
@@ -113,10 +121,11 @@ def calibrate_against_compiled(step, spec: ModelSpec, batch_size: int,
     mp = degrees.get("mp_degree", 1)
     pp = degrees.get("pp_degree", 1)
     sep = degrees.get("sep_degree", 1)
+    sharding = degrees.get("zero_sharding", degrees.get("sharding_degree", 1))
     est_state = resident_state_bytes(spec, mp, pp, param_bytes, master_weights)
     est_peak = estimate_per_device_bytes(
         spec, batch_size, dp, mp, pp, sep, param_bytes=param_bytes,
-        master_weights=master_weights)
+        master_weights=master_weights, sharding=sharding)
     measured_state = int(ma.argument_size_in_bytes)
     measured_peak = int(ma.argument_size_in_bytes + ma.temp_size_in_bytes)
     return {
@@ -130,14 +139,18 @@ def calibrate_against_compiled(step, spec: ModelSpec, batch_size: int,
 def estimate_per_device_bytes(spec: ModelSpec, batch_size: int, dp: int,
                               mp: int, pp: int, sep: int = 1,
                               param_bytes: int = 2, master_weights: bool = True,
-                              remat: bool = True) -> int:
+                              remat: bool = True, sharding: int = 1) -> int:
     """Per-device HBM estimate: params + grads + Adam moments (+fp32
-    master) sharded over mp·pp, plus activations sharded over dp·mp·sep.
+    master) sharded over mp·pp — with the optimizer-state component further
+    divided by the ZeRO ``sharding`` degree (stage 1/2 shard moments and
+    master weights over dp) — plus activations sharded over dp·mp·sep.
     Activation term uses the remat'd transformer footprint
     (~2·s·h bytes/layer/sample boundaries instead of ~34·s·h full)."""
     model_shard = spec.num_params / (mp * pp)
-    # bf16 param + bf16-ish grad + 2 fp32 moments (+ fp32 master)
-    state_mult = param_bytes + param_bytes + 8 + (4 if master_weights else 0)
+    # bf16 param + bf16-ish grad replicated over dp; 2 fp32 moments
+    # (+ fp32 master) ZeRO-sharded
+    opt_mult = (8 + (4 if master_weights else 0)) / max(sharding, 1)
+    state_mult = param_bytes + param_bytes + opt_mult
     model_bytes = model_shard * state_mult
 
     micro_batch = max(batch_size // dp, 1)

@@ -30,19 +30,41 @@ class AutoTuner:
         self.history: List[dict] = []
 
     def candidates(self) -> List[Plan]:
-        """Pruned candidate list, best-first by the greedy heuristic."""
+        """Pruned candidate list, best-first by the greedy heuristic. The
+        grid covers (dp, mp, pp) × ZeRO sharding ∈ {1, dp} (the reference
+        tuner's sharding_stage dimension); prunes are RECORDED in history —
+        divisibility prunes as 'infeasible', memory-model prunes as 'oom'
+        with the estimate (reference prune.py's audit trail)."""
         from ..auto_parallel.planner import _factorizations
 
+        # fresh audit per call: tune() re-enumerates, so stale prune records
+        # from an earlier candidates() call must not duplicate
+        self.history = [h for h in self.history if "pruned" not in h]
         out = []
         for dp, mp, pp, sep in _factorizations(self.n_devices):
             if sep != 1:
                 continue
             if not feasible(self.spec, self.batch_size, dp, mp, pp, sep):
+                self.history.append({
+                    "plan": {"dp_degree": dp, "mp_degree": mp,
+                             "pp_degree": pp, "sep_degree": sep},
+                    "pruned": "infeasible"})
                 continue
-            mem = estimate_per_device_bytes(self.spec, self.batch_size, dp, mp, pp, sep)
-            if mem > self.hbm_bytes:
-                continue
-            out.append(Plan(dp, mp, pp, sep, per_device_bytes=mem))
+            for sharding in ({1, dp} if dp > 1 else {1}):
+                mem = estimate_per_device_bytes(
+                    self.spec, self.batch_size, dp, mp, pp, sep,
+                    sharding=sharding)
+                plan = Plan(dp, mp, pp, sep, sharding=sharding,
+                            per_device_bytes=mem)
+                if mem > self.hbm_bytes:
+                    self.history.append({
+                        "plan": plan.describe,
+                        "pruned": f"oom: est {mem / 2**30:.2f} GiB "
+                                  f"> {self.hbm_bytes / 2**30:.2f} GiB"})
+                    continue
+                out.append(plan)
+        # prefer plain dp, then fewer pipeline stages, then smaller mp,
+        # then lower memory (sharding enters via the memory term)
         out.sort(key=lambda p: (-p.dp, p.pp, p.mp, p.per_device_bytes))
         return out[: self.max_candidates]
 
@@ -65,16 +87,16 @@ class AutoTuner:
                     step()
                 dt = (time.perf_counter() - t0) / steps
             except Exception as e:  # candidate failed to build/run: prune it
-                self.history.append({"plan": plan.degrees, "error": repr(e)})
+                self.history.append({"plan": plan.describe, "error": repr(e)})
                 continue
-            record = {"plan": plan.degrees, "step_seconds": dt}
+            record = {"plan": plan.describe, "step_seconds": dt}
             train_step = getattr(step, "train_step", None)
             if train_step is not None:
                 try:
                     from ..auto_parallel.planner import calibrate_against_compiled
 
                     record["memory"] = calibrate_against_compiled(
-                        train_step, self.spec, self.batch_size, plan.degrees)
+                        train_step, self.spec, self.batch_size, plan.describe)
                 except Exception as e:
                     record["memory_error"] = repr(e)
             self.history.append(record)
@@ -84,7 +106,8 @@ class AutoTuner:
             # nothing measured — fall back to the static chooser
             return choose_plan(self.spec, self.n_devices, self.batch_size,
                                hbm_bytes=self.hbm_bytes)
-        best.reason = f"measured {best_dt * 1e3:.1f} ms/step over {len(self.history)} candidates"
+        measured = sum(1 for h in self.history if "step_seconds" in h)
+        best.reason = f"measured {best_dt * 1e3:.1f} ms/step over {measured} candidates"
         return best
 
 

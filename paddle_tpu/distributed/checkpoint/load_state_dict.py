@@ -26,20 +26,23 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
     # Resolution is metadata-driven: chunk keys are save-nonce-qualified
-    # (collision-free across saves), and PLAIN keys resolve from the
-    # committed save's coordinator shard first — a stale shard file that GC
-    # has not collected yet can never shadow the committed values.
+    # (collision-free across saves, so merge order is irrelevant for them);
+    # PLAIN keys — written only by the save's coordinator — resolve
+    # EXCLUSIVELY from the committed metadata's coordinator shard, so a
+    # stale uncollected shard (even one left by a save with a different
+    # coordinator rank) can never shadow the committed values.
     shards = {}
     coord = meta.get("coordinator_shard")
     for fname in sorted(os.listdir(path)):
-        if (fname.startswith("shard_") and fname.endswith(".npz")
-                and fname != coord):
+        if fname.startswith("shard_") and fname.endswith(".npz"):
             shards.update(np.load(os.path.join(path, fname)))
     if coord and os.path.exists(os.path.join(path, coord)):
-        shards.update(np.load(os.path.join(path, coord)))  # authoritative last
+        plain = dict(np.load(os.path.join(path, coord)))
+    else:  # pre-coordinator_shard checkpoints: merged view (legacy)
+        plain = shards
     flat = _flatten_state(state_dict)
     entries = meta.get("entries", {})
-    missing = [k for k in flat if k not in shards and not entries.get(k, {}).get("chunks")]
+    missing = [k for k in flat if k not in plain and not entries.get(k, {}).get("chunks")]
     if missing:
         raise KeyError(f"checkpoint at {path} is missing keys: {missing[:5]}{'...' if len(missing) > 5 else ''}")
     for k, t in flat.items():
@@ -50,7 +53,7 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
                 idx = tuple(slice(a, b) for a, b in ck["index"])
                 host[idx] = shards[ck["key"]]
         else:
-            host = shards[k]
+            host = plain[k]
         if list(host.shape) != list(t.shape):
             raise ValueError(f"{k}: checkpoint shape {host.shape} != target {t.shape}")
         try:

@@ -310,9 +310,13 @@ def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
 
     with _discover_reads() as rec:
         ref_out = _run_fn(fns[0])
-        for f in fns[1:]:
-            _run_fn(f)
-        _run_fn(default)
+        ref_def0 = _flatten(ref_out)[1]
+        for f in list(fns[1:]) + [default]:
+            odef = _flatten(_run_fn(f))[1]
+            if odef != ref_def0:
+                raise ValueError(
+                    f"switch_case branches returned different structures: "
+                    f"{odef} vs {ref_def0}")
     captured = list(rec.reads.values()) if recording else []
     ref_leaves, ref_def = _flatten(ref_out)
     ref_dtypes = [jnp.asarray(unwrap(l)).dtype for l in ref_leaves]

@@ -156,28 +156,31 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 
 
 
-def _transpose_ks(v_shape, filter_size, output_size, stride, padding, nd):
+def _transpose_ks(v_shape, filter_size, output_size, stride, padding, nd,
+                  dilation=1):
     """filter_size, or derived from output_size (reference conv*d_transpose:
-    ks = out - (in - 1) * stride + 2 * pad per spatial dim)."""
+    out = (in-1)*stride - 2*pad + dilation*(ks-1) + 1 per spatial dim)."""
     if filter_size is not None:
         return (tuple(filter_size) if isinstance(filter_size, (list, tuple))
                 else (filter_size,) * nd)
     if output_size is None:
         raise ValueError("one of filter_size / output_size is required")
-    outs = (tuple(output_size) if isinstance(output_size, (list, tuple))
-            else (output_size,) * nd)
-    strides = (tuple(stride) if isinstance(stride, (list, tuple))
-               else (stride,) * nd)
-    pads = (tuple(padding) if isinstance(padding, (list, tuple))
-            else (padding,) * nd)
+
+    def tup(x):
+        return tuple(x) if isinstance(x, (list, tuple)) else (x,) * nd
+
+    outs, strides, pads, dils = (tup(output_size), tup(stride), tup(padding),
+                                 tup(dilation))
     ins = v_shape[2:2 + nd]
-    ks = tuple(int(o) - (int(i) - 1) * int(s) + 2 * int(p)
-               for o, i, s, p in zip(outs, ins, strides, pads))
-    if any(k < 1 for k in ks):
-        raise ValueError(
-            f"output_size {outs} unreachable from input {tuple(ins)} with "
-            f"stride {strides} / padding {pads}")
-    return ks
+    ks = []
+    for o, i, s, p, d in zip(outs, ins, strides, pads, dils):
+        span = int(o) - (int(i) - 1) * int(s) + 2 * int(p) - 1
+        if span < 0 or span % int(d):
+            raise ValueError(
+                f"output_size {outs} unreachable from input {tuple(ins)} "
+                f"with stride {strides} / padding {pads} / dilation {dils}")
+        ks.append(span // int(d) + 1)
+    return tuple(ks)
 
 
 def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
@@ -188,7 +191,7 @@ def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
 
     c_in = int(unwrap(input).shape[1])
     ks = _transpose_ks(unwrap(input).shape, filter_size, output_size,
-                       stride, padding, 2)
+                       stride, padding, 2, dilation)
     w = _param((c_in, num_filters // groups, ks[0], ks[1]),
                unwrap(input).dtype)
     out = F.conv2d_transpose(input, w, stride=stride, padding=padding,
@@ -205,7 +208,7 @@ def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
 
     c_in = int(unwrap(input).shape[1])
     ks = _transpose_ks(unwrap(input).shape, filter_size, output_size,
-                       stride, padding, 3)
+                       stride, padding, 3, dilation)
     w = _param((c_in, num_filters // groups, *ks), unwrap(input).dtype)
     out = F.conv3d_transpose(input, w, stride=stride, padding=padding,
                              dilation=dilation, groups=groups,

@@ -110,3 +110,241 @@ class Cifar10(Dataset):
 
     def __len__(self):
         return len(self.images)
+
+
+# ---- folder-tree datasets (reference vision/datasets/folder.py) ------------
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def _pil_loader(path):
+    from PIL import Image
+
+    with Image.open(path) as img:
+        return np.asarray(img.convert("RGB"))
+
+
+def default_loader(path):
+    """reference folder.py::default_loader — image file → HWC uint8 array."""
+    return _pil_loader(path)
+
+
+def _find_classes(root):
+    classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+    if not classes:
+        raise FileNotFoundError(f"no class folders under {root}")
+    return classes, {c: i for i, c in enumerate(classes)}
+
+
+def _make_samples(root, class_to_idx, extensions, is_valid_file):
+    if extensions is not None and is_valid_file is not None:
+        raise ValueError("pass either extensions or is_valid_file, not both")
+    if is_valid_file is None:
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+
+        def is_valid_file(p):
+            return p.lower().endswith(exts)
+
+    samples = []
+    for cls in sorted(class_to_idx):
+        d = os.path.join(root, cls)
+        for sub, _, files in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(files):
+                p = os.path.join(sub, fname)
+                if is_valid_file(p):
+                    samples.append((p, class_to_idx[cls]))
+    if not samples:
+        raise FileNotFoundError(f"no valid files found under {root}")
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory tree → (image, class_index) samples (reference
+    vision/datasets/folder.py::DatasetFolder — how real users feed
+    classification models from disk)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or default_loader
+        self.transform = transform
+        self.classes, self.class_to_idx = _find_classes(root)
+        self.samples = _make_samples(root, self.class_to_idx, extensions,
+                                     is_valid_file)
+        self.targets = [t for _, t in self.samples]
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat image list without labels (reference folder.py::ImageFolder —
+    the inference-input counterpart of DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or default_loader
+        self.transform = transform
+        if is_valid_file is None:
+            exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+
+            def is_valid_file(p):
+                return p.lower().endswith(exts)
+
+        self.samples = []
+        for sub, _, files in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(files):
+                p = os.path.join(sub, fname)
+                if is_valid_file(p):
+                    self.samples.append(p)
+        if not self.samples:
+            raise FileNotFoundError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+
+
+def _worker_tar(ds, path):
+    """Per-(process, thread) TarFile handle: a single shared handle's file
+    offset races under thread workers and is duplicated (shared offset)
+    across fork workers — each worker opens its own."""
+    import threading
+
+    tl = ds.__dict__.get("_tar_local")
+    if tl is None:
+        tl = ds.__dict__["_tar_local"] = threading.local()
+    if getattr(tl, "pid", None) != os.getpid() or getattr(tl, "tar", None) is None:
+        tl.tar = tarfile.open(path)
+        tl.pid = os.getpid()
+    return tl.tar
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference vision/datasets/flowers.py): pass the
+    locally available `102flowers.tgz` (or extracted jpg dir), the
+    `imagelabels.mat` and `setid.mat` files (no network egress here; the
+    reference downloads the same three artifacts)."""
+
+    MODE_KEYS = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, backend=None):
+        if data_file is None or label_file is None or setid_file is None:
+            raise ValueError(
+                "no network egress: Flowers needs local data_file "
+                "(102flowers.tgz or jpg dir), label_file (imagelabels.mat) "
+                "and setid_file (setid.mat)")
+        import scipy.io
+
+        self.transform = transform
+        labels = scipy.io.loadmat(label_file)["labels"].ravel()
+        setid = scipy.io.loadmat(setid_file)
+        key = self.MODE_KEYS.get(mode, mode)
+        self.indexes = setid[key].ravel()  # 1-based image ids
+        self.labels = labels
+        if os.path.isdir(data_file):
+            self._dir = data_file
+            self._tar_path = None
+        else:
+            self._dir = None
+            self._tar_path = data_file
+
+    def _read_image(self, image_id):
+        name = f"image_{image_id:05d}.jpg"
+        if self._dir is not None:
+            for cand in (os.path.join(self._dir, name),
+                         os.path.join(self._dir, "jpg", name)):
+                if os.path.exists(cand):
+                    return _pil_loader(cand)
+            raise FileNotFoundError(name)
+        from PIL import Image
+
+        member = _worker_tar(self, self._tar_path).extractfile(f"jpg/{name}")
+        with Image.open(member) as img:
+            return np.asarray(img.convert("RGB"))
+
+    def __getitem__(self, idx):
+        image_id = int(self.indexes[idx])
+        img = self._read_image(image_id)
+        label = np.int64(self.labels[image_id - 1])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference
+    vision/datasets/voc2012.py): data_file is the local VOCtrainval tar (or
+    an extracted VOCdevkit/VOC2012 directory); yields (image, label mask)."""
+
+    SETS = {"train": "train.txt", "valid": "val.txt", "trainval": "trainval.txt"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend=None):
+        if data_file is None:
+            raise ValueError("no network egress: VOC2012 needs a local "
+                             "data_file (tar or extracted VOC2012 dir)")
+        self.transform = transform
+        listing = self.SETS.get(mode, self.SETS["train"])
+        if os.path.isdir(data_file):
+            self._root = data_file
+            self._tar_path = None
+            seg = os.path.join(data_file, "ImageSets", "Segmentation", listing)
+            with open(seg) as f:
+                self.names = [l.strip() for l in f if l.strip()]
+        else:
+            self._root = None
+            self._tar_path = data_file
+            # index once, then close: __getitem__ resolves members by NAME
+            # through a per-worker handle (a shared TarFile's file offset is
+            # unsafe under thread or fork DataLoader workers)
+            with tarfile.open(data_file) as tar:
+                names = tar.getnames()
+                seg = next(n for n in names
+                           if n.endswith(f"ImageSets/Segmentation/{listing}"))
+                self.names = [l.strip() for l in
+                              tar.extractfile(seg).read().decode().split("\n")
+                              if l.strip()]
+            self._prefix = seg.split("ImageSets")[0]
+
+    def _load(self, rel, gray):
+        from PIL import Image
+
+        if self._root is not None:
+            fh = os.path.join(self._root, rel)
+            with Image.open(fh) as img:
+                return np.asarray(img.convert("L" if gray else "RGB"))
+        member = _worker_tar(self, self._tar_path).extractfile(self._prefix + rel)
+        with Image.open(member) as img:
+            return np.asarray(img.convert("L" if gray else "RGB"))
+
+    def __getitem__(self, idx):
+        name = self.names[idx]
+        img = self._load(f"JPEGImages/{name}.jpg", gray=False)
+        label = self._load(f"SegmentationClass/{name}.png", gray=True)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.names)

@@ -4,6 +4,7 @@ distributed/auto_tuner/)."""
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 
@@ -81,3 +82,90 @@ def test_auto_tuner_prunes_and_measures():
     assert any("error" in h for h in tuner.history) or all(
         h["plan"]["pp_degree"] == 1 for h in tuner.history)
     assert "ms/step" in best.reason
+
+
+@pytest.mark.slow
+def test_auto_tuner_e2e_gpt_8devices():
+    """End-to-end search → memory-prune → measure on the 8-device CPU mesh
+    (VERDICT r4 #7): GPT candidates that exceed the HBM budget are recorded
+    as 'oom'-pruned, survivors run REAL train steps per plan (mesh rebuilt
+    in place), and a valid measured best plan comes back."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, ModelSpec
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny,
+    )
+
+    cfg0 = gpt_tiny()
+    paddle.seed(0)
+    spec = ModelSpec.from_model(GPTForCausalLM(cfg0), seq_len=64)
+    batch = 8
+    # budget chosen so unsharded dp=8 (full optimizer replicated) is pruned
+    # but ZeRO-sharded / model-parallel configs survive
+    unsharded = None
+    from paddle_tpu.distributed.auto_parallel.planner import (
+        estimate_per_device_bytes,
+    )
+
+    unsharded = estimate_per_device_bytes(spec, batch, 8, 1, 1, sharding=1)
+    sharded = estimate_per_device_bytes(spec, batch, 8, 1, 1, sharding=8)
+    assert sharded < unsharded
+    budget = (unsharded + sharded) // 2
+
+    tuner = AutoTuner(spec, n_devices=8, batch_size=batch, hbm_bytes=budget,
+                      max_candidates=2)
+    cands = tuner.candidates()
+    oom = [h for h in tuner.history if "oom" in str(h.get("pruned", ""))]
+    assert oom, tuner.history  # the unsharded dp=8 config was memory-pruned
+    assert any(h["plan"].get("zero_sharding", 1) == 1
+               and h["plan"]["dp_degree"] == 8 for h in oom)
+    assert cands and all(
+        p.per_device_bytes <= budget and p.dp * p.mp * p.pp * p.sep == 8
+        for p in cands)
+
+    def build(plan):
+        # plan.sharding is ZeRO over the dp axis (group_sharded shards over
+        # "dp" when the mesh has no dedicated sharding axis) — the mesh
+        # itself is dp×mp×pp
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": plan.dp, "mp_degree": plan.mp, "pp_degree": plan.pp,
+        }
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        cfg = gpt_tiny(
+            tensor_parallel=(plan.mp > 1),
+            pipeline_parallel=(plan.pp > 1),
+            num_hidden_layers=2 * max(plan.pp, 1),
+            pp_num_microbatches=plan.pp if plan.pp > 1 else 0,
+        )
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        if plan.sharding > 1:
+            from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+            model, opt, _ = group_sharded_parallel(model, opt, level="os_g")
+        train_step = TrainStep(model=model, optimizer=opt,
+                               loss_fn=lambda ids: crit(model(ids), ids))
+        rs = np.random.RandomState(0)
+        ids = paddle.Tensor(
+            rs.randint(0, cfg.vocab_size, (batch, 64)).astype(np.int64),
+            stop_gradient=True)
+
+        def step():
+            float(np.asarray(train_step(ids).numpy()))
+
+        step.train_step = train_step
+        return step
+
+    best = tuner.tune(build, steps=2, warmup=1)
+    measured = [h for h in tuner.history if "step_seconds" in h]
+    assert measured, tuner.history
+    assert "ms/step" in best.reason
+    assert best.per_device_bytes <= budget

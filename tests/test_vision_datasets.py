@@ -1,0 +1,128 @@
+"""Folder-tree / flowers / VOC dataset tests (VERDICT r4 #8; reference
+python/paddle/vision/datasets/{folder,flowers,voc2012}.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision import datasets, transforms
+
+
+def _write_png(path, rgb):
+    from PIL import Image
+
+    Image.fromarray(rgb.astype(np.uint8)).save(path)
+
+
+@pytest.fixture()
+def folder_tree(tmp_path):
+    rs = np.random.RandomState(0)
+    for cls in ("cat", "dog", "owl"):
+        d = tmp_path / "data" / cls
+        d.mkdir(parents=True)
+        for i in range(4):
+            _write_png(str(d / f"{i}.png"), rs.randint(0, 255, (32, 32, 3)))
+    return str(tmp_path / "data")
+
+
+def test_dataset_folder_classes_and_samples(folder_tree):
+    ds = datasets.DatasetFolder(folder_tree)
+    assert ds.classes == ["cat", "dog", "owl"]
+    assert len(ds) == 12
+    img, label = ds[0]
+    assert img.shape == (32, 32, 3) and img.dtype == np.uint8
+    assert label == 0 and ds[11][1] == 2
+
+
+def test_image_folder_flat_listing(folder_tree):
+    ds = datasets.ImageFolder(folder_tree)
+    assert len(ds) == 12
+    (img,) = ds[3]
+    assert img.shape == (32, 32, 3)
+
+
+def test_dataset_folder_to_resnet_train_step(folder_tree):
+    """Folder tree → transforms → DataLoader → ResNet18 train step: loss is
+    finite and decreases over a few steps (the 'how real users feed models'
+    path end-to-end)."""
+    from paddle_tpu.vision.models import resnet18
+
+    tf = transforms.Compose([
+        transforms.Resize(32),
+        transforms.Transpose(),        # HWC -> CHW
+        transforms.Normalize(mean=[127.5] * 3, std=[127.5] * 3),
+    ])
+    ds = datasets.DatasetFolder(folder_tree, transform=tf)
+    # shuffle=False: deterministic batches — this asserts a loss trend on 12
+    # images, which unseeded shuffling makes flaky
+    loader = DataLoader(ds, batch_size=6, shuffle=False, drop_last=True)
+
+    paddle.seed(0)
+    model = resnet18(num_classes=3)
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                    parameters=model.parameters())
+    crit = nn.CrossEntropyLoss()
+    epochs = []
+    for _ in range(6):
+        losses = []
+        for img, label in loader:
+            assert tuple(img.shape) == (6, 3, 32, 32)
+            loss = crit(model(img), label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        epochs.append(float(np.mean(losses)))
+    assert all(np.isfinite(e) for e in epochs), epochs
+    # learning signal through the whole pipeline (generous margin: 12
+    # images, batch 6 — the loss is noisy but must trend down)
+    assert np.mean(epochs[-2:]) < np.mean(epochs[:2]), epochs
+
+
+def test_flowers_from_local_artifacts(tmp_path):
+    import scipy.io
+
+    rs = np.random.RandomState(1)
+    jpg_dir = tmp_path / "jpg"
+    jpg_dir.mkdir()
+    n = 6
+    for i in range(1, n + 1):
+        _write_png(str(jpg_dir / f"image_{i:05d}.jpg"),
+                   rs.randint(0, 255, (20, 20, 3)))
+    scipy.io.savemat(str(tmp_path / "imagelabels.mat"),
+                     {"labels": np.arange(1, n + 1)[None]})
+    scipy.io.savemat(str(tmp_path / "setid.mat"),
+                     {"trnid": np.array([[1, 3, 5]]),
+                      "tstid": np.array([[2, 4]]),
+                      "valid": np.array([[6]])})
+    ds = datasets.Flowers(data_file=str(tmp_path), label_file=str(tmp_path / "imagelabels.mat"),
+                          setid_file=str(tmp_path / "setid.mat"), mode="train")
+    assert len(ds) == 3
+    img, label = ds[1]
+    assert img.shape == (20, 20, 3) and label == 3
+    ds_t = datasets.Flowers(data_file=str(tmp_path), label_file=str(tmp_path / "imagelabels.mat"),
+                            setid_file=str(tmp_path / "setid.mat"), mode="test")
+    assert len(ds_t) == 2 and ds_t[0][1] == 2
+
+
+def test_voc2012_from_extracted_dir(tmp_path):
+    rs = np.random.RandomState(2)
+    root = tmp_path / "VOC2012"
+    (root / "JPEGImages").mkdir(parents=True)
+    (root / "SegmentationClass").mkdir()
+    (root / "ImageSets" / "Segmentation").mkdir(parents=True)
+    names = ["2007_000001", "2007_000002"]
+    for nm in names:
+        _write_png(str(root / "JPEGImages" / f"{nm}.jpg"),
+                   rs.randint(0, 255, (24, 24, 3)))
+        _write_png(str(root / "SegmentationClass" / f"{nm}.png"),
+                   rs.randint(0, 20, (24, 24, 1))[..., 0])
+    with open(root / "ImageSets" / "Segmentation" / "train.txt", "w") as f:
+        f.write("\n".join(names) + "\n")
+    ds = datasets.VOC2012(data_file=str(root), mode="train")
+    assert len(ds) == 2
+    img, mask = ds[0]
+    assert img.shape == (24, 24, 3) and mask.shape == (24, 24)
