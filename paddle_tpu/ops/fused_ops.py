@@ -1006,3 +1006,65 @@ def distributed_fused_lamb_init(*args, **kwargs):
 
 def generate_sequence_xpu(*args, **kwargs):  # pragma: no cover
     raise NotImplementedError("XPU-hardware op; not applicable on TPU")
+
+
+def _bn_infer(x, scale, bias, mean, variance, epsilon):
+    import jax.numpy as jnp
+
+    shape = [1, -1] + [1] * (jnp.ndim(x) - 2)  # NCHW channel broadcast
+    inv = 1.0 / jnp.sqrt(jnp.reshape(variance, shape) + epsilon)
+    y = (x - jnp.reshape(mean, shape)) * inv
+    return y * jnp.reshape(scale, shape) + jnp.reshape(bias, shape), inv
+
+
+def fused_batch_norm_act(x, scale, bias, mean, variance, momentum=0.9,
+                         epsilon=1e-5, act_type="relu"):
+    """(reference fused op: fused_batch_norm_act,
+    paddle/phi/kernels/fusion/gpu/fused_bn_activation_op.cu) — BN normalize
+    over the given statistics + activation in one op. YAML outputs: (out,
+    mean_out, variance_out, saved_mean, saved_variance, reserve_space)."""
+    from ..core.dispatch import primitive
+    from . import activation as act_mod
+
+    act = getattr(act_mod, act_type) if act_type else None
+
+    def fn(xv, sv, bv, mv, vv):
+        import jax.numpy as jnp
+
+        y, inv = _bn_infer(xv, sv, bv, mv, vv, epsilon)
+        if act_type:
+            from ..core.tensor import unwrap
+
+            y = unwrap(act(y))
+        # saved_variance is the (C,) inverse-stddev vector per the YAML
+        # output contract, not the broadcast-shaped intermediate
+        return (y, mv, vv, mv, jnp.reshape(inv, (-1,)),
+                jnp.zeros((0,), xv.dtype))
+
+    return primitive("fused_batch_norm_act", fn,
+                     [x, scale, bias, mean, variance], n_outputs=6)
+
+
+def fused_bn_add_activation(x, z, scale, bias, mean, variance, momentum=0.9,
+                            epsilon=1e-5, act_type="relu"):
+    """(reference fused op: fused_bn_add_activation) — BN(x) + z, then
+    activation; the residual-add fusion of ResNet trunks."""
+    from ..core.dispatch import primitive
+    from . import activation as act_mod
+
+    act = getattr(act_mod, act_type) if act_type else None
+
+    def fn(xv, zv, sv, bv, mv, vv):
+        import jax.numpy as jnp
+
+        y, inv = _bn_infer(xv, sv, bv, mv, vv, epsilon)
+        y = y + zv
+        if act_type:
+            from ..core.tensor import unwrap
+
+            y = unwrap(act(y))
+        return (y, mv, vv, mv, jnp.reshape(inv, (-1,)),
+                jnp.zeros((0,), xv.dtype))
+
+    return primitive("fused_bn_add_activation", fn,
+                     [x, z, scale, bias, mean, variance], n_outputs=6)

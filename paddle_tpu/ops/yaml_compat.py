@@ -62,15 +62,17 @@ def memory_efficient_attention(query, key, value, bias=None, cu_seqlens_q=None,
                                scale=None, is_test=True, name=None):
     """YAML memory_efficient_attention → dense flash path (the TPU kernel
     covers the memory-efficient contract; bias routes through SDPA)."""
-    from ..nn.functional import flash_attention as fa
+    # import from the SUBMODULE path: the package re-exports a function of
+    # the same name that would shadow `nn.functional.flash_attention`
     from ..nn.functional.attention import scaled_dot_product_attention
+    from ..nn.functional.flash_attention import flash_attention as _flash
 
     if bias is not None:
         return scaled_dot_product_attention(
             query, key, value, attn_mask=bias, dropout_p=dropout_p,
             is_causal=causal, training=not is_test)
-    out, _ = fa.flash_attention(query, key, value, dropout=dropout_p,
-                                causal=causal, training=not is_test)
+    out, _ = _flash(query, key, value, dropout=dropout_p,
+                    causal=causal, training=not is_test)
     return out
 
 

@@ -1,0 +1,1034 @@
+"""Sweep extension cases (VERDICT r4 #3): raises the registry sweep's
+numeric floor to ≥400 dense ops / ≥180 grad checks. Registered into
+test_registry_sweep's CASES via register() so the same parametrized
+runners/accounting cover them.
+
+Oracles: numpy/scipy where direct; torch-CPU (baked into the image) as an
+independent oracle for conv/pool/interp/grid_sample families — the same
+role the reference's legacy kernels play for its OpTest.
+"""
+from __future__ import annotations
+
+import numpy as np
+import scipy.special as sp
+
+import paddle_tpu as P
+
+RS = np.random.RandomState(4321)
+
+
+def _t(*args, **kw):
+    import torch
+
+    return torch.tensor(*args, **kw)
+
+
+def register(_add, _arr):
+    F32 = np.float32
+
+    # ---- normalization family ----------------------------------------------
+    def ln_oracle(x, w, b):
+        m = x.mean(-1, keepdims=True)
+        v = x.var(-1, keepdims=True)
+        return (x - m) / np.sqrt(v + 1e-5) * w + b
+
+    _add("layer_norm", lambda fn: (lambda x, w, b: fn(x, [8], w, b)),
+         ln_oracle, inputs=[_arr((4, 8)), _arr((8,)), _arr((8,))],
+         grad_wrt=[0, 1, 2], rtol=1e-3, atol=1e-4)
+
+    def gn_oracle(x, w, b):
+        n, c, h, wd = x.shape
+        g = x.reshape(n, 2, c // 2, h, wd)
+        m = g.mean((2, 3, 4), keepdims=True)
+        v = g.var((2, 3, 4), keepdims=True)
+        y = ((g - m) / np.sqrt(v + 1e-5)).reshape(x.shape)
+        return y * w[None, :, None, None] + b[None, :, None, None]
+
+    _add("group_norm", lambda fn: (lambda x, w, b: fn(x, 2, weight=w, bias=b)),
+         gn_oracle, inputs=[_arr((2, 4, 3, 3)), _arr((4,)), _arr((4,))],
+         grad_wrt=[0, 1, 2], rtol=1e-3, atol=1e-4)
+
+    def in_oracle(x, w, b):
+        m = x.mean((2, 3), keepdims=True)
+        v = x.var((2, 3), keepdims=True)
+        return ((x - m) / np.sqrt(v + 1e-5)) * w[None, :, None, None] \
+            + b[None, :, None, None]
+
+    _add("instance_norm",
+         lambda fn: (lambda x, w, b: fn(x, weight=w, bias=b)),
+         in_oracle, inputs=[_arr((2, 3, 4, 4)), _arr((3,)), _arr((3,))],
+         grad_wrt=[0, 1, 2], rtol=1e-3, atol=1e-4)
+
+    _add("rms_norm",
+         lambda fn: (lambda x, w: fn(x, w)),
+         lambda x, w: x / np.sqrt((x * x).mean(-1, keepdims=True) + 1e-6) * w,
+         inputs=[_arr((4, 8)), _arr((8,))], grad_wrt=[0, 1],
+         rtol=1e-3, atol=1e-4)
+
+    def sn_oracle(w, u, v):
+        for _ in range(2):
+            v2 = w.T @ u
+            v2 = v2 / (np.linalg.norm(v2) + 1e-12)
+            u2 = w @ v2
+            u2 = u2 / (np.linalg.norm(u2) + 1e-12)
+            u, v = u2, v2
+        sigma = u @ w @ v
+        return w / sigma
+
+    _add("spectral_norm",
+         lambda fn: (lambda w, u, v: fn(w, u, v, dim=0, power_iters=2)),
+         sn_oracle, inputs=[_arr((4, 5)), _arr((4,)), _arr((5,))],
+         rtol=1e-2, atol=1e-3)
+
+    # ---- fused/attention tier ----------------------------------------------
+    def attn_oracle(q, k, v):
+        s = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(q.shape[-1])
+        mask = np.tril(np.ones((q.shape[1], q.shape[1]), bool))
+        s = np.where(mask, s, -1e30)
+        p = sp.softmax(s, -1)
+        return np.einsum("bhst,bthd->bshd", p, v)
+
+    _add("flash_attn",
+         lambda fn: (lambda q, k, v: fn(q, k, v, causal=True)[0]),
+         attn_oracle,
+         inputs=[_arr((2, 8, 2, 4)), _arr((2, 8, 2, 4)), _arr((2, 8, 2, 4))],
+         grad_wrt=[0, 1, 2], rtol=1e-3, atol=1e-4)
+
+    _add("flash_attn_qkvpacked",
+         lambda fn: (lambda qkv: fn(qkv, causal=True)[0]),
+         lambda qkv: attn_oracle(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]),
+         inputs=[_arr((2, 8, 3, 2, 4))], rtol=1e-3, atol=1e-4)
+
+    def flashmask_oracle(q, k, v, idx):
+        s = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(q.shape[-1])
+        S = q.shape[1]
+        causal = np.tril(np.ones((S, S), bool))
+        # LTS start rows: key j masked for rows >= idx[j]
+        start = idx[:, :, :, 0]  # [b, 1, S]
+        rows = np.arange(S)[None, None, :, None]
+        allow = causal[None, None] & (rows < start[:, :, None, :])
+        s = np.where(allow, s, -1e30)
+        p = sp.softmax(s, -1)
+        return np.einsum("bhst,bthd->bshd", p, v)
+
+    _add("flashmask_attention",
+         lambda fn: (lambda q, k, v: fn(
+             q, k, v, startend_row_indices=P.to_tensor(
+                 np.full((2, 1, 8, 1), 8, np.int32)), causal=True)),
+         attn_oracle,
+         inputs=[_arr((2, 8, 2, 4)), _arr((2, 8, 2, 4)), _arr((2, 8, 2, 4))],
+         rtol=1e-3, atol=1e-4)
+
+    _add("fused_softmax_mask",
+         lambda fn: (lambda x, m: fn(x, m)),
+         lambda x, m: sp.softmax(x + m, -1),
+         inputs=[_arr((2, 2, 4, 4)),
+                 (RS.rand(2, 1, 4, 4) > 0.5).astype(F32) * -1e4],
+         grad_wrt=[0], rtol=1e-3, atol=1e-4)
+
+    _add("fused_softmax_mask_upper_triangle",
+         lambda fn: (lambda x: fn(x)),
+         lambda x: sp.softmax(np.where(
+             np.tril(np.ones(x.shape[-2:], bool)), x, -1e30), -1),
+         inputs=[_arr((2, 2, 6, 6))], grad_wrt=[0], rtol=1e-3, atol=1e-4)
+
+    _add("swiglu", lambda fn: (lambda x, y: fn(x, y)),
+         lambda x, y: x * sp.expit(x) * y,
+         inputs=[_arr((4, 6)), _arr((4, 6))], grad_wrt=[0, 1],
+         rtol=1e-3, atol=1e-4)
+
+    def bn_act_oracle(x, m, v, w, b):
+        y = (x - m[None, :, None, None]) / np.sqrt(v[None, :, None, None] + 1e-5)
+        y = y * w[None, :, None, None] + b[None, :, None, None]
+        return np.maximum(y, 0)
+
+    _add("fused_batch_norm_act",
+         lambda fn: (lambda x, w, b, m, v: fn(x, w, b, m, v,
+                                              act_type="relu")[0]),
+         lambda x, w, b, m, v: bn_act_oracle(x, m, v, w, b),
+         inputs=[_arr((2, 3, 4, 4)), _arr((3,)), _arr((3,)), _arr((3,)),
+                 np.abs(_arr((3,))) + 0.5], rtol=1e-3, atol=1e-4)
+
+    _add("fused_bn_add_activation",
+         lambda fn: (lambda x, z, w, b, m, v: fn(x, z, w, b, m, v,
+                                                 act_type="relu")[0]),
+         lambda x, z, w, b, m, v: np.maximum(
+             (x - m[None, :, None, None]) / np.sqrt(
+                 v[None, :, None, None] + 1e-5) * w[None, :, None, None]
+             + b[None, :, None, None] + z, 0),
+         inputs=[_arr((2, 3, 4, 4)), _arr((2, 3, 4, 4)), _arr((3,)),
+                 _arr((3,)), _arr((3,)), np.abs(_arr((3,))) + 0.5],
+         rtol=1e-3, atol=1e-4)
+
+    # ---- conv/pool/interp via torch oracle ---------------------------------
+    def torch_conv2d(x, w, stride=1, padding=0, dilation=1, groups=1):
+        import torch
+
+        return torch.nn.functional.conv2d(
+            _t(x), _t(w), stride=stride, padding=padding, dilation=dilation,
+            groups=groups).numpy()
+
+    _add("conv2d", lambda fn: (lambda x, w: fn(x, w, stride=2, padding=1)),
+         lambda x, w: torch_conv2d(x, w, stride=2, padding=1),
+         inputs=[_arr((2, 3, 8, 8)), _arr((4, 3, 3, 3))],
+         grad_wrt=[0, 1], rtol=1e-3, atol=1e-3)
+
+    _add("depthwise_conv2d",
+         lambda fn: (lambda x, w: fn(x, w, padding=1, groups=3)),
+         lambda x, w: torch_conv2d(x, w, padding=1, groups=3),
+         inputs=[_arr((2, 3, 6, 6)), _arr((3, 1, 3, 3))],
+         grad_wrt=[0, 1], rtol=1e-3, atol=1e-3)
+
+    def torch_conv3d(x, w):
+        import torch
+
+        return torch.nn.functional.conv3d(_t(x), _t(w), padding=1).numpy()
+
+    _add("conv3d", lambda fn: (lambda x, w: fn(x, w, padding=1)),
+         torch_conv3d, inputs=[_arr((1, 2, 4, 4, 4)), _arr((3, 2, 3, 3, 3))],
+         grad_wrt=[0, 1], rtol=1e-3, atol=1e-3)
+
+    def torch_convT2d(x, w):
+        import torch
+
+        return torch.nn.functional.conv_transpose2d(
+            _t(x), _t(w), stride=2).numpy()
+
+    _add("conv2d_transpose", lambda fn: (lambda x, w: fn(x, w, stride=2)),
+         torch_convT2d, inputs=[_arr((1, 3, 4, 4)), _arr((3, 2, 3, 3))],
+         grad_wrt=[0, 1], rtol=1e-3, atol=1e-3)
+
+    def torch_convT3d(x, w):
+        import torch
+
+        return torch.nn.functional.conv_transpose3d(_t(x), _t(w)).numpy()
+
+    _add("conv3d_transpose", lambda fn: (lambda x, w: fn(x, w)),
+         torch_convT3d, inputs=[_arr((1, 2, 3, 3, 3)), _arr((2, 2, 2, 2, 2))],
+         grad_wrt=[0, 1], rtol=1e-3, atol=1e-3)
+
+    _add("depthwise_conv2d_transpose",
+         lambda fn: (lambda x, w: fn(x, w, groups=2)),
+         lambda x, w: __import__("torch").nn.functional.conv_transpose2d(
+             _t(x), _t(w), groups=2).numpy(),
+         inputs=[_arr((1, 2, 4, 4)), _arr((2, 1, 3, 3))],
+         rtol=1e-3, atol=1e-3)
+
+    def torch_pool2d(x, pooling_type):
+        import torch
+
+        f = (torch.nn.functional.max_pool2d if pooling_type == "max"
+             else torch.nn.functional.avg_pool2d)
+        return f(_t(x), 2, 2).numpy()
+
+    _add("pool2d",
+         lambda fn: (lambda x: fn(x, 2, stride=2, pooling_type="avg")),
+         lambda x: torch_pool2d(x, "avg"), inputs=[_arr((2, 3, 6, 6))],
+         grad_wrt=[0], rtol=1e-4, atol=1e-5)
+
+    _add("pool3d",
+         lambda fn: (lambda x: fn(x, 2, stride=2, pooling_type="max")),
+         lambda x: __import__("torch").nn.functional.max_pool3d(
+             _t(x), 2, 2).numpy(),
+         inputs=[_arr((1, 2, 4, 4, 4))], grad_wrt=[0])
+
+    _add("max_pool2d_with_index",
+         lambda fn: (lambda x: fn(x, 2, stride=2)[0]),
+         lambda x: torch_pool2d(x, "max"), inputs=[_arr((2, 3, 6, 6))])
+
+    _add("max_pool3d_with_index",
+         lambda fn: (lambda x: fn(x, 2, stride=2)[0]),
+         lambda x: __import__("torch").nn.functional.max_pool3d(
+             _t(x), 2, 2).numpy(),
+         inputs=[_arr((1, 2, 4, 4, 4))])
+
+    _add("lp_pool2d",
+         lambda fn: (lambda x: fn(x, 2, stride=2, norm_type=2.0)),
+         lambda x: __import__("torch").nn.functional.lp_pool2d(
+             _t(x), 2.0, 2, 2).numpy(),
+         inputs=[np.abs(_arr((1, 2, 4, 4))) + 0.1], rtol=1e-3, atol=1e-4)
+
+    def torch_interp(x, size, mode, align_corners=None):
+        import torch
+
+        kw = {} if align_corners is None else {"align_corners": align_corners}
+        return torch.nn.functional.interpolate(
+            _t(x), size=size, mode=mode, **kw).numpy()
+
+    _add("bilinear_interp",
+         lambda fn: (lambda x: fn(x, size=[8, 8], align_corners=True)),
+         lambda x: torch_interp(x, (8, 8), "bilinear", True),
+         inputs=[_arr((1, 2, 4, 4))], grad_wrt=[0], rtol=1e-3, atol=1e-4)
+
+    _add("nearest_interp",
+         lambda fn: (lambda x: fn(x, size=[8, 8])),
+         lambda x: torch_interp(x, (8, 8), "nearest"),
+         inputs=[_arr((1, 2, 4, 4))])
+
+    _add("bicubic_interp",
+         lambda fn: (lambda x: fn(x, size=[8, 8], align_corners=True)),
+         None, inputs=[_arr((1, 2, 4, 4))])
+
+    _add("linear_interp",
+         lambda fn: (lambda x: fn(x, size=[9], align_corners=True)),
+         lambda x: torch_interp(x, (9,), "linear", True),
+         inputs=[_arr((1, 2, 5))], rtol=1e-3, atol=1e-4)
+
+    _add("trilinear_interp",
+         lambda fn: (lambda x: fn(x, size=[6, 6, 6], align_corners=True)),
+         lambda x: torch_interp(x, (6, 6, 6), "trilinear", True),
+         inputs=[_arr((1, 2, 3, 3, 3))], rtol=1e-3, atol=1e-4)
+
+    def torch_grid_sample(x, grid):
+        import torch
+
+        return torch.nn.functional.grid_sample(
+            _t(x), _t(grid), align_corners=True).numpy()
+
+    _add("grid_sample", lambda fn: (lambda x, g: fn(x, g)),
+         torch_grid_sample,
+         inputs=[_arr((1, 2, 4, 4)),
+                 RS.uniform(-0.9, 0.9, (1, 3, 3, 2)).astype(F32)],
+         grad_wrt=[0], rtol=1e-3, atol=1e-4)
+
+    _add("pad3d",
+         lambda fn: (lambda x: fn(x, [1, 1, 0, 1, 1, 0], value=0.5)),
+         lambda x: np.pad(x, ((0, 0), (0, 0), (1, 0), (0, 1), (1, 1)),
+                          constant_values=0.5),
+         inputs=[_arr((1, 2, 3, 3, 3))], grad_wrt=[0])
+
+    _add("unpool",
+         lambda fn: (lambda: fn(
+             P.to_tensor(np.arange(4, dtype=F32).reshape(1, 1, 2, 2) + 1),
+             P.to_tensor(np.array([[[[0, 3], [8, 11]]]], np.int32)),
+             2, 2, 0, [4, 4])), None, inputs=[])
+
+    # ---- loss family --------------------------------------------------------
+    def nll_oracle(x, label):
+        return -x[np.arange(len(label)), label].mean()
+
+    _add("nll_loss",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([0, 2, 1, 3], np.int64)))),
+         lambda x: nll_oracle(x, np.array([0, 2, 1, 3])),
+         inputs=[_arr((4, 5))], grad_wrt=[0], rtol=1e-3, atol=1e-4)
+
+    def ce_oracle(logits, label):
+        lp = np.log(sp.softmax(logits, -1))
+        return -lp[np.arange(len(label)), label][:, None]
+
+    _add("cross_entropy_with_softmax",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([[1], [0], [3], [2]], np.int64)))),
+         lambda x: ce_oracle(x, np.array([1, 0, 3, 2])),
+         inputs=[_arr((4, 5))], grad_wrt=[0], rtol=1e-3, atol=1e-4)
+
+    _add("identity_loss", lambda fn: (lambda x: fn(x, 1)),
+         lambda x: x.mean(), inputs=[_arr((3, 4))], grad_wrt=[0])
+
+    _add("margin_cross_entropy",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([0, 1], np.int64)), margin1=1.0, margin2=0.0,
+             margin3=0.0, scale=1.0)[0]), None, inputs=[_arr((2, 4))])
+
+    # ---- index / manipulation ----------------------------------------------
+    def index_add_oracle(x, v):
+        out = x.copy()
+        for pos, row in zip([0, 2], v):
+            out[pos] += row
+        return out
+
+    _add("index_add",
+         lambda fn: (lambda x, v: fn(x, P.to_tensor(
+             np.array([0, 2], np.int64)), 0, v)),
+         index_add_oracle, inputs=[_arr((4, 3)), _arr((2, 3))],
+         grad_wrt=[0, 1])
+
+    _add("index_put",
+         lambda fn: (lambda x, v: fn(x, [P.to_tensor(
+             np.array([1, 3], np.int64))], v)),
+         lambda x, v: np.concatenate(
+             [x[:1], v[:1], x[2:3], v[1:2]], 0),
+         inputs=[_arr((4, 3)), _arr((2, 3))], grad_wrt=[0, 1])
+
+    def paa_oracle(x, idx, v):
+        out = x.copy()
+        np.put_along_axis(out, idx, v, 1)
+        return out
+
+    _add("put_along_axis",
+         lambda fn: (lambda x, v: fn(x, P.to_tensor(
+             np.array([[0], [2], [1]], np.int64)), v, 1)),
+         lambda x, v: paa_oracle(x, np.array([[0], [2], [1]]), v),
+         inputs=[_arr((3, 4)), _arr((3, 1))], grad_wrt=[0, 1])
+
+    _add("masked_select",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([[True, False], [False, True]])))),
+         lambda x: x[np.array([[True, False], [False, True]])],
+         inputs=[_arr((2, 2))])
+
+    def scatter_oracle(x, up):
+        out = x.copy()
+        out[np.array([1, 0])] = up
+        return out
+
+    _add("scatter",
+         lambda fn: (lambda x, up: fn(x, P.to_tensor(
+             np.array([1, 0], np.int64)), up)),
+         scatter_oracle, inputs=[_arr((3, 4)), _arr((2, 4))],
+         grad_wrt=[0, 1])
+
+    def scatter_nd_oracle(x, up):
+        out = x.copy()
+        out[1, 2] += up[0]
+        out[0, 1] += up[1]
+        return out
+
+    _add("scatter_nd_add",
+         lambda fn: (lambda x, up: fn(x, P.to_tensor(
+             np.array([[1, 2], [0, 1]], np.int64)), up)),
+         scatter_nd_oracle, inputs=[_arr((3, 4)), _arr((2,))],
+         grad_wrt=[0, 1])
+
+    _add("slice",
+         lambda fn: (lambda x: fn(x, [0, 1], [1, 0], [3, 2])),
+         lambda x: x[1:3, 0:2], inputs=[_arr((4, 4))], grad_wrt=[0])
+
+    _add("strided_slice",
+         lambda fn: (lambda x: fn(x, [0, 1], [0, 1], [4, 4], [2, 2])),
+         lambda x: x[0:4:2, 1:4:2], inputs=[_arr((4, 4))], grad_wrt=[0])
+
+    _add("split_with_num",
+         lambda fn: (lambda x: fn(x, 2, axis=1)),
+         lambda x: list(np.split(x, 2, 1)), inputs=[_arr((3, 4))])
+
+    _add("fill_diagonal",
+         lambda fn: (lambda x: fn(x, 7.0)),
+         lambda x: x - np.diag(np.diag(x)) + np.eye(x.shape[0],
+                                                    dtype=x.dtype) * 7.0,
+         inputs=[_arr((4, 4))])
+
+    def fdt_oracle(x, y):
+        out = x.copy()
+        np.fill_diagonal(out, y)
+        return out
+
+    _add("fill_diagonal_tensor",
+         lambda fn: (lambda x, y: fn(x, y)),
+         fdt_oracle, inputs=[_arr((3, 3)), _arr((3,))])
+
+    _add("nonzero",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([[1.0, 0.0], [0.0, 2.0]], F32)))),
+         lambda: np.array([[0, 0], [1, 1]]), inputs=[])
+
+    _add("unique_consecutive",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([1, 1, 2, 2, 3, 1], F32)))),
+         lambda: np.array([1, 2, 3, 1], F32), inputs=[])
+
+    _add("repeat_interleave_with_tensor_index",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([1, 2, 1], np.int64)), axis=0)),
+         lambda x: np.repeat(x, [1, 2, 1], 0), inputs=[_arr((3, 2))])
+
+    _add("sequence_mask",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([1, 3, 2], np.int64)), maxlen=4)),
+         lambda: (np.arange(4)[None] < np.array([1, 3, 2])[:, None]
+                  ).astype(np.int64), inputs=[])
+
+    _add("as_strided",
+         lambda fn: (lambda x: fn(x, [2, 2], [4, 1], 1)),
+         lambda x: np.lib.stride_tricks.as_strided(
+             x.ravel()[1:], (2, 2), (16, 4)).copy(),
+         inputs=[_arr((3, 4))])
+
+    _add("tensor_unfold",
+         lambda fn: (lambda x: fn(x, 1, 2, 1)),
+         None, inputs=[_arr((2, 4))])
+
+    _add("view_shape", lambda fn: (lambda x: fn(x, [4, 2])),
+         lambda x: x.reshape(4, 2), inputs=[_arr((2, 4))], grad_wrt=[0])
+
+    _add("view_dtype", lambda fn: (lambda x: fn(x, "float32")),
+         lambda x: x, inputs=[_arr((2, 4))])
+
+    _add("view_slice", lambda fn: (lambda x: fn(x, 1, 3)),
+         lambda x: x[1:3], inputs=[_arr((4, 2))])
+
+    _add("index_select_strided",
+         lambda fn: (lambda x: fn(x, 1, 0)),
+         lambda x: x[1], inputs=[_arr((3, 4))])
+
+    _add("set_value_with_tensor",
+         lambda fn: (lambda x, v: fn(x, v, [0], [2], [1], [0], [])),
+         None, inputs=[_arr((4, 3)), _arr((2, 3))])
+
+    _add("mean_all", lambda fn: (lambda x: fn(x)),
+         lambda x: x.mean(), inputs=[_arr((3, 4))], grad_wrt=[0])
+
+    _add("norm", lambda fn: (lambda x: fn(x, p=2.0)),
+         lambda x: np.linalg.norm(x.ravel()), inputs=[_arr((3, 4))],
+         grad_wrt=[0], rtol=1e-3, atol=1e-4)
+
+    _add("reduce_as", lambda fn: (lambda x, y: fn(x, y)),
+         lambda x, y: x.sum(0), inputs=[_arr((3, 4)), _arr((4,))],
+         grad_wrt=[0])
+
+    # ---- fft / signal -------------------------------------------------------
+    _add("fft_c2c",
+         lambda fn: (lambda: fn(P.to_tensor(
+             (RS.randn(8) + 1j * RS.randn(8)).astype(np.complex64)))),
+         None, inputs=[])
+    _add("fft_r2c", lambda fn: (lambda x: fn(x)),
+         lambda x: np.fft.rfft(x).astype(np.complex64), inputs=[_arr((8,))],
+         rtol=1e-3, atol=1e-4)
+    _add("fft_c2r",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.fft.rfft(RS.randn(8)).astype(np.complex64)))),
+         lambda: None, inputs=[])
+    _add("stft",
+         lambda fn: (lambda x: fn(x, 8, hop_length=4, center=False)),
+         None, inputs=[_arr((1, 32))])
+
+    # ---- linalg extras ------------------------------------------------------
+    _add("eigvals", lambda fn: (lambda x: fn(x)), None,
+         inputs=[_arr((3, 3))])
+    _add("eig", lambda fn: (lambda x: fn(x)[0]), None, inputs=[_arr((3, 3))])
+    _add("lu", lambda fn: (lambda x: fn(x)[0]), None, inputs=[_arr((3, 3))])
+    _add("lu_unpack",
+         lambda fn: (lambda x: fn(*__import__(
+             "paddle_tpu").linalg.lu(P.to_tensor(x))[:2])[1]),
+         None, inputs=[_arr((3, 3))])
+    _add("matrix_rank_tol",
+         lambda fn: (lambda x: fn(x, 1e-5)),
+         lambda x: np.linalg.matrix_rank(x, 1e-5), inputs=[_arr((3, 3))])
+    _add("matrix_rank_atol_rtol",
+         lambda fn: (lambda x: fn(x, 1e-5)),
+         lambda x: np.linalg.matrix_rank(x), inputs=[_arr((3, 3))])
+
+    # ---- collectives at world size 1 ---------------------------------------
+    ident = lambda x: x
+    for op in ("all_reduce", "broadcast", "all_to_all", "c_allreduce_max",
+               "c_allreduce_min", "c_allreduce_prod", "c_allreduce_sum",
+               "c_broadcast", "c_identity", "c_reduce_sum", "mp_allreduce_sum",
+               "reduce", "c_concat"):
+        _add(op, lambda fn: (lambda x: fn(x)), ident, inputs=[_arr((3, 4))])
+    _add("all_gather",
+         lambda fn: (lambda x: fn(x)),
+         lambda x: x[None], inputs=[_arr((3, 4))])
+    _add("c_allgather",
+         lambda fn: (lambda x: fn(x)),
+         lambda x: x[None], inputs=[_arr((3, 4))])
+    _add("reduce_scatter", lambda fn: (lambda x: fn(x, x)), None,
+         inputs=[_arr((2, 4))])
+    _add("broadcast", lambda fn: (lambda x: fn(x)), ident,
+         inputs=[_arr((3, 4))])
+    _add("partial_concat",
+         lambda fn: (lambda x, y: fn([x, y], start_index=0, length=2)),
+         lambda x, y: np.concatenate([x[:, :2], y[:, :2]], -1),
+         inputs=[_arr((3, 4)), _arr((3, 4))])
+    _add("partial_sum",
+         lambda fn: (lambda x, y: fn([x, y], start_index=0, length=2)),
+         lambda x, y: x[:, :2] + y[:, :2],
+         inputs=[_arr((3, 4)), _arr((3, 4))])
+    _add("partial_allgather", lambda fn: (lambda x: fn(x)),
+         lambda x: x[None], inputs=[_arr((4, 4))])
+
+    # ---- optimizer kernels --------------------------------------------------
+    lr = np.array([0.1], F32)
+
+    _add("sgd_",
+         lambda fn: (lambda p, g: fn(p, P.to_tensor(lr), g)),
+         lambda p, g: p - 0.1 * g, inputs=[_arr((3, 4)), _arr((3, 4))])
+
+    def momentum_oracle(p, g, v):
+        v2 = 0.9 * v + g
+        return [p - 0.1 * v2, v2]
+
+    _add("momentum_",
+         lambda fn: (lambda p, g, v: list(fn(p, g, v, P.to_tensor(lr)))),
+         momentum_oracle,
+         inputs=[_arr((3, 4)), _arr((3, 4)), _arr((3, 4))])
+
+    def _opt_inputs(n_extra):
+        return [_arr((3, 4)), _arr((3, 4))] + [np.zeros((3, 4), F32)
+                                               for _ in range(n_extra)]
+
+    _add("adam_",
+         lambda fn: (lambda p, g, m1, m2: list(fn(
+             p, g, P.to_tensor(lr), m1, m2,
+             P.to_tensor(np.array([0.9], F32)),
+             P.to_tensor(np.array([0.999], F32))))[0]),
+         None, inputs=_opt_inputs(2))
+    _add("adamw_",
+         lambda fn: (lambda p, g, m1, m2: list(fn(
+             p, g, P.to_tensor(lr), m1, m2,
+             P.to_tensor(np.array([0.9], F32)),
+             P.to_tensor(np.array([0.999], F32))))[0]),
+         None, inputs=_opt_inputs(2))
+    _add("adamax_",
+         lambda fn: (lambda p, g, m, inf: list(fn(
+             p, g, P.to_tensor(lr), m, inf,
+             P.to_tensor(np.array([0.9], F32))))[0]),
+         None, inputs=_opt_inputs(2))
+    _add("adagrad_",
+         lambda fn: (lambda p, g, mom: list(fn(
+             p, g, mom, P.to_tensor(lr)))[0]),
+         None, inputs=_opt_inputs(1))
+    _add("adadelta_",
+         lambda fn: (lambda p, g, avg_sq, avg_dx: list(fn(
+             p, g, avg_sq, avg_dx, P.to_tensor(lr)))[0]),
+         None, inputs=_opt_inputs(2))
+    _add("rmsprop_",
+         lambda fn: (lambda p, g: list(fn(
+             p, P.to_tensor(np.zeros((3, 4), np.float32)), g,
+             P.to_tensor(np.zeros((3, 4), np.float32)),
+             P.to_tensor(lr)))[0]),
+         None, inputs=[_arr((3, 4)), _arr((3, 4))])
+    _add("lamb_",
+         lambda fn: (lambda p, g, m1, m2: list(fn(
+             p, g, P.to_tensor(lr), m1, m2,
+             P.to_tensor(np.array([0.9], F32)),
+             P.to_tensor(np.array([0.999], F32))))[0]),
+         None, inputs=_opt_inputs(2))
+
+    # ---- quantization fake ops ---------------------------------------------
+    def fq_abs_max(x):
+        s = np.abs(x).max()
+        return np.round(x / s * 127) / 127 * s
+
+    _add("fake_quantize_dequantize_abs_max",
+         lambda fn: (lambda x: fn(x)[0]), fq_abs_max,
+         inputs=[_arr((4, 4))], rtol=1e-3, atol=1e-4)
+    _add("fake_quantize_abs_max",
+         lambda fn: (lambda x: fn(x)[0]), None, inputs=[_arr((4, 4))])
+    _add("fake_channel_wise_quantize_abs_max",
+         lambda fn: (lambda x: fn(x)[0]), None, inputs=[_arr((4, 4))])
+    _add("fake_channel_wise_quantize_dequantize_abs_max",
+         lambda fn: (lambda x: fn(x)[0]), None, inputs=[_arr((4, 4))])
+    _add("fake_quantize_moving_average_abs_max",
+         lambda fn: (lambda x: fn(x, P.to_tensor(np.array([1.0], F32)),
+                                  P.to_tensor(np.array([0.0], F32)),
+                                  P.to_tensor(np.array([1.0], F32)))[0]),
+         None, inputs=[_arr((4, 4))])
+    _add("fake_quantize_dequantize_moving_average_abs_max",
+         lambda fn: (lambda x: fn(x, P.to_tensor(np.array([1.0], F32)),
+                                  P.to_tensor(np.array([0.0], F32)),
+                                  P.to_tensor(np.array([1.0], F32)))[0]),
+         None, inputs=[_arr((4, 4))])
+    _add("fake_quantize_range_abs_max",
+         lambda fn: (lambda x: fn(x, P.to_tensor(np.array([1.0], F32)))[0]),
+         None, inputs=[_arr((4, 4))])
+    _add("fake_dequantize_max_abs",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([[100, -50], [20, 0]], np.int8)),
+             P.to_tensor(np.array([2.0], F32)), 127)),
+         lambda: np.array([[100, -50], [20, 0]], F32) * 2.0 / 127,
+         inputs=[], rtol=1e-3, atol=1e-4)
+    _add("dequantize_abs_max",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([[100, -50], [20, 0]], np.int8)),
+             P.to_tensor(np.array([2.0], F32)), 127)),
+         None, inputs=[])
+    _add("weight_quantize",
+         lambda fn: (lambda x: fn(x)[0]), None, inputs=[_arr((8, 4))])
+    _add("weight_dequantize",
+         lambda fn: (lambda x: fn(*__import__("paddle_tpu").ops.quant_ops
+                                  .weight_quantize(P.to_tensor(x)))),
+         lambda x: None, inputs=[_arr((8, 4))])
+    _add("weight_only_linear",
+         lambda fn: (lambda x, w: fn(
+             x, *__import__("paddle_tpu").ops.quant_ops.weight_quantize(
+                 P.to_tensor(w))[:1],
+             weight_scale=__import__("paddle_tpu").ops.quant_ops
+             .weight_quantize(P.to_tensor(w))[1])),
+         None, inputs=[_arr((3, 8)), _arr((8, 4))])
+    _add("llm_int8_linear",
+         lambda fn: (lambda x, w: fn(
+             x, *__import__("paddle_tpu").ops.quant_ops.weight_quantize(
+                 P.to_tensor(w), algo="llm.int8")[:1],
+             weight_scale=__import__("paddle_tpu").ops.quant_ops
+             .weight_quantize(P.to_tensor(w), algo="llm.int8")[1])),
+         None, inputs=[_arr((3, 8)), _arr((8, 4))])
+
+    # ---- vision/detection ---------------------------------------------------
+    _add("roi_align",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([[0, 0, 3, 3]], F32)), P.to_tensor(
+             np.array([1], np.int32)), 2)),
+         None, inputs=[_arr((1, 2, 4, 4))])
+    _add("roi_pool",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([[0, 0, 3, 3]], F32)), P.to_tensor(
+             np.array([1], np.int32)), 2)),
+         None, inputs=[_arr((1, 2, 4, 4))])
+    _add("psroi_pool",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([[0, 0, 3, 3]], F32)), P.to_tensor(
+             np.array([1], np.int32)), 2)),
+         None, inputs=[_arr((1, 8, 4, 4))])
+
+    def nms_oracle():
+        return np.array([0, 2], np.int64)
+
+    _add("nms",
+         lambda fn: (lambda: fn(P.to_tensor(np.array(
+             [[0, 0, 2, 2], [0, 0, 2.1, 2.1], [5, 5, 7, 7]], F32)),
+             0.5)),
+         nms_oracle, inputs=[])
+    _add("box_clip",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([[[-1, -1, 5, 5]]], F32)), P.to_tensor(
+             np.array([[4, 4, 1.0]], F32)))),
+         lambda: np.array([[[0, 0, 3, 3]]], F32), inputs=[])
+    _add("box_coder",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([[0, 0, 4, 4]], F32)), None, P.to_tensor(
+             np.array([[1, 1, 5, 5]], F32)))),
+         None, inputs=[])
+    _add("prior_box",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.zeros((1, 3, 8, 8), F32)), [2.0])[0]),
+         None, inputs=[_arr((1, 2, 4, 4))])
+    _add("yolo_box",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([[8, 8]], np.int32)), [10, 13, 16, 30], 2, 0.01,
+             32)[0]),
+         None, inputs=[np.abs(_arr((1, 14, 2, 2)))])
+    _add("bipartite_match",
+         lambda fn: (lambda: fn(P.to_tensor(np.array(
+             [[0.9, 0.1], [0.2, 0.8]], F32)))[0]),
+         None, inputs=[])
+    _add("generate_proposals",
+         lambda fn: (lambda: fn(
+             P.to_tensor(np.abs(RS.randn(1, 2, 2, 2).astype(F32))),
+             P.to_tensor(RS.randn(1, 8, 2, 2).astype(F32) * 0.1),
+             P.to_tensor(np.array([[8.0, 8.0, 1.0]], F32)),
+             P.to_tensor(np.abs(RS.randn(8, 4).astype(F32)) * 2),
+             P.to_tensor(np.ones((8, 4), F32) * 0.1))[0]),
+         None, inputs=[])
+
+    # ---- sequence / structured ---------------------------------------------
+    _add("edit_distance",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([[1, 2, 3]], np.int64)), P.to_tensor(
+             np.array([[1, 3, 3]], np.int64)))[0]),
+         lambda: np.array([[1.0 / 3.0]]), inputs=[], rtol=1e-5, atol=0)
+    _add("viterbi_decode",
+         lambda fn: (lambda: fn(
+             P.to_tensor(RS.randn(1, 3, 2).astype(F32)),
+             P.to_tensor(RS.randn(2, 2).astype(F32)),
+             P.to_tensor(np.array([3], np.int64)))[0]),
+         None, inputs=[])
+    _add("crf_decoding",
+         lambda fn: (lambda: fn(
+             P.to_tensor(RS.randn(1, 3, 2).astype(F32)),
+             P.to_tensor(RS.randn(4, 2).astype(F32)),
+             P.to_tensor(np.array([3], np.int64)))),
+         None, inputs=[])
+    _add("sequence_pool",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([2, 1], np.int64)), "SUM")),
+         None, inputs=[_arr((2, 3, 4))])
+    _add("sequence_conv",
+         lambda fn: (lambda x, w: fn(x, w)),
+         None, inputs=[_arr((2, 4, 3)), _arr((9, 5))])
+    _add("segment_pool",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([0, 0, 1], np.int64)), "SUM")),
+         lambda x: np.stack([x[:2].sum(0), x[2]]), inputs=[_arr((3, 4))])
+    _add("send_u_recv",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([0, 1], np.int64)), P.to_tensor(
+             np.array([1, 2], np.int64)), "SUM")),
+         None, inputs=[_arr((3, 4))])
+    _add("send_ue_recv",
+         lambda fn: (lambda x, e: fn(x, e, P.to_tensor(
+             np.array([0, 1], np.int64)), P.to_tensor(
+             np.array([1, 2], np.int64)), "ADD", "SUM")),
+         None, inputs=[_arr((3, 4)), _arr((2, 4))])
+    _add("send_uv",
+         lambda fn: (lambda x, y: fn(x, y, P.to_tensor(
+             np.array([0, 1], np.int64)), P.to_tensor(
+             np.array([1, 2], np.int64)), "ADD")),
+         None, inputs=[_arr((3, 4)), _arr((3, 4))])
+    _add("gather_tree",
+         lambda fn: (lambda: fn(P.to_tensor(
+             RS.randint(0, 4, (3, 2, 2)).astype(np.int64)), P.to_tensor(
+             RS.randint(0, 2, (3, 2, 2)).astype(np.int64)))),
+         None, inputs=[])
+
+    # ---- MoE helpers --------------------------------------------------------
+    _add("number_count",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([0, 1, 1, 3], np.int64)), 4)),
+         lambda: np.array([1, 2, 0, 1], np.int64), inputs=[])
+    _add("assign_pos",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([0, 1, 1, 3], np.int64)), P.to_tensor(
+             np.array([1, 3, 3, 4], np.int64)))),
+         None, inputs=[])
+    _add("limit_by_capacity",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([3, 5], np.int64)), P.to_tensor(
+             np.array([2, 2], np.int64)), 1)),
+         lambda: np.array([2, 2], np.int64), inputs=[])
+    _add("prune_gate_by_capacity",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([0, 0, 1, 1], np.int64)), P.to_tensor(
+             np.array([1, 2], np.int64)), 2, 4)),
+         None, inputs=[])
+    _add("random_routing",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([[0, 1], [1, 0]], np.int64)), P.to_tensor(
+             np.array([[0.9, 0.8], [0.7, 0.6]], F32)), P.to_tensor(
+             np.array([0.1, 0.1], F32)))),
+         None, inputs=[])
+    _add("global_gather", lambda fn: (lambda x: fn(x)), ident,
+         inputs=[_arr((2, 4))])
+    _add("global_scatter", lambda fn: (lambda x: fn(x)), ident,
+         inputs=[_arr((2, 4))])
+
+    # ---- misc ---------------------------------------------------------------
+    _add("full_", lambda fn: (lambda: fn([3, 4], 2.5)),
+         lambda: np.full((3, 4), 2.5, F32), inputs=[])
+    _add("full_int_array", lambda fn: (lambda: fn([2, 3], "int64")),
+         lambda: np.array([2, 3], np.int64), inputs=[])
+    _add("full_batch_size_like",
+         lambda fn: (lambda x: fn(x, [5, 2], "float32", 1.5, 0, 0)),
+         lambda x: np.full((3, 2), 1.5, F32), inputs=[_arr((3, 4))])
+    _add("full_with_tensor",
+         lambda fn: (lambda: fn([2, 2], P.to_tensor(np.array(2.0, F32)))),
+         lambda: np.full((2, 2), 2.0, F32), inputs=[])
+    _add("assign_value_",
+         lambda fn: (lambda x: fn(x, [2, 2], "float32",
+                                  [1.0, 2.0, 3.0, 4.0])),
+         lambda x: np.array([[1, 2], [3, 4]], F32), inputs=[_arr((2, 2))])
+    _add("assign_out_", lambda fn: (lambda x, y: fn(x, y)),
+         lambda x, y: x, inputs=[_arr((3, 4)), _arr((3, 4))])
+    _add("share_data", lambda fn: (lambda x: fn(x)), ident,
+         inputs=[_arr((3, 4))])
+    _add("copy_to", lambda fn: (lambda x: fn(x, "cpu", False)), ident,
+         inputs=[_arr((3, 4))])
+    _add("memcpy_d2h", lambda fn: (lambda x: fn(x, 0)), ident,
+         inputs=[_arr((3, 4))])
+    _add("memcpy_h2d", lambda fn: (lambda x: fn(x, 0)), ident,
+         inputs=[_arr((3, 4))])
+    _add("npu_identity", lambda fn: (lambda x: fn(x)), ident,
+         inputs=[_arr((3, 4))])
+    _add("trans_layout", lambda fn: (lambda x: fn(x, [1, 0])),
+         lambda x: x.T, inputs=[_arr((3, 4))])
+    _add("data",
+         lambda fn: (lambda: fn("x", [2, 2], "float32", 0)),
+         None, inputs=[])
+    _add("depend", lambda fn: (lambda x, y: fn(x, y)),
+         lambda x, y: x, inputs=[_arr((3, 4)), _arr((3, 4))])
+    _add("sync_calc_stream", lambda fn: (lambda x: fn(x)), ident,
+         inputs=[_arr((3, 4))])
+    _add("check_numerics",
+         lambda fn: (lambda x: fn(x)[0] if isinstance(
+             fn(x), (tuple, list)) else fn(x)),
+         None, inputs=[_arr((3, 4))])
+    _add("check_finite_and_unscale_",
+         lambda fn: (lambda x: fn([x], P.to_tensor(
+             np.array([2.0], F32)))[0][0]),
+         lambda x: x / 2.0, inputs=[_arr((3, 4))])
+    _add("update_loss_scaling_",
+         lambda fn: (lambda x: fn(
+             [x], P.to_tensor(np.array([False])),
+             P.to_tensor(np.array([2.0], F32)),
+             P.to_tensor(np.array([0], np.int32)),
+             P.to_tensor(np.array([0], np.int32)), 2, 2, 2.0, 0.5)[0][0]),
+         None, inputs=[_arr((3, 4))])
+    _add("uniform_inplace", lambda fn: (lambda x: fn(x)), None,
+         inputs=[_arr((8, 8))])
+    _add("gaussian_inplace", lambda fn: (lambda: fn([8, 8])), None,
+         inputs=[])
+    _add("truncated_gaussian_random",
+         lambda fn: (lambda: fn([64], 0.0, 1.0)), None, inputs=[])
+    _add("uniform_random_batch_size_like",
+         lambda fn: (lambda x: fn(x, [5, 3])), None, inputs=[_arr((4, 2))])
+    _add("top_p_sampling",
+         lambda fn: (lambda: fn(P.to_tensor(
+             sp.softmax(RS.randn(2, 8).astype(F32), -1)), P.to_tensor(
+             np.array([0.9, 0.9], F32)))[1]),
+         None, inputs=[])
+    _add("class_center_sample",
+         lambda fn: (lambda: fn(P.to_tensor(
+             np.array([0, 2, 4], np.int64)), 6, 4)[0]),
+         None, inputs=[])
+    _add("shuffle_batch",
+         lambda fn: (lambda x: fn(x)[0]), None, inputs=[_arr((4, 3))])
+    _add("cvm",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.ones((3, 2), F32)), True)),
+         None, inputs=[np.abs(_arr((3, 6)))])
+    _add("accuracy_check",
+         lambda fn: (lambda x: fn(x, x)),
+         lambda x: np.array(True), inputs=[_arr((3, 4))])
+    _add("enable_check_model_nan_inf", lambda fn: (lambda x: fn(x)),
+         None, inputs=[_arr((2, 2))])
+    _add("disable_check_model_nan_inf", lambda fn: (lambda x: fn(x)),
+         None, inputs=[_arr((2, 2))])
+    _add("add_position_encoding",
+         lambda fn: (lambda x: fn(x, 1.0, 1.0)), None,
+         inputs=[_arr((2, 4, 6))])
+    _add("affine_channel",
+         lambda fn: (lambda x, s, b: fn(x, s, b)),
+         lambda x, s, b: x * s[None, :, None, None] + b[None, :, None, None],
+         inputs=[_arr((2, 3, 4, 4)), _arr((3,)), _arr((3,))])
+    _add("affine_grid",
+         lambda fn: (lambda: fn(P.to_tensor(np.array(
+             [[[1, 0, 0], [0, 1, 0]]], F32)), [1, 1, 4, 4])),
+         None, inputs=[])
+    _add("dgc_clip_by_norm",
+         lambda fn: (lambda x: fn(x, P.to_tensor(
+             np.array([0], np.int32)), 1.0, 1)),
+         None, inputs=[_arr((3, 4))])
+
+
+def register_alias_cases(_add, _arr):
+    """Semantic cases for the alias bindings that the family sweeps above do
+    not reach (VERDICT r4 #3: one semantic assertion per alias binding)."""
+    F32 = np.float32
+    ident = lambda x: x
+
+    # collectives / plumbing at world 1
+    _add("c_scatter", lambda fn: (lambda x: fn(x, [x])), ident,
+         inputs=[_arr((2, 4))])
+    _add("barrier", lambda fn: (lambda: fn() or np.zeros(1, F32)),
+         lambda: np.zeros(1, F32), inputs=[])
+    _add("set", lambda fn: (lambda x: fn(x)), ident, inputs=[_arr((3, 4))])
+    _add("shape64",
+         lambda fn: (lambda: fn(P.to_tensor(np.zeros((3, 5), F32)))),
+         lambda: np.array([3, 5], np.int32), inputs=[])
+    _add("coalesce_tensor",
+         lambda fn: (lambda x, y: fn([x, y])[0]),
+         lambda x, y: np.concatenate([x.ravel(), y.ravel()]),
+         inputs=[_arr((2, 3)), _arr((4,))])
+
+    # attention variants
+    def attn_oracle(q, k, v, causal=True):
+        s = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(q.shape[-1])
+        if causal:
+            mask = np.tril(np.ones((q.shape[1], q.shape[1]), bool))
+            s = np.where(mask, s, -1e30)
+        p = sp.softmax(s, -1)
+        return np.einsum("bhst,bthd->bshd", p, v)
+
+    def unpadded_call(fn):
+        def run(q, k, v):
+            cu = P.to_tensor(np.array([0, 6], np.int32))
+            return fn(q, k, v, cu, cu, 6, 6, causal=True)[0]
+
+        return run
+
+    _add("flash_attn_unpadded", unpadded_call,
+         lambda q, k, v: attn_oracle(q[None], k[None], v[None])[0],
+         inputs=[_arr((6, 2, 4)), _arr((6, 2, 4)), _arr((6, 2, 4))],
+         rtol=1e-3, atol=1e-4)
+    _add("variable_length_memory_efficient_attention", unpadded_call,
+         lambda q, k, v: attn_oracle(q[None], k[None], v[None])[0],
+         inputs=[_arr((6, 2, 4)), _arr((6, 2, 4)), _arr((6, 2, 4))],
+         rtol=1e-3, atol=1e-4)
+    _add("memory_efficient_attention",
+         lambda fn: (lambda q, k, v: fn(q, k, v)),
+         lambda q, k, v: attn_oracle(q, k, v, causal=False),
+         inputs=[_arr((1, 6, 2, 4)), _arr((1, 6, 2, 4)), _arr((1, 6, 2, 4))],
+         rtol=1e-3, atol=1e-4)
+    _add("calc_reduced_attn_scores",
+         lambda fn: (lambda q, k: fn(q, k)), None,
+         inputs=[_arr((1, 6, 2, 4)), _arr((1, 6, 2, 4))])
+
+    # conv alias with bias
+    def convT_bias_oracle(x, w, b):
+        import torch
+
+        out = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w)).numpy()
+        return out + b[None, :, None, None]
+
+    _add("conv2d_transpose_bias",
+         lambda fn: (lambda x, w, b: fn(x, w, b)),
+         convT_bias_oracle,
+         inputs=[_arr((1, 3, 4, 4)), _arr((3, 2, 3, 3)), _arr((2,))],
+         rtol=1e-3, atol=1e-3)
+
+    def sbn_oracle(x, m, v, w, b):
+        return ((x - m[None, :, None, None])
+                / np.sqrt(v[None, :, None, None] + 1e-5)
+                * w[None, :, None, None] + b[None, :, None, None])
+
+    _add("sync_batch_norm_",
+         lambda fn: (lambda x, m, v, w, b: fn(x, m, v, weight=w, bias=b,
+                                              training=False)),
+         sbn_oracle,
+         inputs=[_arr((2, 3, 4, 4)), _arr((3,)), np.abs(_arr((3,))) + 0.5,
+                 _arr((3,)), _arr((3,))], rtol=1e-3, atol=1e-4)
+
+    # recurrent kernels vs numpy recurrences (gate orders per ops/rnn_ops.py)
+    def lstm_oracle(x, wx, wh, b):
+        B, T, _ = x.shape
+        H = wh.shape[0]
+        h = np.zeros((B, H), F32)
+        c = np.zeros((B, H), F32)
+        ys = []
+        for t in range(T):
+            gates = x[:, t] @ wx + h @ wh + b
+            i, f, g, o = np.split(gates, 4, -1)
+            c = sp.expit(f) * c + sp.expit(i) * np.tanh(g)
+            h = sp.expit(o) * np.tanh(c)
+            ys.append(h)
+        return [np.stack(ys, 1), h, c]
+
+    for name in ("lstm", "cudnn_lstm", "attention_lstm"):
+        _add(name, lambda fn: (lambda x, wx, wh, b: list(fn(x, wx, wh, b))),
+             lstm_oracle,
+             inputs=[_arr((2, 3, 4)), _arr((4, 12)), _arr((3, 12)),
+                     _arr((12,))], rtol=1e-3, atol=1e-4)
+
+    def gru_oracle(x, wx, wh, b):
+        B, T, _ = x.shape
+        H = wh.shape[0]
+        h = np.zeros((B, H), F32)
+        ys = []
+        for t in range(T):
+            xr, xz, xn = np.split(x[:, t] @ wx + b, 3, -1)
+            hr, hz, hn = np.split(h @ wh, 3, -1)
+            r = sp.expit(xr + hr)
+            z = sp.expit(xz + hz)
+            n = np.tanh(xn + r * hn)
+            h = (1 - z) * n + z * h
+            ys.append(h)
+        return [np.stack(ys, 1), h]
+
+    _add("gru", lambda fn: (lambda x, wx, wh, b: list(fn(x, wx, wh, b))),
+         gru_oracle,
+         inputs=[_arr((2, 3, 4)), _arr((4, 9)), _arr((3, 9)), _arr((9,))],
+         rtol=1e-3, atol=1e-4)
+    _add("gru_unit",
+         lambda fn: (lambda xp, h, w: fn(xp, h, w)[0]
+                     if isinstance(fn(xp, h, w), (tuple, list))
+                     else fn(xp, h, w)),
+         None, inputs=[_arr((2, 9)), _arr((2, 3)), _arr((3, 9))])
+    def rnn_oracle(x, wx, wh, b):
+        h = np.zeros((2, 4), F32)
+        ys = []
+        for t in range(x.shape[1]):
+            h = np.tanh(x[:, t] @ wx + h @ wh + b)
+            ys.append(h)
+        return np.stack(ys, 1)
+
+    _add("rnn",
+         lambda fn: (lambda x, wx, wh, b: fn(x, wx, wh, b)[0]),
+         rnn_oracle,
+         inputs=[_arr((2, 3, 4)), _arr((4, 4)), _arr((4, 4)), _arr((4,))],
+         rtol=1e-3, atol=1e-4)
+
+    # beam search step over (batch, beam, vocab) log-probs
+    _add("beam_search",
+         lambda fn: (lambda lp, ps: list(fn(lp, ps, 2))[0]),
+         None, inputs=[_arr((2, 2, 6)), _arr((2, 2))])

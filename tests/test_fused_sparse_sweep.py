@@ -1,0 +1,308 @@
+"""Fused + sparse tier numeric sweeps (VERDICT r4 #3: the r4 sweep covered
+the dense tier only; these extend the oracle discipline to ops/fused_ops.py
+and sparse/ — reference: test/legacy_test/op_test.py:418 check_output over
+the fusion and sparse kernel suites)."""
+import numpy as np
+import pytest
+import scipy.special as sp
+
+import paddle_tpu as P
+from paddle_tpu.ops import registry
+from paddle_tpu.ops.op_defs import OP_DEFS
+
+RS = np.random.RandomState(77)
+
+
+def _arr(shape):
+    return RS.randn(*shape).astype(np.float32)
+
+
+def _ln(x, axis=-1, eps=1e-5):
+    m = x.mean(axis, keepdims=True)
+    v = x.var(axis, keepdims=True)
+    return (x - m) / np.sqrt(v + eps)
+
+
+# ---- fused tier -------------------------------------------------------------
+# name -> (builder(fn) -> callable(), oracle() -> array or None)
+FUSED: dict = {}
+
+
+def _f(name, build, oracle=None, rtol=1e-4, atol=1e-5):
+    fn = registry.get_op(name)
+    if fn is None or name not in OP_DEFS:
+        return
+    FUSED[name] = (build(fn), oracle, rtol, atol)
+
+
+_x34 = _arr((3, 4))
+_y34 = _arr((3, 4))
+_w45 = _arr((4, 5))
+_b5 = _arr((5,))
+
+_f("fused_elementwise_add", lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_y34))),
+   lambda: _x34 + _y34)
+_f("fused_elementwise_sub", lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_y34))),
+   lambda: _x34 - _y34)
+_f("fused_elementwise_mul", lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_y34))),
+   lambda: _x34 * _y34)
+_f("fused_elementwise_div", lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(np.abs(_y34) + 1))),
+   lambda: _x34 / (np.abs(_y34) + 1))
+_f("fused_dropout_add",
+   lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_y34), p=0.0)),
+   lambda: _x34 + _y34)
+_f("fc", lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_w45), P.to_tensor(_b5))),
+   lambda: _x34 @ _w45 + _b5)
+_f("fused_bias_act",
+   lambda fn: (lambda: fn(P.to_tensor(_x34), bias=P.to_tensor(_arr((4,)) * 0 + 0.5),
+                          act_method="relu")),
+   lambda: np.maximum(_x34 + 0.5, 0))
+_f("fused_elemwise_activation",
+   lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_y34),
+                          functor_list=("elementwise_add", "relu"))),
+   lambda: np.maximum(_x34 + _y34, 0))
+_f("fused_elemwise_add_activation",
+   lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_y34))),
+   lambda: np.maximum(_x34 + _y34, 0))
+_f("fusion_squared_mat_sub",
+   lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_w45))),
+   lambda: (_x34 @ _w45) ** 2 - (_x34 ** 2) @ (_w45 ** 2))
+_f("fused_bias_dropout_residual_layer_norm",
+   lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_y34),
+                          dropout_rate=0.0, is_test=True)),
+   lambda: _ln(_x34 + _y34), rtol=1e-3, atol=1e-4)
+_f("fused_bias_residual_layernorm",
+   lambda fn: (lambda: fn(P.to_tensor(_x34), residual=P.to_tensor(_y34))),
+   lambda: _ln(_x34 + _y34), rtol=1e-3, atol=1e-4)
+_f("fused_fc_elementwise_layernorm",
+   lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_w45),
+                          P.to_tensor(_arr((3, 5)) * 0 + 1.0))),
+   lambda: _ln(_x34 @ _w45 + 1.0), rtol=1e-3, atol=1e-4)
+_f("add_group_norm_silu",
+   lambda fn: (lambda: fn(P.to_tensor(_arr((2, 6, 2, 2))), groups=2,
+                          data_format="NCHW")[0]),
+   None)
+_f("fused_rotary_position_embedding",
+   lambda fn: (lambda: fn(P.to_tensor(_arr((2, 8, 2, 4))))[0]),
+   None)
+_f("fused_dot_product_attention",
+   lambda fn: (lambda: fn(P.to_tensor(_arr((2, 8, 2, 4))),
+                          P.to_tensor(_arr((2, 8, 2, 4))),
+                          P.to_tensor(_arr((2, 8, 2, 4))))),
+   None)
+_f("fused_linear_param_grad_add",
+   lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_arr((3, 5))))[0]),
+   lambda: _x34.T @ FUSED_LPG_DOUT, rtol=1e-3, atol=1e-4)
+FUSED_LPG_DOUT = None  # filled below; keep the registration simple
+
+
+def _fix_lpg():
+    global FUSED_LPG_DOUT
+    dout = _arr((3, 5))
+    FUSED_LPG_DOUT = dout
+    fn = registry.get_op("fused_linear_param_grad_add")
+    if fn is None:
+        FUSED.pop("fused_linear_param_grad_add", None)
+        return
+    FUSED["fused_linear_param_grad_add"] = (
+        (lambda: fn(P.to_tensor(_x34), P.to_tensor(dout))[0]),
+        (lambda: _x34.T @ dout), 1e-3, 1e-4)
+
+
+_fix_lpg()
+_f("fusion_transpose_flatten_concat",
+   lambda fn: (lambda: fn([P.to_tensor(_arr((2, 3, 4))),
+                           P.to_tensor(_arr((2, 3, 4)))])),
+   None)
+_f("fusion_repeated_fc_relu",
+   lambda fn: (lambda: fn(P.to_tensor(_x34),
+                          [P.to_tensor(_w45), P.to_tensor(_arr((5, 2)))],
+                          [P.to_tensor(_b5), P.to_tensor(_arr((2,)))])),
+   lambda: np.maximum(np.maximum(_x34 @ _w45 + _b5, 0) @ _arr((5, 2)) * 0
+                      + np.maximum(np.maximum(_x34 @ _w45 + _b5, 0)
+                                   @ _REPEAT_W2 + _REPEAT_B2, 0), 0))
+_REPEAT_W2 = None
+_REPEAT_B2 = None
+
+
+def _fix_repeated_fc():
+    global _REPEAT_W2, _REPEAT_B2
+    fn = registry.get_op("fusion_repeated_fc_relu")
+    if fn is None:
+        FUSED.pop("fusion_repeated_fc_relu", None)
+        return
+    w2, b2 = _arr((5, 2)), _arr((2,))
+    _REPEAT_W2, _REPEAT_B2 = w2, b2
+    FUSED["fusion_repeated_fc_relu"] = (
+        (lambda: fn(P.to_tensor(_x34), [P.to_tensor(_w45), P.to_tensor(w2)],
+                    [P.to_tensor(_b5), P.to_tensor(b2)])),
+        (lambda: np.maximum(np.maximum(_x34 @ _w45 + _b5, 0) @ w2 + b2, 0)),
+        1e-4, 1e-5)
+
+
+_fix_repeated_fc()
+_f("fused_conv2d_add_act",
+   lambda fn: (lambda: fn(P.to_tensor(_arr((1, 2, 5, 5))),
+                          P.to_tensor(_arr((3, 2, 3, 3))))),
+   None)
+_f("fused_scale_bias_add_relu",
+   lambda fn: (lambda: fn(P.to_tensor(_x34), P.to_tensor(_arr((4,)) * 0 + 2.0),
+                          P.to_tensor(_arr((4,)) * 0 + 0.5),
+                          P.to_tensor(_y34))),
+   lambda: np.maximum(_x34 * 2.0 + 0.5 + _y34, 0))
+_f("fused_embedding_eltwise_layernorm",
+   lambda fn: (lambda: fn(
+       [P.to_tensor(np.array([[0, 1]], np.int64)),
+        P.to_tensor(np.array([[1, 0]], np.int64))],
+       [P.to_tensor(_arr((4, 6))), P.to_tensor(_arr((4, 6)))],
+       P.to_tensor(np.zeros(6, np.float32)),
+       P.to_tensor(np.ones(6, np.float32)))),
+   None)
+_f("fused_token_prune",
+   lambda fn: (lambda: fn(
+       P.to_tensor(np.abs(_arr((1, 2, 4, 4)))),
+       P.to_tensor(_arr((1, 4, 6))),
+       P.to_tensor(np.ones((1, 2, 4, 4), np.float32)),
+       P.to_tensor(np.ones((1, 2, 2, 2), np.float32)))[0]),
+   None)
+_f("fused_seqpool_cvm",
+   lambda fn: (lambda: fn([P.to_tensor(_arr((2, 3, 4)))],
+                          P.to_tensor(np.abs(_arr((2, 2))) + 0.5))),
+   None)
+_f("fused_multi_transformer_",
+   lambda fn: (lambda: None), None)  # exercised via models; drop below
+FUSED.pop("fused_multi_transformer_", None)
+
+
+@pytest.mark.parametrize("name", sorted(FUSED))
+def test_fused_sweep(name):
+    build, oracle, rtol, atol = FUSED[name]
+    out = build()
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    vals = [np.asarray(o.numpy() if hasattr(o, "numpy") else o) for o in outs
+            if o is not None]
+    for v in vals:
+        if np.issubdtype(v.dtype, np.floating):
+            assert np.isfinite(v).all(), f"{name}: non-finite"
+    if oracle is None:
+        return
+    want = oracle()
+    np.testing.assert_allclose(vals[0].astype(np.float64),
+                               np.asarray(want, np.float64),
+                               rtol=rtol, atol=atol, err_msg=name)
+
+
+# ---- sparse tier ------------------------------------------------------------
+
+def _coo(dense):
+    idx = np.stack(np.nonzero(dense))
+    vals = dense[tuple(idx)]
+    import paddle_tpu.sparse as S
+
+    return S.sparse_coo_tensor(P.to_tensor(idx.astype(np.int64)),
+                               P.to_tensor(vals), shape=list(dense.shape))
+
+
+def _dense_of(sp_t):
+    return np.asarray(sp_t.to_dense().numpy()
+                      if hasattr(sp_t, "to_dense") else sp_t.numpy())
+
+
+_D = RS.randn(4, 5).astype(np.float32)
+_D[RS.rand(4, 5) > 0.5] = 0.0
+_DPOS = np.abs(_D)  # same sparsity, positive values
+_DUNIT = np.clip(_D, -0.9, 0.9)
+
+# unary ops where f(0) == 0: sparse apply == dense apply
+_SPARSE_UNARY = {
+    "abs": (np.abs, _D), "asin": (np.arcsin, _DUNIT),
+    "asinh": (np.arcsinh, _D), "atan": (np.arctan, _D),
+    "atanh": (np.arctanh, _DUNIT), "expm1": (np.expm1, _D),
+    "log1p": (np.log1p, _DPOS), "relu": (lambda v: np.maximum(v, 0), _D),
+    "relu6": (lambda v: np.clip(v, 0, 6), _D),
+    "leaky_relu": (lambda v: np.where(v > 0, v, 0.01 * v), _D),
+    "sin": (np.sin, _D), "sinh": (np.sinh, _D),
+    "sqrt": (np.sqrt, _DPOS), "square": (np.square, _D),
+    "tan": (np.tan, _DUNIT), "tanh": (np.tanh, _D),
+    "sign": (np.sign, _D),
+}
+
+
+@pytest.mark.parametrize("name", sorted(
+    n for n in _SPARSE_UNARY if registry.get_op(f"sparse.{n}")))
+def test_sparse_unary_sweep(name):
+    fn = registry.get_op(f"sparse.{name}")
+    oracle, dense = _SPARSE_UNARY[name]
+    out = fn(_coo(dense))
+    np.testing.assert_allclose(_dense_of(out), oracle(dense),
+                               rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_sparse_binary_and_matmul_sweep():
+    import paddle_tpu.sparse as S
+
+    a = _D
+    b = RS.randn(4, 5).astype(np.float32)
+    b[RS.rand(4, 5) > 0.5] = 0.0
+    np.testing.assert_allclose(_dense_of(S.add(_coo(a), _coo(b))), a + b,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_dense_of(S.multiply(_coo(a), _coo(b))), a * b,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_dense_of(S.subtract(_coo(a), _coo(b))), a - b,
+                               rtol=1e-5, atol=1e-6)
+
+    dense_rhs = RS.randn(5, 3).astype(np.float32)
+    got = S.matmul(_coo(a), P.to_tensor(dense_rhs))
+    got = np.asarray(got.numpy() if hasattr(got, "numpy") else got)
+    np.testing.assert_allclose(got, a @ dense_rhs, rtol=1e-4, atol=1e-5)
+
+    v = RS.randn(5).astype(np.float32)
+    got = S.mv(_coo(a), P.to_tensor(v))
+    np.testing.assert_allclose(np.asarray(got.numpy()), a @ v,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_structure_ops_sweep():
+    import paddle_tpu.sparse as S
+
+    t = _coo(_D)
+    np.testing.assert_allclose(_dense_of(t), _D)
+    # indices/values round trip
+    idx = np.asarray(t.indices().numpy())
+    vals = np.asarray(t.values().numpy())
+    rebuilt = np.zeros_like(_D)
+    rebuilt[tuple(idx)] = vals
+    np.testing.assert_allclose(rebuilt, _D)
+    # scale / cast / reshape
+    np.testing.assert_allclose(_dense_of(S.scale(t, 2.0)), _D * 2.0,
+                               rtol=1e-5, atol=1e-6)
+    r = S.reshape(t, [5, 4])
+    np.testing.assert_allclose(_dense_of(r), _D.reshape(5, 4))
+    # csr round trip
+    csr = t.to_sparse_csr() if hasattr(t, "to_sparse_csr") else None
+    if csr is not None:
+        np.testing.assert_allclose(_dense_of(csr), _D)
+
+
+def test_sparse_softmax_and_masked():
+    import paddle_tpu.sparse as S
+
+    t = _coo(_DPOS)
+    out = S.softmax(t)
+    got = _dense_of(out)
+    # rows normalize over STORED entries only (reference sparse softmax)
+    for i in range(_DPOS.shape[0]):
+        nz = _DPOS[i] != 0
+        if nz.any():
+            e = np.exp(_DPOS[i][nz] - _DPOS[i][nz].max())
+            np.testing.assert_allclose(got[i][nz], e / e.sum(),
+                                       rtol=1e-4, atol=1e-5)
+
+
+def test_fused_sparse_accounting():
+    """Ratchet: the fused/sparse tiers must keep a numeric-case floor."""
+    fused_cases = [n for n in FUSED if OP_DEFS.get(n, {}).get("tier") == "fused"]
+    assert len(fused_cases) >= 20, len(fused_cases)
+    n_sparse_unary = sum(1 for n in _SPARSE_UNARY
+                         if registry.get_op(f"sparse.{n}"))
+    assert n_sparse_unary >= 14, n_sparse_unary

@@ -577,6 +577,39 @@ _add("bilinear", lambda fn: (lambda x, y, w: fn(x, y, w, None)),
      inputs=[_arr((3, 4)), _arr((3, 5)), _arr((2, 4, 5))], rtol=1e-3,
      atol=1e-4)
 
+# ---- extension batch (VERDICT r4 #3: floor raised to >=400/>=180) ----------
+from sweep_cases_ext import register as _register_ext  # noqa: E402
+from sweep_cases_ext import register_alias_cases as _register_alias  # noqa: E402
+
+_register_ext(_add, _arr)
+_register_alias(_add, _arr)
+
+# Smooth ops from the extension batch get central-difference grad checks
+# wrt every float input (discrete/kinky ops — argsort, round, relu-fused,
+# dropout — stay output-only; the reference's check_grad white-list culture).
+_SMOOTH_GRAD = [
+    "reverse", "unstack", "broadcast_tensors", "crop",
+    "index_sample", "multi_dot", "triangular_solve", "cholesky_solve",
+    "solve", "label_smooth", "log_loss", "kldiv_loss", "temporal_shift",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "shuffle_channel",
+    "fold", "unfold", "frame", "overlap_add", "renorm", "multiplex",
+    "bilinear", "spectral_norm", "flash_attn_qkvpacked",
+    "flashmask_attention", "lp_pool2d", "linear_interp", "trilinear_interp",
+    "partial_concat", "partial_sum", "mp_allreduce_sum", "sequence_pool",
+    "sequence_conv", "segment_pool", "send_u_recv", "send_ue_recv",
+    "send_uv", "trans_layout", "add_position_encoding",
+    "affine_channel", "global_gather", "global_scatter", "roi_align",
+    "fill_diagonal", "fill_diagonal_tensor", "split_with_num", "as_strided",
+    "index_select_strided", "tensor_unfold",
+    "repeat_interleave_with_tensor_index", "depthwise_conv2d_transpose",
+]
+for _n in _SMOOTH_GRAD:
+    _c = CASES.get(_n)
+    if _c is not None and not _c.grad_wrt and _c.inputs:
+        _c.grad_wrt = [
+            i for i, _v in enumerate(_c.inputs)
+            if np.issubdtype(np.asarray(_v).dtype, np.floating)]
+
 # ---- the parametrized checks ----------------------------------------------
 
 
@@ -644,5 +677,22 @@ def test_sweep_accounting():
     """Ratchet: the sweep must numerically exercise a floor of dense ops,
     and every case tagged for grad checking has a YAML backward entry."""
     dense_cases = [n for n in CASES if OP_DEFS[n]["tier"] == "dense"]
-    assert len(dense_cases) >= 230, len(dense_cases)
-    assert len(GRAD_CASES) >= 90, len(GRAD_CASES)
+    assert len(dense_cases) >= 400, len(dense_cases)
+    assert len(GRAD_CASES) >= 180, len(GRAD_CASES)
+
+
+def test_every_alias_has_semantic_case():
+    """One semantic assertion per alias binding (VERDICT r4 #3): every name
+    in registry._ALIASES must be exercised by a sweep case (here or in the
+    fused/sparse sweeps), or carry an explicit exemption with a reason."""
+    from paddle_tpu.ops.registry import _ALIASES
+
+    exempt = {
+        # no YAML row (not in OP_DEFS), so no CASES slot; exercised by
+        # tests/test_communication.py-family suites instead
+        "barrier": "coordination no-op at world 1; covered by comm tests",
+        "shape64": "shape variant without a YAML row; shape is swept",
+    }
+    missing = [a for a in _ALIASES
+               if a not in CASES and a not in exempt]
+    assert not missing, f"aliases without a semantic sweep case: {missing}"
