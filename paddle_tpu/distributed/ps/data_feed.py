@@ -1,0 +1,226 @@
+"""PS-mode streaming data pipeline (VERDICT r4 missing #7).
+
+Reference: paddle/fluid/framework/data_feed.cc (MultiSlotDataFeed — the
+slot-format text parser) + data_set.cc (InMemoryDataset/QueueDataset — the
+file-list driven feeders behind fleet PS training) and their python surface
+python/paddle/distributed/fleet/dataset/dataset.py.
+
+TPU-native shape: instead of C++ channel threads pushing LoDTensors into a
+scope, the feeders parse the same MultiSlot text format into numpy batches
+— sparse slots as padded [batch, max_len] int64 id matrices with a
+[batch, max_len] mask (static shapes for XLA; the reference's LoD ragged
+rows become pad+mask), dense slots as [batch, dim] float32 — and stream
+them through a bounded queue so file IO/parsing overlaps device steps.
+
+MultiSlot text format (one sample per line, reference data_feed.cc):
+    <n> v1 ... vn  <m> v1 ... vm  ...     (one group per configured slot)
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Slot:
+    """One slot's schema: uint64 sparse ids or float dense values."""
+
+    def __init__(self, name: str, dtype: str = "uint64", dim: int = 1):
+        if dtype not in ("uint64", "float"):
+            raise ValueError(f"slot dtype {dtype!r} (uint64|float)")
+        self.name, self.dtype, self.dim = name, dtype, dim
+
+    @property
+    def is_sparse(self) -> bool:
+        return self.dtype == "uint64"
+
+
+def _parse_line(line: str, slots: Sequence[Slot]):
+    toks = line.split()
+    pos = 0
+    out = []
+    for slot in slots:
+        if pos >= len(toks):
+            raise ValueError(f"line ended before slot {slot.name!r}")
+        n = int(toks[pos])
+        pos += 1
+        vals = toks[pos:pos + n]
+        if len(vals) != n:
+            raise ValueError(f"slot {slot.name!r} declared {n} values, "
+                             f"line has {len(vals)}")
+        pos += n
+        if slot.is_sparse:
+            out.append(np.array([int(v) for v in vals], np.int64))
+        else:
+            arr = np.array([float(v) for v in vals], np.float32)
+            if arr.size != slot.dim:
+                raise ValueError(
+                    f"dense slot {slot.name!r} expects {slot.dim} values, "
+                    f"got {arr.size}")
+            out.append(arr)
+    return out
+
+
+def _collate(samples: List[list], slots: Sequence[Slot]) -> Dict[str, object]:
+    """Batch per-sample slot values: sparse → (ids [B, L] padded with 0,
+    mask [B, L] float32), dense → [B, dim]."""
+    batch: Dict[str, object] = {}
+    for i, slot in enumerate(slots):
+        col = [s[i] for s in samples]
+        if slot.is_sparse:
+            L = max((len(c) for c in col), default=1) or 1
+            ids = np.zeros((len(col), L), np.int64)
+            mask = np.zeros((len(col), L), np.float32)
+            for r, c in enumerate(col):
+                ids[r, : len(c)] = c
+                mask[r, : len(c)] = 1.0
+            batch[slot.name] = (ids, mask)
+        else:
+            batch[slot.name] = np.stack(col)
+    return batch
+
+
+class DatasetBase:
+    """Shared surface of InMemoryDataset/QueueDataset (reference
+    dataset.py::DatasetBase): slot schema + file list + batch size."""
+
+    def __init__(self):
+        self.slots: List[Slot] = []
+        self.filelist: List[str] = []
+        self.batch_size = 1
+        self.drop_last = False
+
+    def init(self, batch_size: int = 1, use_var: Optional[Sequence] = None,
+             **kwargs):
+        self.batch_size = int(batch_size)
+        return self
+
+    def set_use_slots(self, slots: Sequence[Slot]):
+        self.slots = list(slots)
+
+    def set_filelist(self, filelist: Sequence[str]):
+        missing = [f for f in filelist if not os.path.exists(f)]
+        if missing:
+            raise FileNotFoundError(f"dataset files missing: {missing}")
+        self.filelist = list(filelist)
+
+    def _read_samples(self) -> Iterator[list]:
+        for path in self.filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield _parse_line(line, self.slots)
+
+
+class InMemoryDataset(DatasetBase):
+    """reference dataset.py::InMemoryDataset — load_into_memory +
+    local_shuffle, then batched iteration."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples: List[list] = []
+
+    def load_into_memory(self):
+        self._samples = list(self._read_samples())
+
+    def get_memory_data_size(self) -> int:
+        return len(self._samples)
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        rs = np.random.RandomState(seed)
+        rs.shuffle(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+    def __iter__(self):
+        for i in range(0, len(self._samples), self.batch_size):
+            chunk = self._samples[i:i + self.batch_size]
+            if self.drop_last and len(chunk) < self.batch_size:
+                return
+            yield _collate(chunk, self.slots)
+
+
+class QueueDataset(DatasetBase):
+    """reference dataset.py::QueueDataset — streaming: a reader thread
+    parses the file list into a bounded queue while training consumes, so
+    host parsing overlaps device steps (the data_feed.cc channel, one
+    python thread instead of C++ readers)."""
+
+    def __init__(self, queue_capacity: int = 16):
+        super().__init__()
+        self.capacity = queue_capacity
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.capacity)
+        DONE = object()
+        err: List[BaseException] = []
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that stays responsive to consumer shutdown — a
+            # plain q.put would block forever if the consumer stopped
+            # iterating with the queue full (leaked thread + open file)
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def reader():
+            try:
+                chunk: List[list] = []
+                for sample in self._read_samples():
+                    chunk.append(sample)
+                    if len(chunk) == self.batch_size:
+                        if not put(_collate(chunk, self.slots)):
+                            return
+                        chunk = []
+                if chunk and not self.drop_last:
+                    put(_collate(chunk, self.slots))
+            except BaseException as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                put(DONE)
+
+        th = threading.Thread(target=reader, daemon=True)
+        th.start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+            th.join()
+
+
+def embedding_lookup(ps_embedding, ids: np.ndarray, mask: np.ndarray,
+                     combiner: str = "sum"):
+    """Pull a padded sparse slot through a PS SparseEmbedding and combine
+    per sample (reference: the pull_sparse + sequence-pool the PS feeder
+    drives): [B, L] ids + mask → [B, dim]."""
+    import paddle_tpu as paddle
+
+    B, L = ids.shape
+    flat = ps_embedding(paddle.to_tensor(ids.reshape(-1)))
+    dim = flat.shape[-1]
+    vecs = flat.reshape([B, L, dim])
+    m = paddle.to_tensor(mask.reshape(B, L, 1))
+    summed = paddle.sum(vecs * m, axis=1)
+    if combiner == "sum":
+        return summed
+    if combiner == "mean":
+        denom = paddle.clip(paddle.to_tensor(
+            mask.sum(-1, keepdims=True).astype(np.float32)), min=1.0)
+        return summed / denom
+    raise ValueError(f"combiner {combiner!r}")

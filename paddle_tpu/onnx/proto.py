@@ -1,0 +1,317 @@
+"""Minimal ONNX protobuf writer/reader (no ``onnx`` package in the TPU
+image). Implements exactly the subset of onnx.proto3 the exporter emits:
+ModelProto / GraphProto / NodeProto / AttributeProto / TensorProto /
+ValueInfoProto with standard protobuf wire encoding (varint, length-
+delimited, 32-bit). Field numbers follow the public onnx.proto3 schema.
+
+The reader exists so exports are verifiable in-environment: tests decode
+the bytes and re-execute the graph against the source model.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# TensorProto.DataType
+FLOAT, INT32, INT64, BOOL, DOUBLE = 1, 6, 7, 9, 11
+NP_TO_ONNX = {np.dtype(np.float32): FLOAT, np.dtype(np.int32): INT32,
+              np.dtype(np.int64): INT64, np.dtype(np.bool_): BOOL,
+              np.dtype(np.float64): DOUBLE}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS = 6, 7
+
+
+# ---- wire-format primitives -------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    n &= (1 << 64) - 1  # two's complement for negative int64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def _float_field(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+def _str_field(field: int, value: str) -> bytes:
+    return _len_field(field, value.encode())
+
+
+# ---- writers ----------------------------------------------------------------
+
+def tensor(name: str, array: np.ndarray) -> bytes:
+    array = np.ascontiguousarray(array)
+    out = b""
+    for d in array.shape:
+        out += _int_field(1, d)                       # dims
+    out += _int_field(2, NP_TO_ONNX[array.dtype])     # data_type
+    out += _str_field(8, name)                        # name
+    out += _len_field(9, array.tobytes())             # raw_data
+    return out
+
+
+def attribute(name: str, value) -> bytes:
+    out = _str_field(1, name)
+    if isinstance(value, bool):
+        out += _int_field(3, int(value)) + _int_field(20, ATTR_INT)
+    elif isinstance(value, int):
+        out += _int_field(3, value) + _int_field(20, ATTR_INT)
+    elif isinstance(value, float):
+        out += _float_field(2, value) + _int_field(20, ATTR_FLOAT)
+    elif isinstance(value, str):
+        out += _len_field(4, value.encode()) + _int_field(20, ATTR_STRING)
+    elif isinstance(value, np.ndarray):
+        out += _len_field(5, tensor(name + "_t", value))
+        out += _int_field(20, ATTR_TENSOR)
+    elif isinstance(value, (list, tuple)) and value and isinstance(
+            value[0], float):
+        for v in value:
+            out += _float_field(7, v)
+        out += _int_field(20, ATTR_FLOATS)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            out += _int_field(8, int(v))
+        out += _int_field(20, ATTR_INTS)
+    else:
+        raise TypeError(f"unsupported attribute {name}={value!r}")
+    return out
+
+
+def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+         name: str = "", attrs: Optional[dict] = None) -> bytes:
+    out = b""
+    for i in inputs:
+        out += _str_field(1, i)
+    for o in outputs:
+        out += _str_field(2, o)
+    if name:
+        out += _str_field(3, name)
+    out += _str_field(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += _len_field(5, attribute(k, v))
+    return out
+
+
+def value_info(name: str, dtype: int, shape: Sequence[Optional[int]]) -> bytes:
+    dims = b""
+    for i, d in enumerate(shape):
+        if d is None:
+            # unique symbol per axis: identical dim_params assert equality
+            dims += _len_field(1, _str_field(2, f"{name}_dyn{i}"))
+        else:
+            dims += _len_field(1, _int_field(1, int(d)))  # dim_value
+    tensor_type = _int_field(1, dtype) + _len_field(2, dims)
+    type_proto = _len_field(1, tensor_type)
+    return _str_field(1, name) + _len_field(2, type_proto)
+
+
+def graph(nodes: Sequence[bytes], name: str, initializers: Sequence[bytes],
+          inputs: Sequence[bytes], outputs: Sequence[bytes]) -> bytes:
+    out = b""
+    for n in nodes:
+        out += _len_field(1, n)
+    out += _str_field(2, name)
+    for ini in initializers:
+        out += _len_field(5, ini)
+    for i in inputs:
+        out += _len_field(11, i)
+    for o in outputs:
+        out += _len_field(12, o)
+    return out
+
+
+def model(graph_bytes: bytes, opset_version: int = 17,
+          producer: str = "paddle_tpu") -> bytes:
+    opset = _str_field(1, "") + _int_field(2, opset_version)
+    out = _int_field(1, 8)               # ir_version 8
+    out += _str_field(2, producer)
+    out += _len_field(7, graph_bytes)
+    out += _len_field(8, opset)
+    return out
+
+
+# ---- reader -----------------------------------------------------------------
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message's bytes."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+            if val >= 1 << 63:  # two's-complement int64
+                val -= 1 << 64
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"wire type {wire}")
+        yield field, wire, val
+
+
+def parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
+    dims, dtype, name, raw = [], FLOAT, "", b""
+    for field, _, val in _fields(buf):
+        if field == 1:
+            dims.append(val)
+        elif field == 2:
+            dtype = val
+        elif field == 8:
+            name = val.decode()
+        elif field == 9:
+            raw = val
+    arr = np.frombuffer(raw, ONNX_TO_NP[dtype]).reshape(dims)
+    return name, arr
+
+
+def parse_attribute(buf: bytes):
+    name, kind = "", None
+    vals = {"f": None, "i": None, "s": None, "t": None, "floats": [],
+            "ints": []}
+    for field, _, val in _fields(buf):
+        if field == 1:
+            name = val.decode()
+        elif field == 2:
+            vals["f"] = val
+        elif field == 3:
+            vals["i"] = val
+        elif field == 4:
+            vals["s"] = val.decode()
+        elif field == 5:
+            vals["t"] = parse_tensor(val)[1]
+        elif field == 7:
+            vals["floats"].append(val)
+        elif field == 8:
+            vals["ints"].append(val)
+        elif field == 20:
+            kind = val
+    if kind == ATTR_FLOAT:
+        return name, vals["f"]
+    if kind == ATTR_INT:
+        return name, vals["i"]
+    if kind == ATTR_STRING:
+        return name, vals["s"]
+    if kind == ATTR_TENSOR:
+        return name, vals["t"]
+    if kind == ATTR_FLOATS:
+        return name, vals["floats"]
+    if kind == ATTR_INTS:
+        return name, vals["ints"]
+    return name, None
+
+
+def parse_node(buf: bytes) -> Dict:
+    out = {"input": [], "output": [], "op_type": "", "name": "", "attrs": {}}
+    for field, _, val in _fields(buf):
+        if field == 1:
+            out["input"].append(val.decode())
+        elif field == 2:
+            out["output"].append(val.decode())
+        elif field == 3:
+            out["name"] = val.decode()
+        elif field == 4:
+            out["op_type"] = val.decode()
+        elif field == 5:
+            k, v = parse_attribute(val)
+            out["attrs"][k] = v
+    return out
+
+
+def parse_value_info(buf: bytes) -> Dict:
+    name, shape, dtype = "", [], FLOAT
+    for field, _, val in _fields(buf):
+        if field == 1:
+            name = val.decode()
+        elif field == 2:
+            for f2, _, v2 in _fields(val):
+                if f2 == 1:  # tensor_type
+                    for f3, _, v3 in _fields(v2):
+                        if f3 == 1:
+                            dtype = v3
+                        elif f3 == 2:  # shape
+                            for f4, _, v4 in _fields(v3):
+                                if f4 == 1:  # dim
+                                    dim = None
+                                    for f5, _, v5 in _fields(v4):
+                                        if f5 == 1:
+                                            dim = v5
+                                    shape.append(dim)
+    return {"name": name, "shape": shape, "dtype": dtype}
+
+
+def parse_model(buf: bytes) -> Dict:
+    out = {"ir_version": None, "producer": "", "opset": None, "graph": None}
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            out["ir_version"] = val
+        elif field == 2:
+            out["producer"] = val.decode()
+        elif field == 7:
+            out["graph"] = parse_graph(val)
+        elif field == 8:
+            for f2, _, v2 in _fields(val):
+                if f2 == 2:
+                    out["opset"] = v2
+    return out
+
+
+def parse_graph(buf: bytes) -> Dict:
+    out = {"nodes": [], "name": "", "initializers": {}, "inputs": [],
+           "outputs": []}
+    for field, _, val in _fields(buf):
+        if field == 1:
+            out["nodes"].append(parse_node(val))
+        elif field == 2:
+            out["name"] = val.decode()
+        elif field == 5:
+            name, arr = parse_tensor(val)
+            out["initializers"][name] = arr
+        elif field == 11:
+            out["inputs"].append(parse_value_info(val))
+        elif field == 12:
+            out["outputs"].append(parse_value_info(val))
+    return out

@@ -1008,21 +1008,32 @@ def generate_sequence_xpu(*args, **kwargs):  # pragma: no cover
     raise NotImplementedError("XPU-hardware op; not applicable on TPU")
 
 
-def _bn_infer(x, scale, bias, mean, variance, epsilon):
+def _bn_train(x, scale, bias, mean, variance, momentum, epsilon):
+    """Training-form BN (the reference fused_bn_activation ops are TRAINING
+    fusions, fused_bn_activation_op.cu): normalize by BATCH statistics,
+    momentum-update the running stats. Returns (y, mean_out, var_out,
+    saved_mean, saved_inv_std)."""
     import jax.numpy as jnp
 
-    shape = [1, -1] + [1] * (jnp.ndim(x) - 2)  # NCHW channel broadcast
-    inv = 1.0 / jnp.sqrt(jnp.reshape(variance, shape) + epsilon)
-    y = (x - jnp.reshape(mean, shape)) * inv
-    return y * jnp.reshape(scale, shape) + jnp.reshape(bias, shape), inv
+    axes = tuple(i for i in range(jnp.ndim(x)) if i != 1)  # NCHW reduce
+    shape = [1, -1] + [1] * (jnp.ndim(x) - 2)
+    batch_mean = x.mean(axes)
+    batch_var = ((x - jnp.reshape(batch_mean, shape)) ** 2).mean(axes)
+    inv = 1.0 / jnp.sqrt(jnp.reshape(batch_var, shape) + epsilon)
+    y = (x - jnp.reshape(batch_mean, shape)) * inv
+    y = y * jnp.reshape(scale, shape) + jnp.reshape(bias, shape)
+    mean_out = momentum * mean + (1.0 - momentum) * batch_mean
+    var_out = momentum * variance + (1.0 - momentum) * batch_var
+    return y, mean_out, var_out, batch_mean, jnp.reshape(inv, (-1,))
 
 
 def fused_batch_norm_act(x, scale, bias, mean, variance, momentum=0.9,
                          epsilon=1e-5, act_type="relu"):
     """(reference fused op: fused_batch_norm_act,
-    paddle/phi/kernels/fusion/gpu/fused_bn_activation_op.cu) — BN normalize
-    over the given statistics + activation in one op. YAML outputs: (out,
-    mean_out, variance_out, saved_mean, saved_variance, reserve_space)."""
+    paddle/phi/kernels/fusion/gpu/fused_bn_activation_op.cu) — training BN
+    (batch statistics + momentum-updated running stats) + activation in one
+    op. YAML outputs: (out, mean_out, variance_out, saved_mean,
+    saved_variance, reserve_space)."""
     from ..core.dispatch import primitive
     from . import activation as act_mod
 
@@ -1031,14 +1042,13 @@ def fused_batch_norm_act(x, scale, bias, mean, variance, momentum=0.9,
     def fn(xv, sv, bv, mv, vv):
         import jax.numpy as jnp
 
-        y, inv = _bn_infer(xv, sv, bv, mv, vv, epsilon)
+        y, mean_out, var_out, saved_mean, saved_inv = _bn_train(
+            xv, sv, bv, mv, vv, momentum, epsilon)
         if act_type:
             from ..core.tensor import unwrap
 
             y = unwrap(act(y))
-        # saved_variance is the (C,) inverse-stddev vector per the YAML
-        # output contract, not the broadcast-shaped intermediate
-        return (y, mv, vv, mv, jnp.reshape(inv, (-1,)),
+        return (y, mean_out, var_out, saved_mean, saved_inv,
                 jnp.zeros((0,), xv.dtype))
 
     return primitive("fused_batch_norm_act", fn,
@@ -1047,8 +1057,8 @@ def fused_batch_norm_act(x, scale, bias, mean, variance, momentum=0.9,
 
 def fused_bn_add_activation(x, z, scale, bias, mean, variance, momentum=0.9,
                             epsilon=1e-5, act_type="relu"):
-    """(reference fused op: fused_bn_add_activation) — BN(x) + z, then
-    activation; the residual-add fusion of ResNet trunks."""
+    """(reference fused op: fused_bn_add_activation) — training BN(x) + z,
+    then activation; the residual-add fusion of ResNet trunks."""
     from ..core.dispatch import primitive
     from . import activation as act_mod
 
@@ -1057,13 +1067,14 @@ def fused_bn_add_activation(x, z, scale, bias, mean, variance, momentum=0.9,
     def fn(xv, zv, sv, bv, mv, vv):
         import jax.numpy as jnp
 
-        y, inv = _bn_infer(xv, sv, bv, mv, vv, epsilon)
+        y, mean_out, var_out, saved_mean, saved_inv = _bn_train(
+            xv, sv, bv, mv, vv, momentum, epsilon)
         y = y + zv
         if act_type:
             from ..core.tensor import unwrap
 
             y = unwrap(act(y))
-        return (y, mv, vv, mv, jnp.reshape(inv, (-1,)),
+        return (y, mean_out, var_out, saved_mean, saved_inv,
                 jnp.zeros((0,), xv.dtype))
 
     return primitive("fused_bn_add_activation", fn,

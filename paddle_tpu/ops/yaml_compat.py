@@ -65,8 +65,24 @@ def memory_efficient_attention(query, key, value, bias=None, cu_seqlens_q=None,
     # import from the SUBMODULE path: the package re-exports a function of
     # the same name that would shadow `nn.functional.flash_attention`
     from ..nn.functional.attention import scaled_dot_product_attention
-    from ..nn.functional.flash_attention import flash_attention as _flash
+    from ..nn.functional.flash_attention import (
+        flash_attention as _flash,
+        flash_attn_unpadded as _flash_varlen,
+    )
 
+    if scale is not None:
+        # the flash path scales by 1/sqrt(head_dim); pre-scaling the query
+        # by scale*sqrt(head_dim) yields the requested effective scale
+        import math as _math
+
+        d = unwrap(query).shape[-1]
+        query = query * float(scale) * _math.sqrt(d)
+    if cu_seqlens_q is not None:
+        return _flash_varlen(
+            query, key, value, cu_seqlens_q,
+            cu_seqlens_k if cu_seqlens_k is not None else cu_seqlens_q,
+            max_seqlen_q, max_seqlen_k, dropout=dropout_p, causal=causal,
+            training=not is_test)[0]
     if bias is not None:
         return scaled_dot_product_attention(
             query, key, value, attn_mask=bias, dropout_p=dropout_p,

