@@ -1,19 +1,32 @@
 """DistEngine — static auto-parallel engine equivalent.
 
 Reference: python/paddle/distributed/auto_parallel/static/engine.py:98
-(prepare/fit/evaluate over a distributed program built by completion.py +
-partitioner.py + reshard.py). TPU-native: the "distributed program" is the
-whole-step jit of the sharded model — GSPMD performs completion (dist-attr
-propagation), partitioning (per-device program) and reshard (collective
-insertion) inside XLA.
+(prepare/fit/evaluate/predict over a distributed program built by
+completion.py + partitioner.py + reshard.py, scored by the cost model and
+transformed by the pass pipeline). TPU-native: the "distributed program"
+is the whole-step jit of the sharded model — GSPMD performs completion
+(dist-attr propagation), partitioning (per-device program) and reshard
+(collective insertion) inside XLA. What remains engine-side, and lives
+here:
+
+- **planning** (prepare): candidate mesh shapes pruned by the memory model
+  and RANKED by the analytic step-cost model (compute + dp/mp comm + pp
+  bubble — planner.estimate_step_cost), the reference's cost-model pass;
+- **partitioning**: when the plan has mp>1, parameters are placed sharded
+  over the mp axis (largest divisible dim) — GSPMD propagates and inserts
+  the collectives, the reference partitioner's job;
+- **pass pipeline**: named passes applied when building the train step —
+  "sharding_stage1/2" (ZeRO optimizer-state sharding), "amp" (bf16 O2
+  decorate), mirroring the reference's pass_base registry.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
 
 class DistEngine:
-    def __init__(self, layer, loader=None, loss=None, optimizer=None, strategy=None):
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
         from ...jit.api import TrainStep
 
         self._layer = layer
@@ -23,37 +36,107 @@ class DistEngine:
         self._strategy = strategy
         self._step: Optional[TrainStep] = None
         self._plan = None
+        self._passes: List[str] = []
+        self.cost_report: List[dict] = []
 
     def prepare(self, batch_size: Optional[int] = None, seq_len: Optional[int] = None,
                 hbm_bytes: int = 16 << 30, n_devices: Optional[int] = None,
-                mode: str = "auto"):
-        """Plan the mesh (dp/mp/pp degrees) for this model WITHOUT user
-        input, then initialize the hybrid environment (reference:
-        static/engine.py:98 prepare() over completion + planner; search tier
-        auto_tuner/prune.py). Returns the chosen Plan."""
+                mode: str = "auto", passes: Optional[List[str]] = None,
+                shard_params: bool = True):
+        """Plan the mesh for this model WITHOUT user input: enumerate
+        candidates, prune by memory, rank by the step-cost model, then
+        initialize the hybrid environment and (mp>1) shard the parameters
+        (reference static/engine.py:98 prepare → completion + planner +
+        partitioner). Returns the chosen Plan; the scored candidate list is
+        kept in ``cost_report``."""
         import jax
 
         from .. import fleet
-        from .planner import ModelSpec, choose_plan
+        from .planner import ModelSpec, estimate_step_cost, iter_feasible
 
+        known_passes = {"sharding_stage1", "sharding_stage2", "amp"}
+        bad = [p for p in (passes or []) if p not in known_passes]
+        if bad:
+            raise ValueError(f"unknown engine pass(es) {bad}; "
+                             f"known: {sorted(known_passes)}")
         n = n_devices or len(jax.devices())
+        bs = batch_size or max(n, 8)
         spec = ModelSpec.from_model(self._layer, seq_len=seq_len)
-        self._plan = choose_plan(spec, n, batch_size or max(n, 8),
-                                 hbm_bytes=hbm_bytes)
+        self.cost_report = []
+        best, best_cost = None, float("inf")
+        for plan, why in iter_feasible(spec, n, bs, hbm_bytes=hbm_bytes):
+            if why == "infeasible":
+                continue
+            if why is not None:
+                self.cost_report.append(
+                    {"plan": (plan.dp, plan.mp, plan.pp), "pruned": why,
+                     "bytes": plan.per_device_bytes})
+                continue
+            cost = estimate_step_cost(spec, bs, plan)
+            self.cost_report.append(
+                {"plan": (plan.dp, plan.mp, plan.pp),
+                 "bytes": plan.per_device_bytes, **cost})
+            if cost["step_seconds"] < best_cost:
+                best, best_cost = plan, cost["step_seconds"]
+        if best is None:
+            raise ValueError(
+                f"no feasible parallel plan for {n} devices within "
+                f"{hbm_bytes / 2**30:.0f} GiB/device")
+        best.reason = (f"cost-model best of {len(self.cost_report)} "
+                       f"candidates: ~{best_cost * 1e3:.2f} ms/step est")
+        self._plan = best
+        self._passes = list(passes or [])
         strategy = fleet.DistributedStrategy()
-        strategy.hybrid_configs = self._plan.degrees
+        strategy.hybrid_configs = best.degrees
         fleet.init(is_collective=True, strategy=strategy)
+        if shard_params and best.mp > 1:
+            self._shard_parameters("mp")
         return self._plan
+
+    def _shard_parameters(self, axis: str):
+        """GSPMD partitioning: place each parameter sharded over ``axis``
+        on its largest divisible dim; XLA propagates the layouts through
+        the step and inserts the collectives (the reference partitioner +
+        reshard passes)."""
+        from .. import env as env_mod
+        from ..env import shard_largest_dim
+
+        jmesh = env_mod.get_mesh()
+        for p in self._layer.parameters():
+            p._replace_value(shard_largest_dim(p._value, jmesh, axis))
+
+    def _apply_passes(self):
+        if getattr(self, "_passes_applied", False):
+            return  # model/optimizer transforms must not re-wrap on retry
+        self._passes_applied = True
+        for name in self._passes:
+            if name in ("sharding_stage1", "sharding_stage2"):
+                from ..sharding import group_sharded_parallel
+
+                level = "os" if name.endswith("1") else "os_g"
+                self._layer, self._optimizer, _ = group_sharded_parallel(
+                    self._layer, self._optimizer, level=level)
+            elif name == "amp":
+                from ... import amp as amp_mod
+
+                amp_mod.decorate(self._layer, level="O2", dtype="bfloat16")
+            else:
+                raise ValueError(f"unknown engine pass {name!r} "
+                                 "(sharding_stage1|sharding_stage2|amp)")
 
     def _ensure_step(self):
         if self._step is None:
             from ...jit.api import TrainStep
 
+            self._apply_passes()
+
             def loss_fn(x, y):
                 out = self._layer(x)
                 return self._loss(out, y)
 
-            self._step = TrainStep(model=self._layer, optimizer=self._optimizer, loss_fn=loss_fn)
+            self._step = TrainStep(model=self._layer,
+                                   optimizer=self._optimizer,
+                                   loss_fn=loss_fn)
         return self._step
 
     # reference Engine surface
@@ -102,6 +185,24 @@ class DistEngine:
             if was_training:
                 self._layer.train()
         return outs
+
+    def save(self, path: str):
+        """reference engine.save: model + optimizer state."""
+        from ...framework.io import save
+
+        save(self._layer.state_dict(), path + ".pdparams")
+        if self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str):
+        from ...framework.io import load
+
+        self._layer.set_state_dict(load(path + ".pdparams"))
+        if self._optimizer is not None:
+            import os
+
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(load(path + ".pdopt"))
 
     def dist_main_program(self, mode="train"):
         step = self._ensure_step()

@@ -180,23 +180,37 @@ def feasible(spec: ModelSpec, batch_size: int, dp: int, mp: int, pp: int,
     return True
 
 
+def iter_feasible(spec: ModelSpec, n_devices: int, batch_size: int,
+                  hbm_bytes: int = 16 << 30, max_mp: int = 8,
+                  use_sep: bool = False):
+    """Yield (plan, pruned_reason) over the candidate grid — the single
+    enumeration/pruning rule set shared by choose_plan, the DistEngine cost
+    model and the AutoTuner (divisibility prunes per auto_tuner/prune.py,
+    memory prunes per the HBM estimate, mp capped at max_mp: tensor
+    parallelism past one slice's ICI is never chosen automatically).
+    pruned_reason is None for survivors."""
+    for dp, mp, pp, sep in _factorizations(n_devices):
+        if not use_sep and sep != 1:
+            continue
+        if mp > max_mp:
+            yield Plan(dp, mp, pp, sep), "mp_cap"
+            continue
+        if not feasible(spec, batch_size, dp, mp, pp, sep):
+            yield Plan(dp, mp, pp, sep), "infeasible"
+            continue
+        mem = estimate_per_device_bytes(spec, batch_size, dp, mp, pp, sep)
+        plan = Plan(dp, mp, pp, sep, per_device_bytes=mem)
+        yield plan, ("oom" if mem > hbm_bytes else None)
+
+
 def choose_plan(spec: ModelSpec, n_devices: int, batch_size: int,
                 hbm_bytes: int = 16 << 30, max_mp: int = 8,
                 use_sep: bool = False) -> Plan:
     """Greedy chooser over the pruned candidate grid."""
     best: Optional[Plan] = None
-    candidates = []
-    for dp, mp, pp, sep in _factorizations(n_devices):
-        if not use_sep and sep != 1:
-            continue
-        if mp > max_mp:
-            continue
-        if not feasible(spec, batch_size, dp, mp, pp, sep):
-            continue
-        mem = estimate_per_device_bytes(spec, batch_size, dp, mp, pp, sep)
-        if mem > hbm_bytes:
-            continue
-        candidates.append(Plan(dp, mp, pp, sep, per_device_bytes=mem))
+    candidates = [p for p, why in iter_feasible(
+        spec, n_devices, batch_size, hbm_bytes, max_mp, use_sep)
+        if why is None]
     if not candidates:
         raise ValueError(
             f"no feasible parallel plan for {n_devices} devices, "
@@ -210,3 +224,36 @@ def choose_plan(spec: ModelSpec, n_devices: int, batch_size: int,
         f"dp-first greedy over {len(candidates)} feasible configs; "
         f"~{best.per_device_bytes / 2**30:.2f} GiB/device")
     return best
+
+
+def estimate_step_cost(spec: ModelSpec, batch_size: int, plan: Plan,
+                       device_tflops: float = 197.0,
+                       ici_gbps: float = 100.0) -> dict:
+    """Relative step-time model over a candidate plan (the reference
+    Engine's cost-model pass, auto_parallel/static/cost/: compute + comm +
+    bubble). Absolute numbers are nominal (bf16 peak, ICI link bw); only
+    the RANKING between candidates matters.
+
+    - compute: 6·tokens·params FLOPs split over all devices;
+    - dp comm: one gradient all-reduce per step, 2·(dp-1)/dp ring factor;
+    - mp comm: two activation all-reduces per layer (Megatron row+column),
+      on the critical path;
+    - pp bubble: (p-1)/(m+p-1) idle fraction on top of compute.
+    """
+    n = plan.dp * plan.mp * plan.pp * plan.sep
+    tokens = batch_size * spec.seq_len
+    flops = 6.0 * tokens * spec.num_params
+    compute_s = flops / (n * device_tflops * 1e12)
+    grad_bytes = 2.0 * spec.num_params / (plan.mp * plan.pp)
+    dp_comm_s = (2.0 * (plan.dp - 1) / max(plan.dp, 1)
+                 * grad_bytes / (ici_gbps * 1e9)) if plan.dp > 1 else 0.0
+    act_bytes = 2.0 * tokens / plan.dp * spec.hidden_size / plan.sep
+    mp_comm_s = (2.0 * spec.num_layers * 2.0 * (plan.mp - 1) / plan.mp
+                 * act_bytes / (ici_gbps * 1e9)) if plan.mp > 1 else 0.0
+    micro = max((batch_size // plan.dp), 1)
+    m = max(micro // max(plan.pp, 1), 1) if plan.pp > 1 else 1
+    bubble = (plan.pp - 1) / (m + plan.pp - 1) if plan.pp > 1 else 0.0
+    step_s = (compute_s + mp_comm_s) / max(1.0 - bubble, 1e-6) + dp_comm_s
+    return {"step_seconds": step_s, "compute_seconds": compute_s,
+            "dp_comm_seconds": dp_comm_s, "mp_comm_seconds": mp_comm_s,
+            "pp_bubble_fraction": bubble}

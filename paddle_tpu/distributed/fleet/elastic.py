@@ -106,6 +106,70 @@ class ElasticManager:
             return ElasticStatus.RESTART
         return ElasticStatus.HOLD
 
+    def survivors(self) -> list:
+        """Ranks with fresh heartbeats, self included (the live node set the
+        reference manager derives from etcd watch events). The per-rank GET
+        uses a SHORT timeout: the store blocks on missing keys, and a rank
+        that crashed before registering must read as dead in ~node_timeout,
+        not stall the recovery path for the store's default 30 s each."""
+        now = time.time()
+        probe_timeout = min(self.node_timeout, 2.0)
+        live = []
+        for r in range(self.world_size):
+            if r == self.rank:
+                live.append(r)
+                continue
+            try:
+                raw = self.store.get(self._hb_key(r), probe_timeout)
+            except TypeError:  # store without a timeout parameter
+                try:
+                    raw = self.store.get(self._hb_key(r))
+                except Exception:
+                    continue
+            except Exception:
+                continue
+            try:
+                if now - float(raw.decode()) <= self.node_timeout:
+                    live.append(r)
+            except (ValueError, AttributeError):
+                continue
+        return live
+
+    def replan(self, degrees=None, devices=None):
+        """Scale-in/out re-plan (reference manager.py:125: the node set
+        changed → compute the new world → relaunch under it). In the
+        single-controller SPMD runtime this means: shrink world_size to the
+        surviving node set, bump the job generation, and REBUILD the device
+        mesh for the new world — the distributed checkpoint loader then
+        reshards state onto the new topology on load (load-time reshard is
+        structural, checkpoint/load_state_dict.py).
+
+        degrees: optional mesh axis degrees for the new plan (defaults to
+        pure dp over the surviving world); devices: optional explicit device
+        list (defaults to a proportional slice of jax.devices()).
+        """
+        import jax
+
+        from .. import env as env_mod
+
+        live = self.survivors()
+        old_world, new_world = self.world_size, len(live)
+        self.world_size = new_world
+        self.store.add(f"elastic/{self.job_id}/generation", 1)
+        if devices is None:
+            all_dev = list(jax.devices())
+            per_node = max(len(all_dev) // max(old_world, 1), 1)
+            devices = all_dev[: per_node * new_world] or all_dev[:1]
+        env = env_mod.instance()
+        degrees = dict(degrees or {})
+        for ax in env_mod.HYBRID_AXES:
+            degrees.setdefault(ax, -1 if ax == "dp" else 1)
+        mesh = env.build_mesh(degrees, devices=devices)
+        get_logger().warning(
+            "elastic replan: world %d -> %d, mesh %s", old_world, new_world,
+            dict(mesh.shape))
+        return mesh
+
     def _completed(self) -> bool:
         try:
             # add(0) is an atomic read-or-create: unlike get, it never blocks

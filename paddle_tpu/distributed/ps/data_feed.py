@@ -53,7 +53,12 @@ def _parse_line(line: str, slots: Sequence[Slot]):
                              f"line has {len(vals)}")
         pos += n
         if slot.is_sparse:
-            out.append(np.array([int(v) for v in vals], np.int64))
+            # ids are 64-bit feature hashes: parse the full uint64 range,
+            # stored as the bit-equivalent int64 (embedding tables key on
+            # the 64-bit pattern; int(v) into int64 would overflow on any
+            # hash with the top bit set)
+            out.append(np.array([np.uint64(v) for v in vals],
+                                np.uint64).view(np.int64))
         else:
             arr = np.array([float(v) for v in vals], np.float32)
             if arr.size != slot.dim:

@@ -110,6 +110,11 @@ def _layer_norm(layer, x, b):
 
 def _pool2d(kind):
     def conv(layer, x, b):
+        kw = getattr(layer, "kw", {})
+        if kw.get("ceil_mode") or kw.get("divisor_override"):
+            raise NotImplementedError(
+                "onnx.export: ceil_mode/divisor_override pooling is not "
+                "converted; use the StableHLO bundle")
         ks = layer.kernel_size
         ks = ks if isinstance(ks, (list, tuple)) else (ks, ks)
         stride = layer.stride if layer.stride is not None else ks
