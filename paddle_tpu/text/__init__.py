@@ -145,3 +145,174 @@ class Imikolov(_FileDataset):
 
 
 __all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing", "Imdb", "Imikolov"]
+
+
+class Movielens(_FileDataset):
+    """MovieLens ml-1m (reference text/datasets/movielens.py): data_file is
+    the extracted ml-1m directory (or any dir holding users.dat /
+    movies.dat / ratings.dat, '::'-delimited). Samples follow the
+    reference's feature layout: (user_id, gender_id, age_id, job_id,
+    movie_id, category_ids, title_ids, rating)."""
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        if not data_file or not os.path.isdir(data_file):
+            raise FileNotFoundError(
+                "Movielens needs the extracted ml-1m directory "
+                f"(data_file={data_file!r})")
+        self.data_file = data_file
+        self.mode = mode
+        self._samples = []
+        self._load()
+
+    def _read(self, name):
+        with open(os.path.join(self.data_file, name), encoding="latin-1") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line.split("::")
+
+    def _load(self):
+        age_idx = {a: i for i, a in enumerate(self.AGES)}
+        users = {}
+        for uid, gender, age, job, _zip in self._read("users.dat"):
+            users[int(uid)] = (int(uid), 0 if gender == "M" else 1,
+                               age_idx.get(int(age), 0), int(job))
+        movies = {}
+        cat_vocab, title_vocab = {}, {}
+        for mid, title, genres in self._read("movies.dat"):
+            cats = [cat_vocab.setdefault(c, len(cat_vocab))
+                    for c in genres.split("|")]
+            words = [title_vocab.setdefault(w, len(title_vocab))
+                     for w in title.split()]
+            movies[int(mid)] = (int(mid), np.array(cats, np.int64),
+                                np.array(words, np.int64))
+        self.categories_dict = cat_vocab
+        self.movie_title_dict = title_vocab
+        rs = np.random.RandomState(self.rand_seed)
+        for uid, mid, rating, _ts in self._read("ratings.dat"):
+            uid, mid = int(uid), int(mid)
+            if uid not in users or mid not in movies:
+                continue
+            is_test = rs.rand() < self.test_ratio
+            if is_test != (self.mode == "test"):
+                continue
+            u = users[uid]
+            m = movies[mid]
+            self._samples.append(
+                (np.int64(u[0]), np.int64(u[1]), np.int64(u[2]),
+                 np.int64(u[3]), np.int64(m[0]), m[1], m[2],
+                 np.float32(rating)))
+
+
+class Conll05st(_FileDataset):
+    """CoNLL-2005 SRL (reference text/datasets/conll05.py): data_file is a
+    directory with ``words`` and ``props`` files (one token per line, blank
+    line between sentences — the test.wsj layout). Yields
+    (word_ids, predicate_id, label_ids) with vocabularies built from the
+    data; pass word_dict/label_dict to reuse training vocab."""
+
+    def __init__(self, data_file=None, mode="train", word_dict=None,
+                 label_dict=None):
+        self.word_dict = dict(word_dict or {})
+        self.label_dict = dict(label_dict or {})
+        if not data_file or not os.path.isdir(data_file):
+            raise FileNotFoundError(
+                "Conll05st needs a directory with words/props files "
+                f"(data_file={data_file!r})")
+        self.data_file = data_file
+        self.mode = mode
+        self._samples = []
+        self._load()
+
+    @staticmethod
+    def _sentences(path):
+        sent = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    if sent:
+                        yield sent
+                        sent = []
+                else:
+                    sent.append(line.split())
+        if sent:
+            yield sent
+
+    def _load(self):
+        words_path = os.path.join(self.data_file, "words")
+        props_path = os.path.join(self.data_file, "props")
+        for words, props in zip(self._sentences(words_path),
+                                self._sentences(props_path)):
+            toks = [w[0].lower() for w in words]
+            wids = np.array([self.word_dict.setdefault(t, len(self.word_dict))
+                             for t in toks], np.int64)
+            # props: col 0 = predicate lemma ('-' if none), col 1+ = tag
+            # sequences, one column per predicate (reference layout)
+            n_cols = len(props[0]) - 1
+            for col in range(1, n_cols + 1):
+                tags = [p[col] for p in props]
+                pred_rows = [i for i, p in enumerate(props) if p[0] != "-"]
+                pred_i = pred_rows[col - 1] if col - 1 < len(pred_rows) else 0
+                lids = np.array(
+                    [self.label_dict.setdefault(t, len(self.label_dict))
+                     for t in tags], np.int64)
+                self._samples.append((wids, np.int64(wids[pred_i]), lids))
+
+
+class WMT16(_FileDataset):
+    """WMT16 en-de (reference text/datasets/wmt16.py): data_file is a
+    directory holding ``{mode}.src`` / ``{mode}.trg`` token-per-space
+    files (the reference's tokenized layout extracted from its tar).
+    Builds source/target vocabularies capped at src/trg_dict_size with the
+    reference's <s>/<e>/<unk> specials; yields
+    (src_ids, trg_ids[:-1], trg_ids[1:])."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en"):
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.lang = lang
+        super().__init__(data_file, mode)
+
+    def _vocab(self, lines, cap):
+        from collections import Counter
+
+        counts = Counter(w for l in lines for w in l)
+        vocab = {"<s>": self.BOS, "<e>": self.EOS, "<unk>": self.UNK}
+        for w, _ in counts.most_common():
+            if 0 < cap <= len(vocab):
+                break
+            vocab.setdefault(w, len(vocab))
+        return vocab
+
+    def _load(self):
+        if not os.path.isdir(self.data_file):
+            raise FileNotFoundError(
+                f"WMT16 needs a directory (data_file={self.data_file!r})")
+
+        def read(suffix):
+            path = os.path.join(self.data_file, f"{self.mode}.{suffix}")
+            with open(path, encoding="utf-8") as f:
+                return [l.strip().split() for l in f if l.strip()]
+
+        src_lines, trg_lines = read("src"), read("trg")
+        self.src_dict = self._vocab(src_lines, self.src_dict_size)
+        self.trg_dict = self._vocab(trg_lines, self.trg_dict_size)
+        for s, t in zip(src_lines, trg_lines):
+            sid = np.array([self.src_dict.get(w, self.UNK) for w in s],
+                           np.int64)
+            tid = np.array([self.BOS] + [self.trg_dict.get(w, self.UNK)
+                                         for w in t] + [self.EOS], np.int64)
+            self._samples.append((sid, tid[:-1], tid[1:]))
+
+    def get_dict(self, lang="en", reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)

@@ -1,0 +1,53 @@
+"""Text dataset family tests (VERDICT r4 missing #4's text half; reference
+python/paddle/text/datasets/{movielens,conll05,wmt16}.py)."""
+import numpy as np
+
+import paddle_tpu as paddle  # noqa: F401
+from paddle_tpu.text import Conll05st, Movielens, WMT16
+
+
+def test_movielens_parses_ml1m_layout(tmp_path):
+    d = tmp_path / "ml-1m"
+    d.mkdir()
+    (d / "users.dat").write_text(
+        "1::M::25::10::48067\n2::F::35::3::55117\n")
+    (d / "movies.dat").write_text(
+        "10::Toy Story (1995)::Animation|Comedy\n"
+        "20::Heat (1995)::Action|Crime\n")
+    (d / "ratings.dat").write_text(
+        "1::10::5::978300760\n1::20::3::978302109\n2::10::4::978301968\n")
+    ds = Movielens(data_file=str(d), mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    uid, gender, age, job, mid, cats, title, rating = ds[0]
+    assert uid == 1 and gender == 0 and mid == 10 and rating == 5.0
+    assert cats.dtype == np.int64 and len(title) >= 2
+    assert len(ds.categories_dict) == 4
+
+
+def test_conll05_srl_columns(tmp_path):
+    d = tmp_path / "conll"
+    d.mkdir()
+    (d / "words").write_text("The\ncat\nsat\n\nDogs\nbark\n\n")
+    (d / "props").write_text(
+        "-\tB-A0\nsit\tB-V\n-\tI-A0\n\nbark\tB-V\n-\tB-A0\n\n"
+        .replace("\t", " "))
+    ds = Conll05st(data_file=str(d))
+    assert len(ds) == 2
+    wids, pred, labels = ds[0]
+    assert len(wids) == 3 and len(labels) == 3
+    assert labels.dtype == np.int64
+
+
+def test_wmt16_vocab_and_shifted_targets(tmp_path):
+    d = tmp_path / "wmt"
+    d.mkdir()
+    (d / "train.src").write_text("a b c\nb c d\n")
+    (d / "train.trg").write_text("x y\ny z\n")
+    ds = WMT16(data_file=str(d), mode="train", src_dict_size=5,
+               trg_dict_size=5)
+    assert len(ds) == 2
+    src, trg_in, trg_out = ds[0]
+    assert trg_in[0] == WMT16.BOS and trg_out[-1] == WMT16.EOS
+    np.testing.assert_array_equal(trg_in[1:], trg_out[:-1])
+    rev = ds.get_dict("de", reverse=True)
+    assert rev[WMT16.BOS] == "<s>"
