@@ -9,6 +9,7 @@ ops are the real compute surface and run compiled (lax.scan DP).
 from __future__ import annotations
 
 import os
+import re
 import tarfile
 from typing import List, Optional
 
@@ -144,7 +145,8 @@ class Imikolov(_FileDataset):
                 self._samples.append(np.asarray(ids[i:i + self.window_size], np.int64))
 
 
-__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing", "Imdb", "Imikolov"]
+__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing", "Imdb",
+           "Imikolov", "Movielens", "Conll05st", "WMT16"]
 
 
 class Movielens(_FileDataset):
@@ -160,14 +162,11 @@ class Movielens(_FileDataset):
                  rand_seed=0):
         self.test_ratio = test_ratio
         self.rand_seed = rand_seed
-        if not data_file or not os.path.isdir(data_file):
+        if data_file and not os.path.isdir(data_file):
             raise FileNotFoundError(
-                "Movielens needs the extracted ml-1m directory "
+                "Movielens needs the extracted ml-1m DIRECTORY "
                 f"(data_file={data_file!r})")
-        self.data_file = data_file
-        self.mode = mode
-        self._samples = []
-        self._load()
+        super().__init__(data_file, mode)
 
     def _read(self, name):
         with open(os.path.join(self.data_file, name), encoding="latin-1") as f:
@@ -187,8 +186,11 @@ class Movielens(_FileDataset):
         for mid, title, genres in self._read("movies.dat"):
             cats = [cat_vocab.setdefault(c, len(cat_vocab))
                     for c in genres.split("|")]
+            # reference movielens.py: strip the trailing "(YYYY)" year and
+            # lowercase before building the title vocabulary
+            clean = re.sub(r"\s*\(\d{4}\)\s*$", "", title).lower()
             words = [title_vocab.setdefault(w, len(title_vocab))
-                     for w in title.split()]
+                     for w in clean.split()]
             movies[int(mid)] = (int(mid), np.array(cats, np.int64),
                                 np.array(words, np.int64))
         self.categories_dict = cat_vocab
@@ -217,17 +219,15 @@ class Conll05st(_FileDataset):
     data; pass word_dict/label_dict to reuse training vocab."""
 
     def __init__(self, data_file=None, mode="train", word_dict=None,
-                 label_dict=None):
+                 label_dict=None, test_ratio=0.1):
         self.word_dict = dict(word_dict or {})
         self.label_dict = dict(label_dict or {})
-        if not data_file or not os.path.isdir(data_file):
+        self.test_ratio = test_ratio
+        if data_file and not os.path.isdir(data_file):
             raise FileNotFoundError(
-                "Conll05st needs a directory with words/props files "
+                "Conll05st needs a DIRECTORY with words/props files "
                 f"(data_file={data_file!r})")
-        self.data_file = data_file
-        self.mode = mode
-        self._samples = []
-        self._load()
+        super().__init__(data_file, mode)
 
     @staticmethod
     def _sentences(path):
@@ -247,8 +247,13 @@ class Conll05st(_FileDataset):
     def _load(self):
         words_path = os.path.join(self.data_file, "words")
         props_path = os.path.join(self.data_file, "props")
-        for words, props in zip(self._sentences(words_path),
-                                self._sentences(props_path)):
+        every = max(int(round(1.0 / self.test_ratio)), 2)
+        for si, (words, props) in enumerate(zip(
+                self._sentences(words_path), self._sentences(props_path))):
+            # deterministic mode split: every Nth sentence is the test fold
+            is_test = (si % every) == every - 1
+            if is_test != (self.mode == "test"):
+                continue
             toks = [w[0].lower() for w in words]
             wids = np.array([self.word_dict.setdefault(t, len(self.word_dict))
                              for t in toks], np.int64)
@@ -301,9 +306,16 @@ class WMT16(_FileDataset):
         def read(suffix):
             path = os.path.join(self.data_file, f"{self.mode}.{suffix}")
             with open(path, encoding="utf-8") as f:
-                return [l.strip().split() for l in f if l.strip()]
+                return [l.strip().split() for l in f]  # keep blanks: row = pair
 
-        src_lines, trg_lines = read("src"), read("trg")
+        src_all, trg_all = read("src"), read("trg")
+        if len(src_all) != len(trg_all):
+            raise ValueError(
+                f"WMT16 parallel files misaligned: {len(src_all)} src rows "
+                f"vs {len(trg_all)} trg rows — same line count required")
+        pairs = [(s, t) for s, t in zip(src_all, trg_all) if s and t]
+        src_lines = [s for s, _ in pairs]
+        trg_lines = [t for _, t in pairs]
         self.src_dict = self._vocab(src_lines, self.src_dict_size)
         self.trg_dict = self._vocab(trg_lines, self.trg_dict_size)
         for s, t in zip(src_lines, trg_lines):

@@ -26,3 +26,9 @@ from .small import (  # noqa: F401
     vgg16,
     vgg19,
 )
+from .segdet import (  # noqa: F401
+    PPLiteSeg,
+    PPYOLOE,
+    pp_liteseg,
+    pp_yoloe,
+)
