@@ -325,7 +325,10 @@ def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
                         ds, clip_bbox=clip_bbox, scale_x_y=scale_x_y)
         outs.append((b, s))
     boxes = jnp.concatenate([unwrap(b) for b, _ in outs], 1)
-    scores = jnp.concatenate([unwrap(s) for _, s in outs], 2)
+    # yolo_box emits scores [B, M, C]; multiclass_nms3 takes the Paddle
+    # [B, C, M] layout — transpose per scale, concat along the box axis
+    scores = jnp.concatenate(
+        [jnp.swapaxes(unwrap(s), 1, 2) for _, s in outs], 2)
     out, idx, cnt = multiclass_nms3(Tensor(boxes), Tensor(scores),
                                     nms_threshold=nms_threshold,
                                     score_threshold=conf_thresh)

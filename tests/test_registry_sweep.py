@@ -583,6 +583,9 @@ from sweep_cases_ext import register_alias_cases as _register_alias  # noqa: E40
 
 _register_ext(_add, _arr)
 _register_alias(_add, _arr)
+from sweep_cases_ext import register_tail as _register_tail  # noqa: E402
+
+_register_tail(_add, _arr)
 
 # Smooth ops from the extension batch get central-difference grad checks
 # wrt every float input (discrete/kinky ops — argsort, round, relu-fused,
@@ -677,8 +680,15 @@ def test_sweep_accounting():
     """Ratchet: the sweep must numerically exercise a floor of dense ops,
     and every case tagged for grad checking has a YAML backward entry."""
     dense_cases = [n for n in CASES if OP_DEFS[n]["tier"] == "dense"]
-    assert len(dense_cases) >= 400, len(dense_cases)
-    assert len(GRAD_CASES) >= 180, len(GRAD_CASES)
+    assert len(dense_cases) >= 470, len(dense_cases)
+    assert len(GRAD_CASES) >= 195, len(GRAD_CASES)
+    # full-tier coverage: every RESOLVING dense op has a numeric case
+    from paddle_tpu.ops import registry as _reg
+
+    resolving = [n for n, d in OP_DEFS.items()
+                 if d["tier"] == "dense" and _reg.get_op(n)]
+    uncovered = [n for n in resolving if n not in CASES]
+    assert not uncovered, f"dense ops without sweep cases: {uncovered}"
 
 
 def test_every_alias_has_semantic_case():
