@@ -1,3 +1,129 @@
 """paddle.audio parity (reference: python/paddle/audio/ — functional
 weighting/window helpers + feature layers over the signal stft)."""
 from . import features, functional  # noqa: F401
+
+
+# ---- datasets (reference python/paddle/audio/datasets/{esc50,tess}.py) -----
+
+class _AudioDataset:
+    """Base (reference audio/datasets/dataset.py::AudioClassificationDataset):
+    wav files → waveform or feature arrays + labels. File-backed (no
+    egress): pass the extracted archive directory."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **feat_kwargs):
+        import numpy as np
+
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_kwargs = feat_kwargs
+        if feat_type not in ("raw", "spectrogram", "melspectrogram",
+                             "logmelspectrogram", "mfcc"):
+            raise ValueError(f"feat_type {feat_type!r}")
+        self._np = np
+
+    def _read_wav(self, path):
+        import wave
+
+        import numpy as np
+
+        with wave.open(path, "rb") as w:
+            sr = w.getframerate()
+            channels = w.getnchannels()
+            width = w.getsampwidth()
+            raw = w.readframes(w.getnframes())
+        dtype = {1: np.int8, 2: np.int16, 4: np.int32}[width]
+        data = np.frombuffer(raw, dtype).astype(np.float32)
+        data /= float(np.iinfo(dtype).max)
+        if channels > 1:
+            data = data.reshape(-1, channels).mean(-1)
+        return data, sr
+
+    def _features(self, wav, sr):
+        import paddle_tpu as P
+
+        from . import features as feats
+
+        if self.feat_type == "raw":
+            return wav
+        cls = {"spectrogram": feats.Spectrogram,
+               "melspectrogram": feats.MelSpectrogram,
+               "logmelspectrogram": feats.LogMelSpectrogram,
+               "mfcc": feats.MFCC}[self.feat_type]
+        kw = dict(self.feat_kwargs)
+        if self.feat_type != "spectrogram":
+            kw.setdefault("sr", sr)
+        layer = cls(**kw)
+        out = layer(P.to_tensor(wav[None]))
+        return self._np.asarray(out.numpy())[0]
+
+    def __getitem__(self, idx):
+        import numpy as np
+
+        wav, sr = self._read_wav(self.files[idx])
+        return self._features(wav, sr), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(_AudioDataset):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py):
+    data_dir is the extracted archive (audio/*.wav + meta/esc50.csv).
+    mode='train'/'dev' folds per the csv's fold column (split_fold is the
+    held-out fold, reference default 1)."""
+
+    def __init__(self, data_dir=None, mode="train", split_fold=1,
+                 feat_type="raw", **feat_kwargs):
+        import csv
+        import os
+
+        if not data_dir or not os.path.isdir(data_dir):
+            raise FileNotFoundError(
+                f"ESC50 needs the extracted archive dir (data_dir={data_dir!r})")
+        meta = os.path.join(data_dir, "meta", "esc50.csv")
+        files, labels = [], []
+        with open(meta) as f:
+            for row in csv.DictReader(f):
+                held_out = int(row["fold"]) == int(split_fold)
+                if held_out != (mode == "dev"):
+                    continue
+                files.append(os.path.join(data_dir, "audio", row["filename"]))
+                labels.append(int(row["target"]))
+        super().__init__(files, labels, feat_type=feat_type, **feat_kwargs)
+
+
+class TESS(_AudioDataset):
+    """TESS emotional speech (reference audio/datasets/tess.py): data_dir
+    holds per-speaker folders of ``*_<emotion>.wav`` files; the emotion
+    suffix is the label. n_folds/split deterministic split like the
+    reference."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, data_dir=None, mode="train", n_folds=5, split=1,
+                 feat_type="raw", **feat_kwargs):
+        import os
+
+        if not data_dir or not os.path.isdir(data_dir):
+            raise FileNotFoundError(
+                f"TESS needs the extracted archive dir (data_dir={data_dir!r})")
+        label_idx = {e: i for i, e in enumerate(self.EMOTIONS)}
+        wavs = []
+        for sub, _, names in sorted(os.walk(data_dir)):
+            for name in sorted(names):
+                if not name.lower().endswith(".wav"):
+                    continue
+                emotion = name.rsplit(".", 1)[0].rsplit("_", 1)[-1].lower()
+                if emotion in label_idx:
+                    wavs.append((os.path.join(sub, name), label_idx[emotion]))
+        files, labels = [], []
+        for i, (path, lab) in enumerate(wavs):
+            held_out = (i % n_folds) == (split - 1)
+            if held_out != (mode == "dev"):
+                continue
+            files.append(path)
+            labels.append(lab)
+        super().__init__(files, labels, feat_type=feat_type, **feat_kwargs)
