@@ -34,9 +34,24 @@ class _AudioDataset:
             channels = w.getnchannels()
             width = w.getsampwidth()
             raw = w.readframes(w.getnframes())
-        dtype = {1: np.int8, 2: np.int16, 4: np.int32}[width]
-        data = np.frombuffer(raw, dtype).astype(np.float32)
-        data /= float(np.iinfo(dtype).max)
+        if width == 1:
+            # WAV 8-bit PCM is UNSIGNED, centered at 128
+            data = (np.frombuffer(raw, np.uint8).astype(np.float32)
+                    - 128.0) / 128.0
+        elif width == 3:
+            b = np.frombuffer(raw, np.uint8).reshape(-1, 3)
+            ints = (b[:, 0].astype(np.int32)
+                    | (b[:, 1].astype(np.int32) << 8)
+                    | (b[:, 2].astype(np.int32) << 16))
+            ints = np.where(ints >= 1 << 23, ints - (1 << 24), ints)
+            data = ints.astype(np.float32) / float(1 << 23)
+        elif width in (2, 4):
+            dtype = {2: np.int16, 4: np.int32}[width]
+            data = np.frombuffer(raw, dtype).astype(np.float32)
+            data /= float(np.iinfo(dtype).max)
+        else:
+            raise ValueError(f"unsupported WAV sample width {width} bytes "
+                             f"in {path!r}")
         if channels > 1:
             data = data.reshape(-1, channels).mean(-1)
         return data, sr
@@ -48,14 +63,19 @@ class _AudioDataset:
 
         if self.feat_type == "raw":
             return wav
-        cls = {"spectrogram": feats.Spectrogram,
-               "melspectrogram": feats.MelSpectrogram,
-               "logmelspectrogram": feats.LogMelSpectrogram,
-               "mfcc": feats.MFCC}[self.feat_type]
-        kw = dict(self.feat_kwargs)
-        if self.feat_type != "spectrogram":
-            kw.setdefault("sr", sr)
-        layer = cls(**kw)
+        # one feature layer per (dataset, sample rate): the mel filterbank /
+        # window / DCT matrices are constant, not per-item work
+        cache = self.__dict__.setdefault("_feat_layers", {})
+        layer = cache.get(sr)
+        if layer is None:
+            cls = {"spectrogram": feats.Spectrogram,
+                   "melspectrogram": feats.MelSpectrogram,
+                   "logmelspectrogram": feats.LogMelSpectrogram,
+                   "mfcc": feats.MFCC}[self.feat_type]
+            kw = dict(self.feat_kwargs)
+            if self.feat_type != "spectrogram":
+                kw.setdefault("sr", sr)
+            layer = cache[sr] = cls(**kw)
         out = layer(P.to_tensor(wav[None]))
         return self._np.asarray(out.numpy())[0]
 
@@ -80,6 +100,8 @@ class ESC50(_AudioDataset):
         import csv
         import os
 
+        if mode not in ("train", "dev"):
+            raise ValueError(f"ESC50 mode must be 'train' or 'dev', got {mode!r}")
         if not data_dir or not os.path.isdir(data_dir):
             raise FileNotFoundError(
                 f"ESC50 needs the extracted archive dir (data_dir={data_dir!r})")
@@ -107,6 +129,8 @@ class TESS(_AudioDataset):
                  feat_type="raw", **feat_kwargs):
         import os
 
+        if mode not in ("train", "dev"):
+            raise ValueError(f"TESS mode must be 'train' or 'dev', got {mode!r}")
         if not data_dir or not os.path.isdir(data_dir):
             raise FileNotFoundError(
                 f"TESS needs the extracted archive dir (data_dir={data_dir!r})")

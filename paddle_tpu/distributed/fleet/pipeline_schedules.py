@@ -64,6 +64,29 @@ def chunk_permutation(num_layers: int, num_stages: int, num_chunks: int) -> List
     return order
 
 
+
+def _chunk_run(apply_layer, chunk_leaves, xc, key):
+    """Apply one chunk's layers (lax.scan over the leading layer dim) with
+    ``key`` installed as the framework RNG stream — the single RNG-cell-swap
+    protocol shared by every schedule's forward/recompute path."""
+    def one(xin, layer_leaves):
+        return apply_layer(layer_leaves, xin), None
+
+    def run(cl, xx):
+        return jax.lax.scan(one, xx, cl)[0]
+
+    if key is None:
+        return run(chunk_leaves, xc)
+    from ...base import global_state
+
+    cell = Tensor(key, name="pp_tick_rng", stop_gradient=True)
+    prev = global_state.swap_rng_cell(cell)
+    try:
+        return run(chunk_leaves, xc)
+    finally:
+        global_state.swap_rng_cell(prev)
+
+
 def _solve_tick(t, d, *, p: int, v: int, m: int):
     """Which (local chunk slot j, microbatch i) is active on device d at tick
     t. Microbatch i enters chunk 0 at tick inj_i = (i//p)·v·p + i%p and moves
@@ -149,9 +172,13 @@ def pipeline_spmd(
 
     if schedule in ("1f1b", "eager_1f1b", "zb", "zbh1"):
         if v != 1:
-            raise ValueError(
-                f"schedule={schedule!r} requires num_chunks == 1; interleaved "
-                "VPP stacks use the rotation schedule")
+            if schedule in ("zb", "zbh1"):
+                raise ValueError(
+                    "ZB-H1 covers num_chunks == 1; interleaved stacks use "
+                    "schedule='1f1b' (tick-interleaved VPP) or 'rotation'")
+            return _pipeline_vpp_1f1b(
+                apply_layer, stacked_leaves, x, p=p, v=v, m=m, mesh=mesh,
+                axis=axis, batch_axis=batch_axis, rng_key=rng_key)
         return _pipeline_1f1b(
             apply_layer, stacked_leaves, x, p=p, m=m, mesh=mesh, axis=axis,
             batch_axis=batch_axis, rng_key=rng_key,
@@ -307,23 +334,7 @@ def _pipeline_1f1b(apply_layer, stacked_leaves, x, *, p, m, mesh, axis,
         ring_bwd = [(s, (s - 1) % p) for s in range(p)]
 
         def chunk_run(leaves_chunk, xc, key):
-            """Apply this stage's k layers with the folded RNG installed."""
-            def one(xin, layer_leaves):
-                return apply_layer(layer_leaves, xin), None
-
-            def run(cl, xx):
-                return jax.lax.scan(one, xx, cl)[0]
-
-            if key is None:
-                return run(leaves_chunk, xc)
-            from ...base import global_state
-
-            cell = Tensor(key, name="pp_tick_rng", stop_gradient=True)
-            prev = global_state.swap_rng_cell(cell)
-            try:
-                return run(leaves_chunk, xc)
-            finally:
-                global_state.swap_rng_cell(prev)
+            return _chunk_run(apply_layer, leaves_chunk, xc, key)
 
         def fwd_body(x_mb, rng, *leaves):
             d = jax.lax.axis_index(axis)
@@ -589,6 +600,202 @@ def _pipeline_1f1b(apply_layer, stacked_leaves, x, *, p, m, mesh, axis,
     return out.reshape(x.shape)
 
 
+def _pipeline_vpp_1f1b(apply_layer, stacked_leaves, x, *, p, v, m, mesh,
+                       axis, batch_axis, rng_key):
+    """Tick-interleaved 1F1B for the INTERLEAVED (virtual pipeline) stack
+    (reference pipeline_vpp.py — Megatron VPP is 1F1B-interleaved). Closes
+    the rotation schedule's O(m·v) activation residency for v > 1:
+
+    custom_vjp around the whole pipelined call, like _pipeline_1f1b:
+
+    - fwd: the rotation scan with NO AD (residuals: x_mb, rng, leaves).
+    - bwd: ONE combined scan. With L = v·p global chunks and the rotation
+      injection inj(i) = (i//p)·L + i%p, the sub-tick schedule is
+          F(chunk c, mb i) at u = inj(i) + c
+          B(chunk c, mb i) at u = inj(i) + 2L − 1 − c
+      so B(L−1, i) turns a microbatch around one tick after its last F,
+      dx hops the reverse ring once per tick (chunk c lives on device
+      c % p), and F work fills the backward's warmup exactly as in the
+      flat 1F1B. Chunk inputs park in a per-local-slot ring buffer until
+      the backward tick recomputes the chunk under jax.vjp (same folded
+      key → identical dropout masks) and accumulates parameter grads into
+      the stacked leaves at the slot's row block.
+
+    Per-device live activations: ≤ 4p microbatch inputs per local slot
+    (v slots) — O(v·p), INDEPENDENT of m, vs the rotation schedule's
+    m·v + p − 1 stacked residuals. Ticks: m·v + v·p + p − 1 per direction
+    — the canonical interleaved bubble (p−1)/(m·v + p − 1) plus the drain.
+    """
+    b = x.shape[0]
+    L = v * p
+    mb_shape = (m, b // m) + tuple(x.shape[1:])
+    x_mb = x.reshape(mb_shape)
+    x_spec = P(None, batch_axis, *([None] * (len(mb_shape) - 2)))
+    leaf_specs = tuple(P(axis, *([None] * (a.ndim - 1))) for a in stacked_leaves)
+    has_rng = rng_key is not None
+    rng = rng_key if has_rng else jax.random.PRNGKey(0)
+
+    cache_key = (
+        "vpp1f1b", apply_layer, p, v, m, axis, batch_axis, mesh, has_rng,
+        tuple(mb_shape), str(x_mb.dtype),
+        tuple((tuple(a.shape), str(a.dtype)) for a in stacked_leaves),
+    )
+    jitted = _COMPILED.get(cache_key)
+    if jitted is not None:
+        _COMPILED.move_to_end(cache_key)
+    if jitted is None:
+        ring_fwd = [(s, (s + 1) % p) for s in range(p)]
+        ring_bwd = [(s, (s - 1) % p) for s in range(p)]
+
+        def chunk_run(chunk_leaves, xc, key):
+            return _chunk_run(apply_layer, chunk_leaves, xc, key)
+
+        def slot_chunk(local, j):
+            """local: leaves reshaped (v, k, ...); pick slot j's (k, ...)."""
+            return [jax.lax.dynamic_index_in_dim(a, j, 0, keepdims=False)
+                    for a in local]
+
+        def fwd_body(x_mb, rng, *leaves):
+            d = jax.lax.axis_index(axis)
+            leaves = list(leaves)
+            k = leaves[0].shape[0] // v
+            local = [a.reshape((v, k) + a.shape[1:]) for a in leaves]
+            stage_rng = jax.random.fold_in(rng, d) if has_rng else None
+            T = m * v + p - 1
+            out0 = jnp.zeros(x_mb.shape, x_mb.dtype)
+            cur0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+
+            def tick(carry, t):
+                cur, out = carry
+                j, c, i, active = _solve_tick(t, d, p=p, v=v, m=m)
+                chunk = slot_chunk(local, j)
+                x_in = jnp.where(
+                    c == 0,
+                    jax.lax.dynamic_index_in_dim(x_mb, i, 0, keepdims=False),
+                    cur)
+                key = (jax.random.fold_in(stage_rng, t) if has_rng else None)
+                y = chunk_run(chunk, x_in, key)
+                done = active & (c == L - 1)
+                slot = jax.lax.dynamic_index_in_dim(out, i, 0, keepdims=False)
+                out = jax.lax.dynamic_update_index_in_dim(
+                    out, jnp.where(done, y, slot), i, 0)
+                nxt = jax.lax.ppermute(y, axis, ring_fwd)
+                return (nxt, out), None
+
+            (_, out), _ = jax.lax.scan(tick, (cur0, out0), jnp.arange(T))
+            return jax.lax.psum(out, axis)
+
+        def _solve_b(u, d):
+            """Which (slot j, chunk c, mb i) has its BACKWARD on device d at
+            tick u: B(c, i) at u = inj(i) + 2L − 1 − c, c ∈ {d, d+p, ...}."""
+            cs = d + p * jnp.arange(v)
+            inj = u - (2 * L - 1) + cs
+            r = jnp.mod(inj, L)
+            q = inj // L
+            i_cand = q * p + r
+            valid = (inj >= 0) & (r < p) & (i_cand < m)
+            j = jnp.argmax(valid)
+            c = cs[j]
+            i = jnp.clip(i_cand[j], 0, m - 1)
+            return j, c, i, jnp.any(valid)
+
+        def bwd_body(g, x_mb, rng, *leaves):
+            d = jax.lax.axis_index(axis)
+            leaves = list(leaves)
+            k = leaves[0].shape[0] // v
+            local = [a.reshape((v, k) + a.shape[1:]) for a in leaves]
+            stage_rng = jax.random.fold_in(rng, d) if has_rng else None
+            T2 = m * v + v * p + p - 1
+            nbuf = 4 * p
+            # per-slot parked chunk inputs: [v, nbuf, ...]
+            fbuf0 = jnp.zeros((v, nbuf) + x_mb.shape[1:], x_mb.dtype)
+            fcur0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+            bcur0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+            gacc0 = [jnp.zeros_like(a) for a in local]  # (v, k, ...)
+            dx0 = jnp.zeros(x_mb.shape, x_mb.dtype)
+
+            def tick(carry, u):
+                fbuf, fcur, bcur, gacc, dxout = carry
+                # ---- forward sub-tick: F(c_f, i_f) at u = inj(i_f) + c_f
+                jf, cf, i_f, act_f = _solve_tick(u, d, p=p, v=v, m=m)
+                x_in = jnp.where(
+                    cf == 0,
+                    jax.lax.dynamic_index_in_dim(x_mb, i_f, 0, keepdims=False),
+                    fcur)
+                slot_f = jnp.mod(i_f, nbuf)
+                old = fbuf[jf, slot_f]
+                fbuf = fbuf.at[jf, slot_f].set(jnp.where(act_f, x_in, old))
+                key_f = (jax.random.fold_in(stage_rng, u) if has_rng else None)
+                y = chunk_run(slot_chunk(local, jf), x_in, key_f)
+                # ---- backward sub-tick: B(c_b, i_b) mirrored
+                jb, cb, i_b, act_b = _solve_b(u, d)
+                ct = jnp.where(
+                    cb == L - 1,
+                    jax.lax.dynamic_index_in_dim(g, i_b, 0, keepdims=False),
+                    bcur).astype(x_mb.dtype)
+                x_b = fbuf[jb, jnp.mod(i_b, nbuf)]
+                # refold the key F(c_b, i_b) used: its forward tick
+                u_f = u - 2 * (L - 1 - cb) - 1
+                key_b = (jax.random.fold_in(stage_rng, u_f) if has_rng
+                         else None)
+                _, vjp_fn = jax.vjp(
+                    lambda cl, xx: chunk_run(cl, xx, key_b),
+                    slot_chunk(local, jb), x_b)
+                dchunk, dx = vjp_fn(ct)
+                gacc = [ga.at[jb].add(jnp.where(act_b, dl, jnp.zeros_like(dl)))
+                        for ga, dl in zip(gacc, dchunk)]
+                cur_slot = jax.lax.dynamic_index_in_dim(
+                    dxout, i_b, 0, keepdims=False)
+                dxout = jax.lax.dynamic_update_index_in_dim(
+                    dxout, jnp.where(act_b & (cb == 0), dx, cur_slot), i_b, 0)
+                fcur = jax.lax.ppermute(y, axis, ring_fwd)
+                bcur = jax.lax.ppermute(dx, axis, ring_bwd)
+                return (fbuf, fcur, bcur, gacc, dxout), None
+
+            (_, _, _, gacc, dxout), _ = jax.lax.scan(
+                tick, (fbuf0, fcur0, bcur0, gacc0, dx0), jnp.arange(T2))
+            dxout = jax.lax.psum(dxout, axis)  # only chunk 0's device wrote
+            gout = [ga.reshape((v * k,) + ga.shape[2:]) for ga in gacc]
+            if batch_axis:
+                gout = [jax.lax.psum(gv, batch_axis) for gv in gout]
+            return (dxout, *gout)
+
+        manual = {axis} | ({batch_axis} if batch_axis else set())
+        fwd_shmap = jax.shard_map(
+            fwd_body, mesh=mesh,
+            in_specs=(x_spec, P()) + leaf_specs, out_specs=x_spec,
+            axis_names=frozenset(manual), check_vma=False)
+        bwd_shmap = jax.shard_map(
+            bwd_body, mesh=mesh,
+            in_specs=(x_spec, x_spec, P()) + leaf_specs,
+            out_specs=(x_spec,) + leaf_specs,
+            axis_names=frozenset(manual), check_vma=False)
+
+        @jax.custom_vjp
+        def call(x_mb, rng, *leaves):
+            return fwd_shmap(x_mb, rng, *leaves)
+
+        def call_fwd(x_mb, rng, *leaves):
+            return fwd_shmap(x_mb, rng, *leaves), (x_mb, rng, leaves)
+
+        def call_bwd(res, gout):
+            x_mb, rng, leaves = res
+            outs = bwd_shmap(gout, x_mb, rng, *leaves)
+            drng = np.zeros(np.shape(rng), jax.dtypes.float0)
+            return (outs[0], drng) + tuple(outs[1:])
+
+        call.defvjp(call_fwd, call_bwd)
+        jitted = jax.jit(call)
+        _COMPILED[cache_key] = jitted
+        while len(_COMPILED) > _COMPILED_MAX:
+            _COMPILED.popitem(last=False)
+
+    if not isinstance(x_mb, jax.core.Tracer):
+        x_mb = jax.device_put(x_mb, NamedSharding(mesh, x_spec))
+    out = jitted(x_mb, rng, *stacked_leaves)
+    return out.reshape(x.shape)
+
+
 def schedule_cost_report(p: int, m: int, schedule: str) -> dict:
     """Traced-unit accounting for one train step of the tick-interleaved
     schedules (the SPMD analog of the reference's per-stage job-list bubble
@@ -648,8 +855,10 @@ class PipelinedStack(Layer):
         self.remat = remat
         if schedule not in ("rotation", "1f1b", "eager_1f1b", "zb", "zbh1"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
-        if schedule != "rotation" and num_chunks != 1:
-            raise ValueError(f"schedule={schedule!r} requires num_chunks == 1")
+        if schedule in ("zb", "zbh1") and num_chunks != 1:
+            raise ValueError(
+                "ZB-H1 covers num_chunks == 1; interleaved stacks use "
+                "schedule='1f1b' (tick-interleaved VPP) or 'rotation'")
         self.schedule = schedule
         if num_layers % (self.num_stages * num_chunks) != 0:
             raise ValueError(

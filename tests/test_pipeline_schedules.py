@@ -508,3 +508,98 @@ def test_zb_memory_bounded_vs_rotation():
         pytest.skip("backend does not report memory analysis")
     assert mem_z.temp_size_in_bytes < 0.7 * mem_r.temp_size_in_bytes, (
         mem_z.temp_size_in_bytes, mem_r.temp_size_in_bytes)
+
+
+# ---- tick-interleaved 1F1B for INTERLEAVED (VPP) stacks (closes the
+# rotation-only limitation; reference pipeline_vpp.py is 1F1B-interleaved) --
+
+def test_vpp_1f1b_forward_and_grad_parity():
+    """num_chunks=2 under schedule='1f1b': serial-model numbers for forward
+    AND stacked-weight/input grads via the interleaved combined scan."""
+    import jax
+    import jax.numpy as jnp
+
+    paddle.seed(13)
+    stack = PipelinedStack(lambda: Block(16), num_layers=8, num_chunks=2,
+                           num_microbatches=8, schedule="1f1b")
+    rs = np.random.RandomState(2)
+    x_np = rs.randn(16, 16).astype(np.float32)
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    out = stack(x)
+    np.testing.assert_allclose(out.numpy(), _serial_reference(stack, x_np),
+                               rtol=1e-4, atol=1e-5)
+    loss = (out * out).mean()
+    loss.backward()
+
+    perm = chunk_permutation(8, 4, 2)
+    W = jnp.asarray(stack.stack_fc__weight._value)
+    B = jnp.asarray(stack.stack_fc__bias._value)
+
+    def serial_loss(Wv, Bv, xv):
+        h = xv
+        for idx in range(8):
+            pos = perm.index(idx)
+            h = h + jnp.tanh(h @ Wv[pos] + Bv[pos])
+        return (h * h).mean()
+
+    gw, gb, gx = jax.grad(serial_loss, argnums=(0, 1, 2))(
+        W, B, jnp.asarray(x_np))
+    np.testing.assert_allclose(stack.stack_fc__weight.grad.numpy(),
+                               np.asarray(gw), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(stack.stack_fc__bias.grad.numpy(),
+                               np.asarray(gb), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(x.grad.numpy(), np.asarray(gx),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_vpp_1f1b_dropout_trains_and_replays():
+    paddle.seed(17)
+    stack = PipelinedStack(lambda: DropBlock(16, 0.5), num_layers=8,
+                           num_stages=4, num_chunks=2, num_microbatches=4,
+                           schedule="1f1b")
+    x = paddle.to_tensor(
+        np.random.RandomState(4).randn(8, 16).astype(np.float32),
+        stop_gradient=False)
+    out = stack(x)
+    assert np.isfinite(out.numpy()).all()
+    paddle.sum(out).backward()
+    g = x.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+    stack.eval()
+    e1, e2 = stack(x), stack(x)
+    np.testing.assert_allclose(e1.numpy(), e2.numpy(), rtol=1e-6)
+
+
+def test_vpp_1f1b_memory_bounded_vs_rotation():
+    """The interleaved combined scan must NOT stack per-tick residuals: at
+    m >> p its grad program's temp memory stays well under the rotation
+    schedule's O(m·v) saved chunk inputs."""
+    import jax
+
+    from paddle_tpu.distributed.fleet.pipeline_schedules import pipeline_spmd
+
+    paddle.seed(19)
+    stack = PipelinedStack(lambda: Block(256), num_layers=8, num_stages=4,
+                           num_chunks=2, num_microbatches=4)
+    leaves = [stack.stack_fc__weight._value, stack.stack_fc__bias._value]
+    m = 32
+    rs = np.random.RandomState(0)
+    x = np.asarray(rs.randn(m * 2, 256), np.float32)
+
+    def build(schedule):
+        def loss(xv, w, b):
+            out = pipeline_spmd(stack._apply_layer, [w, b], xv,
+                                num_stages=4, num_microbatches=m,
+                                num_chunks=2, schedule=schedule)
+            return (out * out).mean()
+
+        return jax.jit(jax.grad(loss, argnums=(1, 2))).lower(
+            x, *leaves).compile()
+
+    rot, ilv = build("rotation"), build("1f1b")
+    mem_r = rot.memory_analysis()
+    mem_i = ilv.memory_analysis()
+    if mem_r is None or mem_i is None or not hasattr(mem_r, "temp_size_in_bytes"):
+        pytest.skip("backend does not report memory analysis")
+    assert mem_i.temp_size_in_bytes < 0.7 * mem_r.temp_size_in_bytes, (
+        mem_i.temp_size_in_bytes, mem_r.temp_size_in_bytes)
