@@ -147,20 +147,47 @@ class Tensor_:
 
 
 class Predictor:
-    """reference paddle.inference.Predictor over a jit-exported program."""
+    """reference paddle.inference.Predictor (AnalysisPredictor,
+    analysis_predictor.h:105) over a jit-exported program: the load-time
+    "analysis" is deserializing the compiled StableHLO module; creation
+    runs an AOT warmup call on the recorded input specs so the first real
+    request serves at steady-state latency (with Config.set_optim_cache_dir
+    the executable deserializes from the persistent cache)."""
 
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, _shared_layer=None):
         from ..jit.serialization import load as jit_load
 
         self.config = config
         if config._prefix is None:
             raise ValueError("Config needs a model path prefix")
-        self._layer = jit_load(config._prefix)
+        self._layer = (_shared_layer if _shared_layer is not None
+                       else jit_load(config._prefix))
         meta = getattr(self._layer, "_meta", {})
         n = int(meta.get("n_inputs", 1))
         self._input_names = [f"x{i}" for i in range(n)]
         self._inputs: Dict[str, Tensor_] = {name: Tensor_(name) for name in self._input_names}
         self._outputs: List[Tensor_] = []
+        self._input_shapes = meta.get("input_shapes")
+        if _shared_layer is None and self._input_shapes:
+            self._warmup()
+
+    def _warmup(self):
+        try:
+            zeros = [np.zeros(s, np.dtype(d)) for s, d in self._input_shapes]
+            self._layer(*zeros)
+        except Exception as e:  # best-effort, but never silent
+            _warn(f"predictor warmup failed ({e!r}); the first real request "
+                  "will pay the compile latency instead")
+
+    def clone(self) -> "Predictor":
+        """reference AnalysisPredictor::Clone — a predictor for another
+        serving thread SHARING the loaded weights/executable (XLA execution
+        is thread-safe; only the zero-copy IO handles are per-clone)."""
+        return Predictor(self.config, _shared_layer=self._layer)
+
+    def get_input_shapes(self):
+        return {n: list(s) for n, (s, _) in zip(
+            self._input_names, self._input_shapes or [])}
 
     def get_input_names(self) -> List[str]:
         return list(self._input_names)

@@ -90,6 +90,8 @@ def save(layer, path, input_spec=None, **configs):
             f.write(exported.serialize())
         meta["has_program"] = True
         meta["n_inputs"] = len(leaves)
+        meta["input_shapes"] = [(list(a.shape), str(a.dtype))
+                                for a in args_shaped]
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f)
 

@@ -148,3 +148,48 @@ def test_inference_config_no_silent_noops():
     cfg.disable_glog_info()
     assert logger.level == logging.WARNING
     logger.setLevel(logging.INFO)
+
+
+def test_predictor_clone_serves_concurrently(tmp_path):
+    """AnalysisPredictor::Clone parity: clones share weights/executable and
+    serve correct results from concurrent threads (zero-copy handles are
+    per-clone)."""
+    import threading
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu import jit
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    model.eval()
+    path = str(tmp_path / "served")
+    jit.save(model, path, input_spec=[InputSpec([2, 4], "float32")])
+
+    pred = create_predictor(Config(path))
+    assert pred.get_input_shapes() == {"x0": [2, 4]}
+    rs = np.random.RandomState(0)
+    feeds = [rs.randn(2, 4).astype(np.float32) for _ in range(4)]
+    want = [model(paddle.to_tensor(f)).numpy() for f in feeds]
+
+    clones = [pred] + [pred.clone() for _ in range(3)]
+    assert all(c._layer is pred._layer for c in clones)
+    results = [None] * 4
+    errors = []
+
+    def serve(i):
+        try:
+            (out,) = clones[i].run([feeds[i]])
+            results[i] = out
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=serve, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for got, exp in zip(results, want):
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
