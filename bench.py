@@ -354,6 +354,56 @@ def bench_resnet(on_tpu):
     return f"{name}_train_images_per_sec", batch * steps / dt, "images/sec", extras
 
 
+def bench_liteseg(on_tpu):
+    """PP-LiteSeg semantic segmentation images/sec (BASELINE.md row 3:
+    'PaddleDetection PP-YOLOE / PaddleSeg PP-LiteSeg')."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.vision.models import pp_liteseg
+
+    if on_tpu:
+        num_classes, base, batch, size, steps = 19, 32, 16, 512, 10
+    else:
+        num_classes, base, batch, size, steps = 4, 16, 2, 64, 3
+
+    paddle.seed(0)
+    model = pp_liteseg(num_classes=num_classes, base=base)
+    crit = nn.CrossEntropyLoss()
+    if on_tpu:
+        paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                    parameters=model.parameters())
+
+    def loss_fn(x, y):
+        if on_tpu:
+            with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+                logits = model(x)
+        else:
+            logits = model(x)
+        from paddle_tpu.ops.manipulation import reshape, transpose
+
+        flat = reshape(transpose(logits, [0, 2, 3, 1]), [-1, num_classes])
+        return crit(flat, reshape(y, [-1]))
+
+    step = TrainStep(model=model, optimizer=opt, loss_fn=loss_fn)
+    rs = np.random.RandomState(0)
+    x = paddle.Tensor(rs.randn(batch, 3, size, size).astype(np.float32),
+                      stop_gradient=True)
+    y = paddle.Tensor(rs.randint(0, num_classes, (batch, size, size))
+                      .astype(np.int64), stop_gradient=True)
+    _sync(step(x, y))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    name = "pp_liteseg" if on_tpu else "pp_liteseg_smoke"
+    return f"{name}_train_images_per_sec", batch * steps / dt, "images/sec", {}
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache beside this file: the expensive
     gpt2-small train-step compile happens once per toolchain; later bench
@@ -393,7 +443,8 @@ def _worker():
         except Exception as e:  # never let the gate sink the bench
             pallas_self_test = {"error": str(e).split("\n")[0][:200]}
     metric, value, unit, extras = {
-        "gpt": bench_gpt, "bert": bench_bert, "resnet": bench_resnet, "llama": bench_llama,
+        "gpt": bench_gpt, "bert": bench_bert, "resnet": bench_resnet,
+        "llama": bench_llama, "liteseg": bench_liteseg,
     }[mode](on_tpu)
     if pallas_self_test is not None:
         extras["pallas_self_test"] = pallas_self_test

@@ -48,6 +48,7 @@ def main(modes):
             metric, value, unit, extras = {
                 "gpt": bench.bench_gpt, "bert": bench.bench_bert,
                 "resnet": bench.bench_resnet, "llama": bench.bench_llama,
+                "liteseg": bench.bench_liteseg,
             }[mode](True)
             print(f"warmed {mode}: {metric}={value:.1f} {unit} "
                   f"extras={extras} ({time.time() - t0:.1f}s)", flush=True)
