@@ -35,6 +35,7 @@ from .base.dtype import (  # noqa: F401
     iinfo,
 )
 from .base.flags import get_flags, set_flags  # noqa: F401
+from .hapi.dynamic_flops import flops  # noqa: F401
 from .core.tensor import Parameter, Tensor  # noqa: F401
 
 dtype = _dtype_mod.DType

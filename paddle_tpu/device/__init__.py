@@ -110,3 +110,40 @@ class Event:
 
     def elapsed_time(self, end):
         return (end._t - self._t) * 1000.0
+
+
+# ---- memory observability (reference paddle.device.cuda.max_memory_allocated
+# family, paddle/phi/core/memory/stats.cc) — mapped onto PJRT memory_stats --
+
+def _mem_stats(device=None):
+    dev = get_device_object() if device is None else _resolve_device(device)
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return stats or {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (PJRT ``bytes_in_use``;
+    0 when the backend does not report memory stats, e.g. CPU)."""
+    return int(_mem_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak bytes allocated on the device (PJRT ``peak_bytes_in_use``)."""
+    return int(_mem_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved by the allocator pool (PJRT pool stats; falls back to
+    bytes_in_use when the backend has no pool accounting)."""
+    s = _mem_stats(device)
+    return int(s.get("pool_bytes", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = _mem_stats(device)
+    return int(s.get("peak_pool_bytes", s.get("peak_bytes_in_use", 0)))
+
+
+def memory_limit(device=None) -> int:
+    """The device's usable memory budget (PJRT ``bytes_limit``)."""
+    return int(_mem_stats(device).get("bytes_limit", 0))

@@ -159,3 +159,45 @@ def test_model_summary(capsys):
     net = nn.Linear(4, 2)
     info = paddle.Model(net).summary()
     assert info["total_params"] == 4 * 2 + 2
+
+
+def test_paddle_flops_counts_common_layers():
+    """paddle.flops (reference hapi/dynamic_flops.py): layer-walk FLOPs on
+    a conv+linear net match hand accounting; custom_ops override works."""
+    import paddle_tpu.nn as nn
+
+    net = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1),  # 32*32*8 out elems * 3*9 MACs
+        nn.ReLU(),
+        nn.MaxPool2D(2),
+        nn.Flatten(),
+        nn.Linear(8 * 16 * 16, 10),
+    )
+    total = paddle.flops(net, [1, 3, 32, 32])
+    conv = 32 * 32 * 8 * 3 * 9 + 32 * 32 * 8
+    relu = 32 * 32 * 8
+    pool = 16 * 16 * 8 * 4
+    linear = 10 * 8 * 16 * 16 + 10
+    assert total == conv + relu + pool + linear, (
+        total, conv + relu + pool + linear)
+
+    class Scale(nn.Layer):
+        def forward(self, x):
+            return x * 2
+
+    net2 = nn.Sequential(nn.Linear(4, 4), Scale())
+    t2 = paddle.flops(net2, [2, 4],
+                      custom_ops={Scale: lambda l, x, y: 1000})
+    assert t2 == (2 * 4 * 4 + 2 * 4) + 1000
+
+
+def test_device_memory_stats_surface():
+    """Memory observability maps onto PJRT memory_stats (0 on backends
+    without stats — never raises)."""
+    from paddle_tpu import device
+
+    for fn in (device.memory_allocated, device.max_memory_allocated,
+               device.memory_reserved, device.max_memory_reserved,
+               device.memory_limit):
+        v = fn()
+        assert isinstance(v, int) and v >= 0
