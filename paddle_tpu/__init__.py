@@ -36,6 +36,7 @@ from .base.dtype import (  # noqa: F401
 )
 from .base.flags import get_flags, set_flags  # noqa: F401
 from .hapi.dynamic_flops import flops  # noqa: F401
+from .hapi.model_summary import summary  # noqa: F401
 from .core.tensor import Parameter, Tensor  # noqa: F401
 
 dtype = _dtype_mod.DType

@@ -1,0 +1,81 @@
+"""paddle.summary — layer-by-layer model summary (reference:
+python/paddle/hapi/model_summary.py): one dry forward with forward-post
+hooks records each leaf layer's output shape and parameter count; returns
+{'total_params', 'trainable_params'} like the reference and logs the
+table."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _numel(shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def summary(net, input_size=None, dtypes=None, input=None) -> dict:
+    """reference paddle.summary(net, input_size): dry-run shape/param table.
+
+    input_size: tuple/list batch shape (or list of them for multi-input);
+    input: a ready-made tensor (wins over input_size).
+    """
+    import paddle_tpu as P
+
+    rows = []
+    removes = []
+
+    def attach(layer):
+        if list(layer.children()):
+            return
+
+        def hook(lay, inputs, output):
+            y = output[0] if isinstance(output, (list, tuple)) else output
+            own = lay.parameters(include_sublayers=False)
+            n_params = int(sum(_numel(p.shape) for p in own))
+            n_train = int(sum(_numel(p.shape) for p in own
+                              if not p.stop_gradient))
+            rows.append((type(lay).__name__, list(np.shape(y)),
+                         n_params, n_train))
+
+        removes.append(layer.register_forward_post_hook(hook))
+
+    for sub in net.sublayers(include_self=True):
+        attach(sub)
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = (list(input_size) if isinstance(input_size[0], (list, tuple))
+                 else [list(input_size)])
+        np_dtypes = list(dtypes or ["float32"] * len(sizes))
+        args = [P.to_tensor(np.zeros(s, np.dtype(d)))
+                for s, d in zip(sizes, np_dtypes)]
+    else:
+        args = [input]
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(*args)
+    finally:
+        if was_training:
+            net.train()
+        for r in removes:
+            r.remove()
+
+    total = int(sum(_numel(p.shape) for p in net.parameters()))
+    trainable = int(sum(_numel(p.shape) for p in net.parameters()
+                        if not p.stop_gradient))
+    from ..base.log import get_logger
+
+    log = get_logger()
+    log.info("%-22s %-22s %12s", "Layer (type)", "Output Shape", "Param #")
+    for name, shape, n_params, _ in rows:
+        log.info("%-22s %-22s %12d", name, shape, n_params)
+    log.info("Total params: %d  Trainable params: %d  Non-trainable: %d",
+             total, trainable, total - trainable)
+    return {"total_params": total, "trainable_params": trainable}

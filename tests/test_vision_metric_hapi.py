@@ -201,3 +201,16 @@ def test_device_memory_stats_surface():
                device.memory_limit):
         v = fn()
         assert isinstance(v, int) and v >= 0
+
+
+def test_paddle_summary_counts_params():
+    import paddle_tpu.nn as nn
+
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    info = paddle.summary(net, (2, 4))
+    want = 4 * 8 + 8 + 8 * 2 + 2
+    assert info == {"total_params": want, "trainable_params": want}
+    # frozen params reported as non-trainable
+    net[0].weight.stop_gradient = True
+    info = paddle.summary(net, (2, 4))
+    assert info["trainable_params"] == want - 4 * 8
