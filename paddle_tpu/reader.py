@@ -1,0 +1,117 @@
+"""Legacy reader decorators (reference python/paddle/reader/decorator.py):
+generator-composition utilities still used by older recipes — shuffle,
+batch, buffered, chain, map_readers, xmap_readers (thread pool)."""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+
+def shuffle(reader, buf_size):
+    def impl():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return impl
+
+
+def batch(reader, batch_size, drop_last=False):
+    def impl():
+        chunk = []
+        for item in reader():
+            chunk.append(item)
+            if len(chunk) == batch_size:
+                yield chunk
+                chunk = []
+        if chunk and not drop_last:
+            yield chunk
+
+    return impl
+
+
+def buffered(reader, size):
+    """Decouple producer/consumer through a bounded background queue."""
+    END = object()
+
+    def impl():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(END)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is END:
+                t.join()
+                return
+            yield item
+
+    return impl
+
+
+def chain(*readers):
+    def impl():
+        return itertools.chain(*[r() for r in readers])
+
+    return impl
+
+
+def compose(*readers):
+    def impl():
+        for items in zip(*[r() for r in readers]):
+            out = []
+            for it in items:
+                out.extend(it if isinstance(it, (list, tuple)) else [it])
+            yield tuple(out)
+
+    return impl
+
+
+def map_readers(func, *readers):
+    def impl():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return impl
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Thread-pool mapper (reference xmap_readers; threads, not processes —
+    mappers here are numpy-level and the GIL releases in numpy)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def impl():
+        with ThreadPoolExecutor(process_num) as pool:
+            pending = []
+            it = reader()
+            for item in it:
+                pending.append(pool.submit(mapper, item))
+                if len(pending) >= buffer_size:
+                    yield pending.pop(0).result()
+            for f in pending:
+                yield f.result()
+
+    return impl
+
+
+def firstn(reader, n):
+    def impl():
+        return itertools.islice(reader(), n)
+
+    return impl

@@ -1,0 +1,13 @@
+"""paddle.tensor namespace (reference python/paddle/tensor/): the
+functional tensor API grouped like the reference submodules — thin
+re-exports; the implementations live in ops/."""
+from __future__ import annotations
+
+from .ops import creation, linalg, logic, manipulation, math, random, search, stat  # noqa: F401
+from .ops.creation import *  # noqa: F401,F403
+from .ops.logic import *  # noqa: F401,F403
+from .ops.manipulation import *  # noqa: F401,F403
+from .ops.math import *  # noqa: F401,F403
+from .ops.random import *  # noqa: F401,F403
+from .ops.search import *  # noqa: F401,F403
+from .ops.stat import *  # noqa: F401,F403

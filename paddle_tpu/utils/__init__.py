@@ -1,0 +1,94 @@
+"""paddle.utils parity surface (reference: python/paddle/utils/ —
+deprecated decorator, dlpack interop, unique_name, install_check,
+try_import; download is egress-gated by design here)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import unique_name  # noqa: F401
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 1):
+    """reference utils/deprecated.py — warn (or raise at level 2) when the
+    decorated API is called."""
+
+    def decorator(fn):
+        msg = f"API '{fn.__module__}.{fn.__qualname__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f"; use '{update_to}' instead"
+        if reason:
+            msg += f" ({reason})"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name: str):
+    """reference utils/lazy_import.py::try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"optional dependency {module_name!r} is not installed "
+            f"({e}); install it where package installs are allowed") from e
+
+
+def run_check():
+    """reference utils/install_check.py::run_check — a tiny end-to-end
+    train step proving the install (device, compile, autograd) works."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = paddle.mean(net(x) ** 2)
+    loss.backward()
+    assert net.weight.grad is not None
+    import jax
+
+    dev = jax.devices()[0]
+    from ..base.log import get_logger
+
+    get_logger().info(
+        "PaddlePaddle (paddle_tpu) works! backend=%s device=%s",
+        dev.platform, getattr(dev, "device_kind", dev.platform))
+    return True
+
+
+# ---- dlpack interop (reference utils/dlpack.py) ----------------------------
+
+class dlpack:
+    @staticmethod
+    def to_dlpack(tensor):
+        """Tensor → DLPack exporter (the modern ``__dlpack__`` protocol:
+        consumers like torch.utils.dlpack.from_dlpack take the object
+        directly; zero-copy where the backend allows)."""
+        from ..core.tensor import unwrap
+
+        return unwrap(tensor)
+
+    @staticmethod
+    def from_dlpack(capsule):
+        """DLPack capsule / __dlpack__ exporter (e.g. a torch tensor) →
+        Tensor."""
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        return Tensor(jnp.from_dlpack(capsule))

@@ -1,0 +1,41 @@
+"""reference python/paddle/utils/unique_name.py (re-export of
+base/unique_name.py): process-wide unique name generator with guard()."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_lock = threading.Lock()
+_counters = {}
+_prefix = [""]
+
+
+def generate(key: str) -> str:
+    with _lock:
+        n = _counters.get(key, 0)
+        _counters[key] = n + 1
+    return f"{_prefix[0]}{key}_{n}"
+
+
+def switch(new_generator=None):
+    with _lock:
+        old = dict(_counters)
+        _counters.clear()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator: str = ""):
+    """Names generated inside get the given prefix; counters are scoped."""
+    with _lock:
+        saved_counters = dict(_counters)
+        _counters.clear()
+    saved_prefix = _prefix[0]
+    _prefix[0] = new_generator or ""
+    try:
+        yield
+    finally:
+        _prefix[0] = saved_prefix
+        with _lock:
+            _counters.clear()
+            _counters.update(saved_counters)

@@ -1,0 +1,77 @@
+"""paddle.utils / paddle.reader / paddle.tensor parity surfaces (reference
+python/paddle/{utils,reader,tensor}/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.reader as reader
+import paddle_tpu.tensor as pt
+from paddle_tpu.utils import deprecated, dlpack, run_check, try_import, unique_name
+
+
+def test_tensor_namespace_mirrors_ops():
+    x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    np.testing.assert_allclose(pt.abs(x).numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(pt.concat([x, x]).numpy(), [-1, 2, -1, 2])
+    assert pt.zeros([2, 2]).shape == [2, 2]
+
+
+def test_reader_decorators_compose():
+    base = lambda: iter(range(10))
+    r = reader.batch(reader.shuffle(base, 4), 3)
+    chunks = list(r())
+    assert sum(len(c) for c in chunks) == 10 and len(chunks) == 4
+    r2 = reader.batch(base, 3, drop_last=True)
+    assert all(len(c) == 3 for c in r2())
+    buf = reader.buffered(base, 2)
+    assert sorted(buf()) == list(range(10))
+    mapped = reader.map_readers(lambda a, b: a + b, base, base)
+    assert list(mapped()) == [2 * i for i in range(10)]
+    xm = reader.xmap_readers(lambda v: v * 10, base, 2, 4)
+    assert sorted(xm()) == [i * 10 for i in range(10)]
+    assert list(reader.firstn(base, 3)()) == [0, 1, 2]
+    assert list(reader.chain(lambda: iter([1]), lambda: iter([2]))()) == [1, 2]
+
+
+def test_unique_name_and_guard():
+    a, b = unique_name.generate("w"), unique_name.generate("w")
+    assert a != b
+    with unique_name.guard("scope_"):
+        c = unique_name.generate("w")
+        assert c.startswith("scope_") and c.endswith("_0")
+    d = unique_name.generate("w")
+    assert not d.startswith("scope_")
+
+
+def test_deprecated_decorator_warns_and_raises():
+    @deprecated(update_to="paddle.new_api", since="2.0")
+    def old():
+        return 7
+
+    with pytest.warns(DeprecationWarning, match="new_api"):
+        assert old() == 7
+
+    @deprecated(level=2)
+    def gone():
+        return 0
+
+    with pytest.raises(RuntimeError):
+        gone()
+
+
+def test_dlpack_roundtrip_with_torch():
+    import torch
+
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    ours = dlpack.from_dlpack(t)
+    np.testing.assert_allclose(ours.numpy(), t.numpy())
+    cap = dlpack.to_dlpack(ours)
+    back = torch.utils.dlpack.from_dlpack(cap)
+    np.testing.assert_allclose(back.numpy(), t.numpy())
+
+
+def test_try_import_and_run_check():
+    assert try_import("numpy") is np
+    with pytest.raises(ImportError, match="not installed"):
+        try_import("definitely_not_a_module_xyz")
+    assert run_check() is True
