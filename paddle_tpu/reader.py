@@ -40,27 +40,49 @@ def batch(reader, batch_size, drop_last=False):
 
 
 def buffered(reader, size):
-    """Decouple producer/consumer through a bounded background queue."""
+    """Decouple producer/consumer through a bounded background queue.
+    Producer exceptions propagate to the consumer (a crash must not read
+    as a clean short epoch), and an early-abandoned generator unblocks and
+    joins the fill thread instead of leaking it."""
     END = object()
 
     def impl():
         q = queue.Queue(maxsize=size)
+        stop = threading.Event()
+        err = []
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def fill():
             try:
                 for item in reader():
-                    q.put(item)
+                    if not put(item):
+                        return
+            except BaseException as e:
+                err.append(e)
             finally:
-                q.put(END)
+                put(END)
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is END:
-                t.join()
-                return
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is END:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+            t.join()
 
     return impl
 
@@ -72,9 +94,20 @@ def chain(*readers):
     return impl
 
 
-def compose(*readers):
+class ComposeNotAligned(ValueError):
+    """reference reader/decorator.py: composed readers differ in length."""
+
+
+def compose(*readers, check_alignment=True):
     def impl():
-        for items in zip(*[r() for r in readers]):
+        MISSING = object()
+        for items in itertools.zip_longest(*[r() for r in readers],
+                                           fillvalue=MISSING):
+            if MISSING in items:
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "composed readers have different lengths")
+                return
             out = []
             for it in items:
                 out.extend(it if isinstance(it, (list, tuple)) else [it])
@@ -91,21 +124,32 @@ def map_readers(func, *readers):
     return impl
 
 
-def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+def xmap_readers(mapper, reader, process_num, buffer_size, order=True):
     """Thread-pool mapper (reference xmap_readers; threads, not processes —
-    mappers here are numpy-level and the GIL releases in numpy)."""
-    from concurrent.futures import ThreadPoolExecutor
+    mappers here are numpy-level and the GIL releases in numpy).
+    order=True preserves input order; order=False yields completion order
+    within the sliding buffer. Abandoning the generator cancels queued
+    work instead of blocking on the pool."""
+    from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
     def impl():
-        with ThreadPoolExecutor(process_num) as pool:
-            pending = []
-            it = reader()
-            for item in it:
+        pool = ThreadPoolExecutor(process_num)
+        pending = []
+        try:
+            for item in reader():
                 pending.append(pool.submit(mapper, item))
                 if len(pending) >= buffer_size:
-                    yield pending.pop(0).result()
-            for f in pending:
+                    if order:
+                        yield pending.pop(0).result()
+                    else:
+                        done, _ = wait(pending, return_when=FIRST_COMPLETED)
+                        f = next(iter(done))
+                        pending.remove(f)
+                        yield f.result()
+            for f in (pending if order else list(pending)):
                 yield f.result()
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     return impl
 

@@ -18,9 +18,14 @@ def generate(key: str) -> str:
 
 
 def switch(new_generator=None):
+    """Swap the live counter state (reference unique_name.switch): returns
+    the PREVIOUS state; pass a previously returned state to restore it —
+    `pre = switch(); ...; switch(pre)` round-trips."""
     with _lock:
         old = dict(_counters)
         _counters.clear()
+        if new_generator:
+            _counters.update(new_generator)
     return old
 
 

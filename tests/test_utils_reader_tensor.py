@@ -75,3 +75,45 @@ def test_try_import_and_run_check():
     with pytest.raises(ImportError, match="not installed"):
         try_import("definitely_not_a_module_xyz")
     assert run_check() is True
+
+
+def test_unique_name_switch_roundtrip():
+    unique_name.generate("sw")
+    pre = unique_name.switch()
+    assert unique_name.generate("sw").endswith("_0")
+    unique_name.switch(pre)
+    # restored counters continue where the saved state left off
+    assert not unique_name.generate("sw").endswith("_0")
+
+
+def test_compose_alignment_raises():
+    from paddle_tpu.reader import ComposeNotAligned, compose
+
+    good = compose(lambda: iter([1, 2]), lambda: iter([3, 4]))
+    assert list(good()) == [(1, 3), (2, 4)]
+    bad = compose(lambda: iter([1, 2, 3]), lambda: iter([4]))
+    with pytest.raises(ComposeNotAligned):
+        list(bad())
+    lax = compose(lambda: iter([1, 2, 3]), lambda: iter([4]),
+                  check_alignment=False)
+    assert list(lax()) == [(1, 4)]
+
+
+def test_buffered_propagates_producer_errors_and_joins():
+    def crashing():
+        yield 1
+        raise IOError("disk gone")
+
+    buf = reader.buffered(crashing, 2)
+    with pytest.raises(IOError, match="disk gone"):
+        list(buf())
+    # early abandonment neither hangs nor leaks: generator closes cleanly
+    gen = reader.buffered(lambda: iter(range(100)), 2)()
+    assert next(gen) == 0
+    gen.close()
+
+
+def test_xmap_readers_unordered_mode():
+    xm = reader.xmap_readers(lambda v: v, lambda: iter(range(8)), 2, 4,
+                             order=False)
+    assert sorted(xm()) == list(range(8))
