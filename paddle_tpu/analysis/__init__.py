@@ -1,0 +1,91 @@
+"""paddle_tpu.analysis — commit-time static analysis over the framework.
+
+Rebuild of the reference's well-formedness tier: PIR's verify pass
+(paddle/pir/src/core/ir_verify.cc, run after every pass pipeline) and the
+YAML-driven consistency checks its codegen applies to the op library. On
+the JAX rebuild the same guarantees are delivered by three CPU-only
+analyzers that run at commit time:
+
+- :mod:`program_verify` — well-formedness pass over the recorded
+  ``static.Program`` IR (SSA/def-before-use, feed/fetch resolution,
+  shape/dtype consistency vs ``ops/op_defs.py`` signatures, dead nodes,
+  clone invariants).
+- :mod:`trace_safety` — AST linter over the ``paddle_tpu/`` source tree
+  flagging jit-unsafe host patterns inside traced regions (host syncs,
+  tensor truthiness, clock/entropy reads, global mutation under trace).
+- :mod:`registry_check` — promotes ``registry.alias_signature_report()``
+  from advisory to enforced: every op row resolves, alias signatures
+  bind, AMP lists stay disjoint, profiler tags stay valid.
+
+One CLI drives all three: ``python -m tools.lint`` (exit 1 on any
+error-severity finding; ``--json`` for machine-readable output).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Finding",
+    "check_registry",
+    "lint_paths",
+    "lint_source",
+    "verify_program",
+]
+
+
+@dataclass
+class Finding:
+    """One analyzer result. ``severity`` is 'error' (gates CI) or
+    'warning' (reported, never gates). ``location`` is ``file:line`` for
+    source findings, ``op[<index>]:<name>`` for program findings, and the
+    op/alias name for registry findings."""
+
+    analyzer: str   # 'program' | 'trace' | 'registry'
+    code: str       # stable id, e.g. 'PV001' / 'TS101' / 'RC201'
+    severity: str   # 'error' | 'warning'
+    message: str
+    location: str = ""
+    extra: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = {"analyzer": self.analyzer, "code": self.code,
+             "severity": self.severity, "message": self.message,
+             "location": self.location}
+        if self.extra:
+            d["extra"] = self.extra
+        return d
+
+    def __str__(self):
+        loc = f"{self.location}: " if self.location else ""
+        return f"{loc}{self.code} [{self.severity}] {self.message}"
+
+
+def errors(findings) -> list:
+    """The gating subset of a findings list."""
+    return [f for f in findings if f.severity == "error"]
+
+
+# Re-exported lazily-importable entry points (keep `import paddle_tpu`
+# cheap: the analyzers pull ast/inspect only when actually called).
+def verify_program(program, fetch_ids=None):
+    from .program_verify import verify_program as _impl
+
+    return _impl(program, fetch_ids=fetch_ids)
+
+
+def lint_paths(paths):
+    from .trace_safety import lint_paths as _impl
+
+    return _impl(paths)
+
+
+def lint_source(source, filename="<string>"):
+    from .trace_safety import lint_source as _impl
+
+    return _impl(source, filename)
+
+
+def check_registry(**kwargs):
+    from .registry_check import check_registry as _impl
+
+    return _impl(**kwargs)

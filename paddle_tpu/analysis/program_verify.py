@@ -1,0 +1,222 @@
+"""Well-formedness pass over the recorded ``static.Program`` IR.
+
+Reference analog: PIR's verify pass (paddle/pir/src/core/ir_verify.cc) —
+run after every pass pipeline, it rejects programs whose operands dangle
+or whose op signatures disagree with the op definition. Here the IR is
+the replay node list of ``paddle_tpu/static/program.py``; the same
+guarantees map onto:
+
+PV001  use-before-def          a 'v' input is produced by a LATER node
+PV002  duplicate definition    two nodes claim the same output id
+PV003  feed integrity          feed without a spec / feed shadowed by an op
+PV004  dangling input          a 'v' binding whose Tensor was corrupted/lost
+PV005  producer mismatch       input shape/dtype disagrees with its producer
+PV006  signature arity         more tensor inputs than the op's YAML spec (warning)
+PV007  unresolvable fetch      fetch id not produced / fed / by-ref constant
+PV008  dead node               node outside the backward slice of the fetches (warning)
+PV009  clone invariant         clone() dropped nodes / feeds / placeholder refs
+
+Errors gate (``Program.verify()`` raises); warnings report. Fetch-aware
+checks (PV007/PV008) only run when ``fetch_ids`` is given — without fetch
+targets every output is potentially fetchable.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from . import Finding
+
+_ANALYZER = "program"
+
+
+def _tensor_specs(arg_specs):
+    """Flatten a node's arg_specs to (kind, tid, tensor) tensor bindings."""
+    out = []
+
+    def scan(spec):
+        if spec[0] == "v":
+            out.append(spec)
+        elif spec[0] == "t":
+            out.append(spec)
+        elif spec[0] == "lt":
+            for s in spec[1]:
+                scan(s)
+
+    for spec in arg_specs:
+        scan(spec)
+    return out
+
+
+def _spec_shape_dtype(tensor):
+    try:
+        return tuple(tensor.shape), str(tensor.dtype)
+    except Exception:
+        return None, None
+
+
+def verify_program(program, fetch_ids: Optional[Sequence[int]] = None) -> List[Finding]:
+    """Run all checks over ``program``; returns findings (possibly empty)."""
+    from ..ops.op_defs import OP_DEFS
+
+    findings: List[Finding] = []
+
+    def add(code, severity, message, loc, **extra):
+        findings.append(Finding(_ANALYZER, code, severity, message, loc, extra))
+
+    feed_ids = set(program.feeds.values())
+    # PV003: every feed has a recorded (shape, dtype) spec
+    for name in program.feeds:
+        if name not in program.feed_specs:
+            add("PV003", "error", f"feed '{name}' has no recorded shape/dtype spec",
+                f"feed:{name}")
+
+    # Pass 1: definition sites. PV002 duplicate output ids.
+    producer = {}  # out id -> (node index, out_ref)
+    for i, node in enumerate(program.ops):
+        loc = f"op[{i}]:{node.name}"
+        for j, oid in enumerate(node.out_ids):
+            if oid in producer:
+                add("PV002", "error",
+                    f"output id {oid} already produced by "
+                    f"op[{producer[oid][0]}]:{program.ops[producer[oid][0]].name}",
+                    loc)
+            else:
+                ref = node.out_refs[j] if j < len(node.out_refs) else None
+                producer[oid] = (i, ref)
+        # PV003: a feed id must come from static.data, not an op
+        for oid in node.out_ids:
+            if oid in feed_ids:
+                feed_name = next(n for n, v in program.feeds.items() if v == oid)
+                add("PV003", "error",
+                    f"feed '{feed_name}' is shadowed: its id is produced by this op",
+                    loc)
+
+    # Pass 2: uses. PV001/PV004/PV005/PV006.
+    for i, node in enumerate(program.ops):
+        loc = f"op[{i}]:{node.name}"
+        tspecs = _tensor_specs(node.arg_specs)
+        for spec in tspecs:
+            if spec[0] != "v":
+                continue
+            _, tid, tensor = spec
+            if tid in producer:
+                p_idx, p_ref = producer[tid]
+                if p_idx >= i:
+                    add("PV001", "error",
+                        f"input id {tid} is produced by the later "
+                        f"op[{p_idx}]:{program.ops[p_idx].name} (use before def)",
+                        loc)
+                # PV005: the recorded binding must agree with its producer.
+                # Healthy programs bind the producer's own Tensor, so shape
+                # and dtype match by construction; a mismatch means the
+                # node list was edited or a tensor id got reused.
+                if tensor is not None and p_ref is not None:
+                    got = _spec_shape_dtype(tensor)
+                    want = _spec_shape_dtype(p_ref)
+                    if None not in (got[0], want[0]) and got != want:
+                        add("PV005", "error",
+                            f"input id {tid} recorded as shape={got[0]} "
+                            f"dtype={got[1]} but its producer "
+                            f"op[{p_idx}]:{program.ops[p_idx].name} emits "
+                            f"shape={want[0]} dtype={want[1]}", loc)
+            elif tid in feed_ids:
+                pass  # fed at run time
+            else:
+                # by-reference constant (parameter): the Tensor itself is
+                # the value source, so it must still be alive and wrapped
+                if tensor is None or not hasattr(tensor, "_value"):
+                    add("PV004", "error",
+                        f"input id {tid} is neither produced, fed, nor a live "
+                        "by-reference Tensor (dangling input)", loc)
+
+        # PV006: recorded tensor arity vs the YAML signature. Only checked
+        # when the row records args and none are variadic Tensor[] slots.
+        d = OP_DEFS.get(node.name)
+        if d and d["args"] and not any(a[0].startswith("Tensor[") for a in d["args"]):
+            n_tensor_args = sum(1 for a in d["args"] if a[0].startswith("Tensor"))
+            n_bound = len(tspecs)
+            if n_bound > n_tensor_args:
+                add("PV006", "warning",
+                    f"records {n_bound} tensor inputs but the op signature "
+                    f"declares only {n_tensor_args} Tensor args", loc)
+
+    # Fetch-aware checks.
+    if fetch_ids is not None:
+        produced = set(producer)
+        # ids of by-reference constants are legal fetch targets: _replay
+        # seeds them into the environment
+        const_ids = set()
+        for node in program.ops:
+            for spec in _tensor_specs(node.arg_specs):
+                if spec[0] == "v" and spec[1] not in produced and spec[1] not in feed_ids:
+                    const_ids.add(spec[1])
+        live = set()
+        for fid in fetch_ids:
+            if fid not in produced and fid not in feed_ids and fid not in const_ids:
+                add("PV007", "error",
+                    f"fetch id {fid} is not produced by any node, not a feed, "
+                    "and not a by-reference constant", f"fetch:{fid}")
+            else:
+                live.add(fid)
+        # PV008: backward slice from the resolvable fetches
+        needed = set(live)
+        contributing = set()
+        for i in range(len(program.ops) - 1, -1, -1):
+            node = program.ops[i]
+            if any(oid in needed for oid in node.out_ids):
+                contributing.add(i)
+                for spec in _tensor_specs(node.arg_specs):
+                    if spec[0] == "v":
+                        needed.add(spec[1])
+        for i, node in enumerate(program.ops):
+            if i not in contributing:
+                add("PV008", "warning",
+                    "node does not contribute to any fetch target (dead node)",
+                    f"op[{i}]:{node.name}")
+
+    return findings
+
+
+def record_demo_program():
+    """Record the canonical small well-formed program (data → fc → mean)
+    used by ``tools.lint``'s program analyzer and the test gates — one
+    definition so the CLI and the tests verify the SAME graph. Returns
+    ``(program, feed_tensor, hidden, loss)``."""
+    import paddle_tpu as paddle
+    from ..static.program import Program, program_guard
+
+    main = Program()
+    with program_guard(main):
+        x = paddle.static.data(name="x", shape=[None, 8], dtype="float32")
+        hidden = paddle.static.nn.fc(x, size=4)
+        loss = paddle.mean(hidden)
+    return main, x, hidden, loss
+
+
+def verify_clone(original, clone) -> List[Finding]:
+    """PV009: ``clone()``/``clone(for_test=True)`` invariants — the clone
+    replays the same computation (shared node objects), keeps the feed
+    surface, and retains the placeholder Tensors whose ids key the feeds
+    (a clone that drops them dangles once the original is collected)."""
+    findings: List[Finding] = []
+
+    def add(message):
+        findings.append(Finding(_ANALYZER, "PV009", "error", message, "clone"))
+
+    if len(clone.ops) != len(original.ops):
+        add(f"clone has {len(clone.ops)} ops, original has {len(original.ops)}")
+    else:
+        for i, (a, b) in enumerate(zip(original.ops, clone.ops)):
+            if a is not b:
+                add(f"clone op[{i}] is not the original node object "
+                    "(replay identity broken)")
+                break
+    if clone.feeds != original.feeds:
+        add("clone feed map differs from the original")
+    if clone.feed_specs != original.feed_specs:
+        add("clone feed specs differ from the original")
+    clone_ph = {id(p) for p in getattr(clone, "_placeholders", [])}
+    if not set(clone.feeds.values()) <= clone_ph:
+        add("clone dropped the feed placeholder references "
+            "(feed ids dangle once the original program is collected)")
+    return findings

@@ -1,0 +1,157 @@
+"""Registry consistency gate: ``alias_signature_report`` made enforced.
+
+The reference keeps its 683k-LoC op library honest through YAML-driven
+codegen — a bad op row fails the build. Here ``ops/op_defs.py`` is data,
+so the equivalent guarantee is this gate, run by ``python -m tools.lint``
+and the tier-1 ``tests/test_lint_clean.py``:
+
+RC200  malformed op row       missing keys / bad tier / bad arg tuples
+RC201  unresolved op          dense|fused|sparse row with no implementation
+RC202  dead alias             _ALIASES entry whose target import fails
+RC203  unknown alias name     alias for an op absent from OP_DEFS and not
+                              declared in registry._ALIAS_EXTRA_NAMES
+RC204  alias signature        alias impl cannot bind the YAML's required
+                              args positionally (alias_signature_report
+                              ok=False)
+RC205  AMP ambiguity          an op name matching both the white and black
+                              stem patterns without an _AMP_OVERRIDES pin
+RC206  unknown AMP override   _AMP_OVERRIDES key not in OP_DEFS
+RC207  invalid profiler tag   profiler_tag outside the known tag set, or
+                              'custom' for a registered op
+
+The xpu tier (Kunlun-hardware fused kernels) is intentionally exempt from
+RC201 — those ops have no TPU binding and are excluded from
+``registry.coverage()`` for the same reason.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from . import Finding
+
+_ANALYZER = "registry"
+
+_VALID_TIERS = {"dense", "fused", "sparse", "xpu"}
+_VALID_TAGS = {"dense", "fused", "sparse", "xpu", "matmul", "forward_only"}
+_REQUIRED_KEYS = {"args", "outputs", "backward", "inplace", "forward_only", "tier"}
+_AMP_CLASSES = {"white", "black", "none"}
+
+
+def _resolve_target(target: str):
+    """Import a 'module:attr' alias target directly (independent of the
+    live _ALIASES table, so injected alias rows are checked for real)."""
+    import importlib
+
+    mod, _, attr = target.partition(":")
+    try:
+        return getattr(importlib.import_module(mod), attr, None)
+    except Exception:
+        # ANY import-time failure of the target module (ImportError, but
+        # also AttributeError/NameError from a half-broken module) is a
+        # dead alias to report, not a gate crash
+        return None
+
+
+def check_registry(op_defs=None, aliases=None, registry=None) -> List[Finding]:
+    """Run all checks. ``op_defs``/``aliases`` override the live tables for
+    the table-driven checks (RC200-RC203); the derived-state checks
+    (RC204-RC207) read the live registry module and are skipped when a
+    synthetic ``op_defs`` is injected. Op-name resolution (RC201) always
+    goes through the live ``registry._lookup`` — "does the framework
+    resolve this name" is inherently a live question — while alias targets
+    (RC202) resolve from the passed table."""
+    from ..ops import registry as reg_mod
+
+    registry = registry or reg_mod
+    live_tables = op_defs is None  # signature/AMP/tag checks read module state
+    op_defs = op_defs if op_defs is not None else registry.OP_DEFS
+    if aliases is None:
+        # a synthetic op_defs scopes the run to that table: cross-checking
+        # the live alias names against it would flood RC203
+        aliases = registry._ALIASES if live_tables else {}
+
+    findings: List[Finding] = []
+
+    def add(code, message, loc, severity="error"):
+        findings.append(Finding(_ANALYZER, code, severity, message, loc))
+
+    # RC200: structural sanity of every row
+    for name, d in op_defs.items():
+        if not isinstance(d, dict) or not _REQUIRED_KEYS <= set(d):
+            add("RC200", "op row is missing required keys "
+                f"{sorted(_REQUIRED_KEYS - set(d or {}))}", name)
+            continue
+        if d["tier"] not in _VALID_TIERS:
+            add("RC200", f"unknown tier '{d['tier']}'", name)
+        if not d["outputs"]:
+            add("RC200", "op row declares no outputs", name)
+        for a in d["args"]:
+            if not (isinstance(a, tuple) and len(a) in (2, 3)
+                    and all(isinstance(x, str) for x in a)):
+                add("RC200", f"malformed arg tuple {a!r}", name)
+                break
+
+    # RC201: every non-xpu row must resolve to an implementation
+    for name, d in op_defs.items():
+        if not isinstance(d, dict) or d.get("tier") not in ("dense", "fused", "sparse"):
+            continue
+        if registry._lookup(name) is None:
+            add("RC201", f"{d['tier']}-tier op has no resolvable implementation",
+                name)
+
+    # RC202/RC203: alias table integrity
+    extra_names = getattr(registry, "_ALIAS_EXTRA_NAMES", set())
+    for name, target in aliases.items():
+        if _resolve_target(target) is None:
+            add("RC202", f"alias target '{target}' does not resolve", name)
+        if name not in op_defs and name not in extra_names:
+            add("RC203", "alias for an op name absent from OP_DEFS (add the "
+                "row, or declare it in registry._ALIAS_EXTRA_NAMES with why)",
+                name)
+
+    # RC204..RC207 evaluate the registry module's own derived tables;
+    # they only make sense against the live op_defs
+    if not live_tables:
+        return findings
+
+    # RC204: enforced alias signature compatibility
+    report = registry.alias_signature_report()
+    for name, row in report.items():
+        if not row.get("ok", False):
+            add("RC204",
+                "alias implementation cannot bind the YAML required args "
+                f"{row.get('required')} positionally "
+                f"(impl requires {row.get('impl_required')})", name)
+
+    # RC205: AMP classification unambiguous. amp_white()/amp_black() are
+    # disjoint by construction (one classifier, black-first), so the real
+    # conflict to surface is an op name matching BOTH stem regexes with no
+    # explicit _AMP_OVERRIDES pin — today it silently classifies black.
+    white_re = getattr(registry, "_WHITE_RE", None)
+    black_re = getattr(registry, "_BLACK_RE", None)
+    overrides = getattr(registry, "_AMP_OVERRIDES", {})
+    if white_re is not None and black_re is not None:
+        for name in op_defs:
+            if (name not in overrides and white_re.search(name)
+                    and black_re.search(name)):
+                add("RC205", "op name matches both the AMP white and black "
+                    "stem patterns — pin its class in _AMP_OVERRIDES", name)
+
+    # RC206: AMP overrides refer to real ops and real classes
+    for name, cls in getattr(registry, "_AMP_OVERRIDES", {}).items():
+        if name not in op_defs:
+            add("RC206", "AMP override for an op absent from OP_DEFS", name)
+        if cls not in _AMP_CLASSES:
+            add("RC206", f"AMP override class '{cls}' is not one of "
+                f"{sorted(_AMP_CLASSES)}", name)
+
+    # RC207: profiler tags valid for every registered op
+    for name in op_defs:
+        tag = registry.profiler_tag(name)
+        if tag == "custom":
+            add("RC207", "profiler_tag is 'custom' for a registered op "
+                "(tag derivation broke)", name)
+        elif tag not in _VALID_TAGS:
+            add("RC207", f"profiler_tag '{tag}' is not a known tag", name)
+
+    return findings

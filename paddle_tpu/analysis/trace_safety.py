@@ -1,0 +1,410 @@
+"""AST linter: jit-unsafe host patterns inside traced regions.
+
+The reference catches these dynamically (SOT graph-breaks on host
+conversions, paddle/fluid/pybind/sot/eval_frame.c); on the JAX rebuild a
+host sync inside a traced region silently downgrades the whole function
+to eager (jit/functionalize.py ``fallback_reason``) or bakes a trace-time
+constant into the compiled program. This linter finds them statically.
+
+Traced regions — code that executes under ``jax.jit`` tracing:
+
+1. functions decorated with ``to_static`` (any dotted spelling, bare or
+   called form),
+2. functions named ``step_fn`` (the TrainStep whole-step convention),
+3. kernel callables handed to the dispatcher — the lambda or local
+   ``def`` passed as the second argument of ``primitive(...)`` /
+   ``passthrough(...)`` (ops/ kernels run under jax.vjp/jit).
+
+Rules (all scoped to traced regions):
+
+TS101  host sync            .numpy()/.item()/.tolist()/.cpu() call
+TS102  tensor truthiness    if/while/ternary branches on a traced argument
+TS103  host clock           time.time()/perf_counter()/monotonic()/...
+TS104  host entropy         stdlib random.* or numpy random under trace
+TS105  global mutation      `global` declaration inside a traced region
+TS106  mutable static arg   list/dict/set default on a traced function
+                            (non-hashable static args defeat the compile
+                            cache key)
+
+Suppression: a ``# noqa: TS1xx`` comment on the flagged line (bare
+``# noqa`` suppresses every rule on that line). Findings carry
+``file:line`` locations.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence
+
+from . import Finding
+
+_ANALYZER = "trace"
+
+_HOST_SYNC_ATTRS = {"numpy", "item", "tolist", "cpu"}
+_TIME_FNS = {"time", "perf_counter", "monotonic", "process_time", "clock",
+             "time_ns", "perf_counter_ns", "monotonic_ns"}
+# attribute reads on a traced value that are static under tracing and
+# therefore safe to branch on (shapes/dtypes are trace-time constants)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "name", "stop_gradient"}
+_HOST_EVAL_CALLS = {"len", "isinstance", "hasattr", "getattr", "callable",
+                    "issubclass", "type",
+                    # dtype/shape predicates: evaluate on the abstract value,
+                    # static under tracing (jnp.iscomplexobj, np.issubdtype, …)
+                    "iscomplexobj", "isrealobj", "issubdtype", "result_type",
+                    "ndim", "shape"}
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.I)
+
+_DISPATCH_FNS = {"primitive", "passthrough"}
+
+
+class _Imports(ast.NodeVisitor):
+    """Map local names to the stdlib/numpy modules they alias."""
+
+    def __init__(self):
+        self.time_aliases: set = set()
+        self.random_aliases: set = set()
+        self.numpy_aliases: set = set()
+        self.random_fn_names: set = set()  # from random import randint, ...
+        self.time_fn_names: set = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = a.asname or a.name.split(".")[0]
+            if a.name == "time" or a.name.startswith("time."):
+                self.time_aliases.add(name)
+            elif a.name == "random" or a.name.startswith("random."):
+                self.random_aliases.add(name)
+            elif a.name == "numpy.random" and a.asname:
+                # `import numpy.random as npr`: npr IS the RNG module
+                self.random_aliases.add(a.asname)
+            elif a.name == "numpy" or a.name.startswith("numpy."):
+                self.numpy_aliases.add(name)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "random":
+            for a in node.names:
+                self.random_fn_names.add(a.asname or a.name)
+        elif node.module == "time":
+            for a in node.names:
+                if a.name in _TIME_FNS:
+                    self.time_fn_names.add(a.asname or a.name)
+        elif node.module == "numpy.random":
+            # `from numpy.random import randn` binds bare FUNCTION names
+            for a in node.names:
+                self.random_fn_names.add(a.asname or a.name)
+        elif node.module == "numpy":
+            # `from numpy import random` binds the RNG MODULE to a name
+            for a in node.names:
+                if a.name == "random":
+                    self.random_aliases.add(a.asname or a.name)
+
+
+def _decorator_is_to_static(dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if isinstance(dec, ast.Name):
+        return dec.id == "to_static"
+    if isinstance(dec, ast.Attribute):
+        return dec.attr == "to_static"
+    return False
+
+
+class _RegionChecker(ast.NodeVisitor):
+    """Apply the TS rules inside ONE traced region (a function body)."""
+
+    def __init__(self, imports: _Imports, params: set, findings: List[Finding],
+                 filename: str, region: str):
+        self.imports = imports
+        self.params = set(params)
+        self.findings = findings
+        self.filename = filename
+        self.region = region
+
+    def add(self, code, node, message):
+        self.findings.append(Finding(
+            _ANALYZER, code, "error", f"{message} (in traced region '{self.region}')",
+            f"{self.filename}:{node.lineno}"))
+
+    # -- TS101 host syncs ---------------------------------------------------
+    def visit_Call(self, node):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _HOST_SYNC_ATTRS and not node.args and not node.keywords:
+                self.add("TS101", node,
+                         f".{func.attr}() forces a host sync under trace")
+            self._check_module_call(node, func)
+        elif isinstance(func, ast.Name):
+            if func.id in self.imports.random_fn_names:
+                self.add("TS104", node,
+                         f"stdlib random '{func.id}' draws host entropy under "
+                         "trace (use paddle RNG / jax.random)")
+            elif func.id in self.imports.time_fn_names:
+                self.add("TS103", node,
+                         f"'{func.id}()' reads the host clock under trace "
+                         "(value bakes in as a constant)")
+        self.generic_visit(node)
+
+    def _check_module_call(self, node, func: ast.Attribute):
+        # time.<fn>() / random.<fn>() / np.random.<fn>()
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id in self.imports.time_aliases and func.attr in _TIME_FNS:
+                self.add("TS103", node,
+                         f"time.{func.attr}() reads the host clock under trace "
+                         "(value bakes in as a constant)")
+            elif base.id in self.imports.random_aliases:
+                self.add("TS104", node,
+                         f"host RNG '{base.id}.{func.attr}' under trace (use "
+                         "paddle RNG / jax.random)")
+        elif (isinstance(base, ast.Attribute) and base.attr == "random"
+              and isinstance(base.value, ast.Name)
+              and base.value.id in self.imports.numpy_aliases):
+            self.add("TS104", node,
+                     f"numpy host RNG 'random.{func.attr}' under trace (use "
+                     "paddle RNG / jax.random)")
+
+    # -- TS102 tensor truthiness -------------------------------------------
+    def _test_uses_param(self, test: ast.expr) -> Optional[str]:
+        """A traced-argument Name reachable in ``test`` without passing
+        through a statically-evaluable wrapper (shape/dtype attribute,
+        len/isinstance/hasattr call, `is`/`is not` comparison)."""
+        hit = []
+
+        def walk(n):
+            if hit:
+                return
+            if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+                return  # x.shape / x.ndim / ... are trace-time constants
+            if isinstance(n, ast.Call):
+                f = n.func
+                fname = f.id if isinstance(f, ast.Name) else getattr(f, "attr", "")
+                if fname in _HOST_EVAL_CALLS:
+                    return
+            if isinstance(n, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+                return
+            if isinstance(n, ast.Name) and n.id in self.params:
+                hit.append(n.id)
+                return
+            for child in ast.iter_child_nodes(n):
+                walk(child)
+
+        walk(test)
+        return hit[0] if hit else None
+
+    def _check_branch(self, node, kind):
+        name = self._test_uses_param(node.test)
+        if name is not None:
+            self.add("TS102", node,
+                     f"{kind} branches on traced argument '{name}' — python "
+                     "control flow on tensor truthiness does not trace (use "
+                     "jnp.where / lax.cond)")
+
+    def visit_If(self, node):
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_branch(node, "conditional expression")
+        self.generic_visit(node)
+
+    # -- TS105 global mutation ---------------------------------------------
+    def visit_Global(self, node):
+        self.add("TS105", node,
+                 f"mutates global state ({', '.join(node.names)}) under trace "
+                 "— retraces won't see prior mutations")
+        self.generic_visit(node)
+
+    # nested defs get their own region pass when they are traced entry
+    # points; inside a traced region they still execute under the trace,
+    # so keep descending (generic_visit default does).
+
+
+def _fn_params(fn) -> set:
+    """Parameter names that bind traced arrays. ``*args``/``**kwargs`` are
+    excluded: the vararg tuple / kwarg dict themselves are host containers
+    whose truthiness is their (static) length — the common optional-input
+    idiom ``def fn(v, *b): ... if b: ...`` is trace-safe."""
+    a = fn.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    return {n for n in names if n != "self"}
+
+
+def _check_mutable_defaults(fn, findings, filename, region):
+    defaults = list(fn.args.defaults) + [d for d in fn.args.kw_defaults if d]
+    for d in defaults:
+        bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+            isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+            and d.func.id in ("list", "dict", "set"))
+        if bad:
+            findings.append(Finding(
+                _ANALYZER, "TS106", "error",
+                f"mutable default argument on traced function '{region}' — "
+                "non-hashable static args defeat the compile cache key",
+                f"{filename}:{d.lineno}"))
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _collect_kernels(tree):
+    """(kernel node, region label) for every callable handed to
+    ``primitive``/``passthrough``. Names are resolved through the lexical
+    scope chain (innermost first) — a bare ``ast.walk`` would cross scope
+    boundaries and bind ``fn`` to the first same-named def in the file."""
+    kernels = []
+
+    def direct_locals(scope) -> Dict[str, ast.AST]:
+        """Defs/lambda-bindings made in ``scope`` itself, not in nested
+        function bodies."""
+        out: Dict[str, ast.AST] = {}
+
+        def scan(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.setdefault(child.name, child)
+                    continue  # body is a nested scope
+                if isinstance(child, ast.Lambda):
+                    continue
+                if isinstance(child, ast.Assign) and isinstance(child.value, ast.Lambda):
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Name):
+                            out.setdefault(tgt.id, child.value)
+                scan(child)
+
+        scan(scope)
+        return out
+
+    def visit_scope(scope, chain):
+        local = direct_locals(scope)
+        chain = chain + [local]
+
+        def find_calls(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _SCOPE_NODES):
+                    continue  # calls in there belong to the nested scope
+                if (isinstance(child, ast.Call)
+                        and isinstance(child.func, ast.Name)
+                        and child.func.id in _DISPATCH_FNS
+                        and len(child.args) >= 2):
+                    op_name = ""
+                    if isinstance(child.args[0], ast.Constant):
+                        op_name = str(child.args[0].value)
+                    region = f"{child.func.id}:{op_name or '?'}"
+                    kernel = child.args[1]
+                    if isinstance(kernel, ast.Lambda):
+                        kernels.append((kernel, region))
+                    elif isinstance(kernel, ast.Name):
+                        for scope_locals in reversed(chain):
+                            if kernel.id in scope_locals:
+                                kernels.append((scope_locals[kernel.id], region))
+                                break
+                find_calls(child)
+
+        find_calls(scope)
+        for nested in local.values():
+            visit_scope(nested, chain)
+
+    visit_scope(tree, [])
+    return kernels
+
+
+def lint_source(source: str, filename: str = "<string>") -> List[Finding]:
+    """Lint one module's source text; returns (unsuppressed) findings."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Finding(_ANALYZER, "TS000", "error",
+                        f"syntax error: {e.msg}", f"{filename}:{e.lineno or 0}")]
+    imports = _Imports()
+    imports.visit(tree)
+    findings: List[Finding] = []
+    checked = set()  # id() of region roots already linted
+
+    def check_region(fn_node, region_name, params=None):
+        if id(fn_node) in checked:
+            return
+        checked.add(id(fn_node))
+        if params is None:
+            params = _fn_params(fn_node)
+        checker = _RegionChecker(imports, params, findings, filename, region_name)
+        body = fn_node.body if isinstance(fn_node.body, list) else [fn_node.body]
+        for stmt in body:
+            checker.visit(stmt)
+
+    # regions 1+2: decorated / step_fn functions
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            traced = node.name == "step_fn" or any(
+                _decorator_is_to_static(d) for d in node.decorator_list)
+            if traced:
+                _check_mutable_defaults(node, findings, filename, node.name)
+                # to_static/step_fn arguments are host objects as often as
+                # tensors, so TS102 (truthiness on args) stays scoped to
+                # dispatcher kernels where every arg is a traced array.
+                check_region(node, node.name, params=set())
+
+    # region 3: kernels handed to primitive()/passthrough()
+    for kernel, region in _collect_kernels(tree):
+        check_region(kernel, region)
+
+    # a region nested inside another traced region (a kernel def inside a
+    # @to_static body) is visited from both roots; keep one finding per
+    # (code, line) so counts aren't inflated
+    deduped, seen = [], set()
+    for f in findings:
+        key = (f.code, f.location)
+        if key not in seen:
+            seen.add(key)
+            deduped.append(f)
+    return _apply_noqa(deduped, source)
+
+
+def _apply_noqa(findings: List[Finding], source: str) -> List[Finding]:
+    lines = source.splitlines()
+    kept = []
+    for f in findings:
+        try:
+            lineno = int(f.location.rsplit(":", 1)[1])
+            text = lines[lineno - 1]
+        except (IndexError, ValueError):
+            kept.append(f)
+            continue
+        m = _NOQA_RE.search(text)
+        if m:
+            codes = m.group("codes")
+            if codes is None or f.code in {c.strip().upper()
+                                           for c in codes.split(",")}:
+                continue
+        kept.append(f)
+    return kept
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    """Lint every ``.py`` file under the given files/directories. A path
+    that does not exist raises: a typo'd CI path must fail loudly, not
+    lint zero files and report green."""
+    findings: List[Finding] = []
+    files: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, names in os.walk(path):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git", ".jax_cache")]
+                files.extend(os.path.join(root, n)
+                             for n in names if n.endswith(".py"))
+        elif os.path.isfile(path) and path.endswith(".py"):
+            files.append(path)
+        else:
+            raise FileNotFoundError(
+                f"lint path '{path}' is not a directory or .py file")
+    for fname in sorted(files):
+        with open(fname, "r", encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), fname))
+    return findings

@@ -77,6 +77,9 @@ define_flag("use_pallas_kernels", True, "use Pallas TPU kernels for fused ops wh
 define_flag("log_level", 1, "framework log verbosity (higher = chattier)")
 define_flag("allocator_strategy", "xla", "memory allocator strategy (informational on TPU; XLA owns HBM)")
 define_flag("embedding_deterministic", False, "deterministic embedding grad accumulation")
+define_flag("static_verify_program", False,
+            "run the analysis verify pass over a static Program before "
+            "Executor.run compiles it (paddle_tpu.analysis.program_verify)")
 define_flag("cudnn_deterministic", False, "accepted for compat; XLA is deterministic by default")
 
 

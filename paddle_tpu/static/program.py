@@ -101,7 +101,33 @@ class Program:
         p.ops = list(self.ops)
         p.feeds = dict(self.feeds)
         p.feed_specs = dict(self.feed_specs)
+        # the placeholder Tensors whose ids key `feeds` must stay alive via
+        # the clone too: a clone that outlives the original would otherwise
+        # replay against reused ids (analysis PV009 clone invariant)
+        p._placeholders = list(getattr(self, "_placeholders", []))
         return p
+
+    # -- verification (paddle_tpu.analysis.program_verify) -------------------
+    def verify(self, fetch_list=None, raise_on_error: bool = True):
+        """Well-formedness pass over the recorded IR (the reference's PIR
+        verify analog): SSA/def-before-use, feed/fetch resolution, recorded
+        shape/dtype vs producer, signature arity vs ops/op_defs.py, dead
+        nodes. Returns the findings list; raises ``EnforceError`` on any
+        error-severity finding unless ``raise_on_error=False``."""
+        from ..analysis import errors as _errors
+        from ..analysis.program_verify import verify_program
+
+        fetch_ids = None
+        if fetch_list is not None:
+            fetch_ids = [t if isinstance(t, int) else id(t) for t in fetch_list]
+        findings = verify_program(self, fetch_ids=fetch_ids)
+        errors = _errors(findings)
+        if errors and raise_on_error:
+            from ..base.enforce import PreconditionNotMetError
+
+            raise PreconditionNotMetError(
+                "Program.verify failed:\n  " + "\n  ".join(str(f) for f in errors))
+        return findings
 
     def constants(self) -> Dict[int, Tensor]:
         """By-reference constant tensors (parameters): 'v' bindings never
@@ -249,6 +275,21 @@ class Executor:
         if not program.ops:
             return []  # startup program: parameters already initialized eagerly
         fetch_ids = [id(t) for t in fetch_list]
+
+        from ..base.flags import get_flag
+
+        if get_flag("static_verify_program"):
+            # debug gate (FLAGS_static_verify_program): run the analysis
+            # verify pass once per program version before compiling it.
+            # The marker lives ON the program so a reused id of a collected
+            # program can never skip verification of a new one.
+            key = (program._version, tuple(fetch_ids))
+            done = getattr(program, "_verified_keys", None)
+            if done is None:
+                done = program._verified_keys = set()
+            if key not in done:
+                program.verify(fetch_list=fetch_ids)
+                done.add(key)
 
         feed_vals = {}
         for name in program.feeds:

@@ -1,0 +1,58 @@
+"""CI gate: the repo itself passes its own static analysis.
+
+Runs all three ``paddle_tpu.analysis`` analyzers over the live codebase
+and asserts ZERO error-severity findings, so a regression (a new
+jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug)
+fails tier-1 instead of rotting until pod scale. The ``python -m
+tools.lint`` CLI contract (exit 0, machine-readable JSON) is gated here
+too.
+"""
+import json
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _errors(findings):
+    from paddle_tpu.analysis import errors
+
+    return [str(f) for f in errors(findings)]
+
+
+def test_trace_safety_clean_over_source_tree():
+    from paddle_tpu.analysis.trace_safety import lint_paths
+
+    findings = lint_paths([os.path.join(_REPO, "paddle_tpu")])
+    assert _errors(findings) == []
+
+
+def test_registry_gate_green():
+    from paddle_tpu.analysis.registry_check import check_registry
+
+    findings = check_registry()
+    assert _errors(findings) == []
+
+
+def test_program_verifier_green_on_recorded_program():
+    from paddle_tpu.analysis.program_verify import (
+        record_demo_program, verify_clone, verify_program)
+
+    main, x, hidden, loss = record_demo_program()
+    findings = verify_program(main, fetch_ids=[id(loss), id(hidden)])
+    assert _errors(findings) == []
+    assert _errors(verify_clone(main, main.clone(for_test=True))) == []
+
+
+def test_cli_exits_zero_with_machine_readable_findings(capsys):
+    """`tools.lint --json` over the repo: exit 0, parseable. Run in-process
+    (the three tests above already paid the analyzer costs once; a fresh
+    subprocess would re-import jax + paddle_tpu just to check exit code)."""
+    import tools.lint as lint_cli
+
+    rc = lint_cli.main(["--json"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    payload = json.loads(out)
+    assert payload["errors"] == 0
+    assert set(payload["analyzers"]) == {"trace", "registry", "program"}
+    assert isinstance(payload["findings"], list)

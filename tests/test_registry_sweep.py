@@ -621,7 +621,24 @@ def _run_case(case):
     return case.fw(*tensors), tensors
 
 
-@pytest.mark.parametrize("name", sorted(CASES))
+# Quick-loop balance (ISSUE 1 / VERDICT r5 weak #5): the sweep's heaviest
+# single cases — multi-second XLA compiles per the tier-1 --durations
+# profile — ride the slow lane. The full tier still runs under `-m slow`,
+# and test_sweep_accounting pins CASES itself, so numeric coverage cannot
+# silently shrink by growing these sets.
+_SLOW_OUTPUT = {"roi_align", "sparse_attention", "temporal_shift",
+                "trilinear_interp", "poisson", "warpctc", "yolo_loss",
+                "bicubic_interp", "deformable_conv", "roi_pool"}
+_SLOW_GRAD = {"flash_attn", "grid_sample", "temporal_shift",
+              "trilinear_interp", "conv2d", "conv2d_transpose", "roi_align"}
+
+
+def _lane(names, heavy):
+    return [pytest.param(n, marks=pytest.mark.slow) if n in heavy else n
+            for n in names]
+
+
+@pytest.mark.parametrize("name", _lane(sorted(CASES), _SLOW_OUTPUT))
 def test_sweep_output(name):
     case = CASES[name]
     out, _ = _run_case(case)
@@ -652,7 +669,7 @@ GRAD_CASES = sorted(
     if c.grad_wrt and OP_DEFS[n]["backward"] is not None)
 
 
-@pytest.mark.parametrize("name", GRAD_CASES)
+@pytest.mark.parametrize("name", _lane(GRAD_CASES, _SLOW_GRAD))
 def test_sweep_grad(name):
     from op_test import check_grad
 

@@ -1,0 +1,319 @@
+"""paddle_tpu.analysis: positive/negative cases for each analyzer.
+
+Each analyzer must (a) stay silent on well-formed input and (b) catch its
+seeded negative: a deliberately corrupted Program fails verify(), a
+jit-unsafe source snippet trips the trace linter, a broken alias/registry
+row trips the consistency gate. (ISSUE 1 acceptance criteria.)
+"""
+import copy
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import Finding
+from paddle_tpu.analysis.program_verify import verify_clone, verify_program
+from paddle_tpu.analysis.registry_check import check_registry
+from paddle_tpu.analysis.trace_safety import lint_source
+
+
+# ---------------------------------------------------------------- helpers
+def _record_fc_program():
+    """The shared well-formed program (data → fc → mean over one feed)."""
+    from paddle_tpu.analysis.program_verify import record_demo_program
+
+    return record_demo_program()
+
+
+def _codes(findings):
+    return {f.code for f in findings}
+
+
+# ---------------------------------------------------------------- program
+class TestProgramVerifier:
+    def test_well_formed_program_is_clean(self):
+        main, x, hidden, loss = _record_fc_program()
+        findings = verify_program(main, fetch_ids=[id(loss), id(hidden)])
+        assert [f for f in findings if f.severity == "error"] == [], \
+            [str(f) for f in findings]
+        # and via the wired method
+        assert main.verify(fetch_list=[loss, hidden]) is not None
+
+    def test_dangling_input_rejected(self):
+        main, *_ = _record_fc_program()
+        bad = main.clone()
+        node = copy.copy(bad.ops[-1])
+        node.arg_specs = [("v", 0xDEAD_BEEF, None)]  # input nobody produces
+        bad.ops[-1] = node
+        assert "PV004" in _codes(verify_program(bad))
+        from paddle_tpu.base.enforce import PreconditionNotMetError
+
+        with pytest.raises(PreconditionNotMetError, match="PV004"):
+            bad.verify()
+
+    def test_use_before_def_rejected(self):
+        main, *_ = _record_fc_program()
+        bad = main.clone()
+        bad.ops = list(reversed(bad.ops))
+        assert "PV001" in _codes(verify_program(bad))
+
+    def test_duplicate_definition_rejected(self):
+        main, *_ = _record_fc_program()
+        bad = main.clone()
+        dup = copy.copy(bad.ops[0])
+        bad.ops = bad.ops + [dup]  # same out ids claimed twice
+        assert "PV002" in _codes(verify_program(bad))
+
+    def test_dtype_mismatch_vs_producer_rejected(self):
+        main, *_ = _record_fc_program()
+        bad = main.clone()
+        produced_tid = bad.ops[0].out_ids[0]
+        wrong = paddle.Tensor(np.zeros((3, 3), np.float64))
+        node = copy.copy(bad.ops[-1])
+        node.arg_specs = [("v", produced_tid, wrong)]
+        bad.ops[-1] = node
+        assert "PV005" in _codes(verify_program(bad))
+
+    def test_unresolvable_fetch_rejected(self):
+        main, *_ = _record_fc_program()
+        findings = verify_program(main, fetch_ids=[123456789])
+        assert "PV007" in _codes(findings)
+
+    def test_dead_node_reported_as_warning(self):
+        main, x, hidden, loss = _record_fc_program()
+        # fetching only `hidden` leaves the mean node outside the slice
+        findings = verify_program(main, fetch_ids=[id(hidden)])
+        dead = [f for f in findings if f.code == "PV008"]
+        assert dead and all(f.severity == "warning" for f in dead)
+        # warnings never make verify() raise
+        main.verify(fetch_list=[hidden])
+
+    def test_shadowed_feed_rejected(self):
+        main, *_ = _record_fc_program()
+        bad = main.clone()
+        bad.feeds = dict(bad.feeds)
+        bad.feeds["shadow"] = bad.ops[0].out_ids[0]  # feed id an op produces
+        bad.feed_specs = dict(bad.feed_specs)
+        bad.feed_specs["shadow"] = ((1,), "float32")
+        assert "PV003" in _codes(verify_program(bad))
+
+    def test_clone_invariants(self):
+        main, *_ = _record_fc_program()
+        good = main.clone(for_test=True)
+        assert verify_clone(main, good) == []
+        # clone must retain the feed placeholder refs (the pre-fix defect)
+        assert getattr(good, "_placeholders", None), \
+            "clone() dropped the feed placeholders"
+        dropped = main.clone()
+        dropped._placeholders = []
+        assert "PV009" in _codes(verify_clone(main, dropped))
+        truncated = main.clone()
+        truncated.ops = truncated.ops[:-1]
+        assert "PV009" in _codes(verify_clone(main, truncated))
+
+    def test_executor_debug_flag_verifies(self):
+        from paddle_tpu.base import flags
+
+        main, x, hidden, loss = _record_fc_program()
+        flags.set_flags({"static_verify_program": True})
+        try:
+            exe = paddle.static.Executor()
+            (out,) = exe.run(main, feed={"x": np.ones((2, 8), np.float32)},
+                             fetch_list=[loss])
+            assert np.isfinite(out).all()
+            # corrupted program: the same flag makes Executor.run raise
+            bad = main.clone()
+            node = copy.copy(bad.ops[-1])
+            node.arg_specs = [("v", 0xBAD, None)]
+            bad.ops[-1] = node
+            from paddle_tpu.base.enforce import PreconditionNotMetError
+
+            with pytest.raises(PreconditionNotMetError):
+                exe.run(bad, feed={"x": np.ones((2, 8), np.float32)},
+                        fetch_list=[loss])
+        finally:
+            flags.set_flags({"static_verify_program": False})
+
+
+# ---------------------------------------------------------------- trace
+_JIT_UNSAFE_SNIPPET = '''
+import time
+import random
+import numpy as np
+from paddle_tpu.jit import to_static
+
+@to_static
+def step(x, scale=[1.0]):
+    global _COUNT
+    _COUNT = 1
+    v = x.numpy()
+    t = time.time()
+    r = random.random()
+    q = np.random.randn(3)
+    return v + t + r + q.sum()
+
+def kernel_op(x):
+    def fn(v):
+        if v:
+            v = v + 1
+        while v > 0:
+            v = v - 1
+        return v.item()
+    return primitive("bad_op", fn, [x])
+'''
+
+_CLEAN_SNIPPET = '''
+import jax.numpy as jnp
+from paddle_tpu.jit import to_static
+
+@to_static
+def step(x, scale=1.0):
+    return x * scale
+
+def optional_bias_op(x, bias=None):
+    def fn(v, *b):
+        if b:                      # vararg tuple truthiness: static
+            v = v + b[0]
+        if v.ndim == 2:            # shape attribute: trace-time constant
+            v = v * 2
+        if not jnp.iscomplexobj(v):  # dtype predicate: static
+            v = v + 0.0
+        return v
+    return primitive("good_op", fn, [x] + ([bias] if bias is not None else []))
+
+def host_side_helper(idx):
+    # outside any traced region: host syncs are fine here
+    return int(idx.item())
+'''
+
+
+class TestTraceSafetyLinter:
+    def test_jit_unsafe_snippet_trips_every_rule(self):
+        findings = lint_source(_JIT_UNSAFE_SNIPPET, "snippet.py")
+        codes = _codes(findings)
+        assert {"TS101", "TS102", "TS103", "TS104",
+                "TS105", "TS106"} <= codes, sorted(codes)
+        assert all(isinstance(f, Finding) and f.location.startswith("snippet.py:")
+                   for f in findings)
+
+    def test_clean_snippet_is_silent(self):
+        assert lint_source(_CLEAN_SNIPPET, "clean.py") == []
+
+    def test_noqa_suppression(self):
+        src = ('def op(x):\n'
+               '    def fn(v):\n'
+               '        return v.item()  # noqa: TS101\n'
+               '    return primitive("op", fn, [x])\n')
+        assert lint_source(src, "s.py") == []
+        # a different code on the noqa does NOT suppress
+        src_other = src.replace("TS101", "TS999")
+        assert _codes(lint_source(src_other, "s.py")) == {"TS101"}
+
+    def test_bare_numpy_random_import_flagged(self):
+        src = ('from numpy.random import randn\n'
+               'def op(x):\n'
+               '    def fn(v):\n'
+               '        return v + randn(3)\n'
+               '    return primitive("op", fn, [x])\n')
+        assert _codes(lint_source(src, "s.py")) == {"TS104"}
+
+    def test_step_fn_is_a_traced_region(self):
+        src = ('import time\n'
+               'def step_fn(batch):\n'
+               '    return time.time()\n')
+        assert _codes(lint_source(src, "s.py")) == {"TS103"}
+
+    def test_repo_source_tree_lints(self, tmp_path):
+        # lint_paths walks directories and skips caches
+        f = tmp_path / "mod.py"
+        f.write_text("def op(x):\n    def fn(v):\n        return v.numpy()\n"
+                     "    return passthrough('op', fn, [x])\n")
+        (tmp_path / "__pycache__").mkdir()
+        (tmp_path / "__pycache__" / "junk.py").write_text("def f(:\n")
+        from paddle_tpu.analysis.trace_safety import lint_paths
+
+        findings = lint_paths([str(tmp_path)])
+        assert _codes(findings) == {"TS101"}
+
+    def test_syntax_error_reported_not_raised(self):
+        findings = lint_source("def broken(:\n", "bad.py")
+        assert _codes(findings) == {"TS000"}
+
+
+# ---------------------------------------------------------------- registry
+class TestRegistryGate:
+    def test_live_registry_is_green(self):
+        assert check_registry() == []
+
+    def test_dead_alias_rejected(self):
+        from paddle_tpu.ops import registry
+
+        registry._ALIASES["totally_fake_op"] = "paddle_tpu.nonexistent:nope"
+        try:
+            codes = _codes(check_registry())
+            assert "RC202" in codes  # target does not resolve
+            assert "RC203" in codes  # no OP_DEFS row, not declared
+        finally:
+            del registry._ALIASES["totally_fake_op"]
+        assert check_registry() == []
+
+    def test_broken_alias_signature_rejected(self):
+        from paddle_tpu.ops import registry, yaml_compat
+
+        def _needs_five(a, b, c, d, e):  # pragma: no cover - never called
+            raise AssertionError
+
+        yaml_compat._lint_probe_impl = _needs_five
+        registry._ALIASES["abs"] = "paddle_tpu.ops.yaml_compat:_lint_probe_impl"
+        try:
+            findings = check_registry()
+            assert any(f.code == "RC204" and f.location == "abs"
+                       for f in findings), [str(f) for f in findings]
+        finally:
+            del registry._ALIASES["abs"]
+            del yaml_compat._lint_probe_impl
+
+    def test_ambiguous_amp_stem_rejected(self):
+        from paddle_tpu.ops.op_defs import OP_DEFS
+
+        # matches _BLACK_RE ('softmax') AND _WHITE_RE ('matmul'); xpu tier
+        # keeps RC201 out of the way
+        OP_DEFS["softmax_matmul_probe"] = {
+            "args": (), "outputs": ("out",), "backward": None,
+            "inplace": None, "forward_only": True, "tier": "xpu"}
+        try:
+            findings = check_registry()
+            assert any(f.code == "RC205" and f.location == "softmax_matmul_probe"
+                       for f in findings), [str(f) for f in findings]
+        finally:
+            del OP_DEFS["softmax_matmul_probe"]
+        assert check_registry() == []
+
+    def test_unknown_amp_override_rejected(self):
+        from paddle_tpu.ops import registry
+
+        registry._AMP_OVERRIDES["ghost_op"] = "purple"
+        try:
+            codes = _codes(check_registry())
+            assert "RC206" in codes
+        finally:
+            del registry._AMP_OVERRIDES["ghost_op"]
+
+    def test_malformed_op_row_rejected(self):
+        bad_defs = {
+            "no_keys": {"args": ()},
+            "bad_tier": {"args": (), "outputs": ("out",), "backward": None,
+                         "inplace": None, "forward_only": True, "tier": "gpu"},
+            "no_outputs": {"args": (), "outputs": (), "backward": None,
+                           "inplace": None, "forward_only": True, "tier": "xpu"},
+        }
+        findings = check_registry(op_defs=bad_defs, aliases={})
+        assert {f.location for f in findings if f.code == "RC200"} == \
+            {"no_keys", "bad_tier", "no_outputs"}
+
+    def test_unresolved_dense_row_rejected(self):
+        defs = {"definitely_not_an_op_xyz": {
+            "args": (("Tensor", "x"),), "outputs": ("out",), "backward": None,
+            "inplace": None, "forward_only": True, "tier": "dense"}}
+        findings = check_registry(op_defs=defs, aliases={})
+        assert any(f.code == "RC201" for f in findings)
