@@ -114,6 +114,10 @@ def bench_gpt(on_tpu):
         extras["dispatch"] = _dispatcher_microbench()
     except Exception as e:  # never let the microbench sink the headline
         extras["dispatch"] = {"error": str(e).split("\n")[0][:200]}
+    try:
+        extras["lint"] = _lint_bench(step)
+    except Exception as e:
+        extras["lint"] = {"error": str(e).split("\n")[0][:200]}
     return f"{name}_train_tokens_per_sec", tok_s, "tokens/sec", extras
 
 
@@ -147,6 +151,34 @@ def _dispatcher_microbench(n=2000):
     return {"framework_ns_per_op": round(disp_ns),
             "raw_jnp_ns_per_op": round(raw_ns),
             "overhead_x": round(disp_ns / raw_ns, 2)}
+
+
+def _lint_bench(step):
+    """Lint-cost tracking (ISSUE 2 bench satellite): wall-time of the
+    static ``tools.lint`` analyzer families (trace + registry + spmd —
+    the CPU-only passes every commit pays; the program/jaxpr demos are
+    excluded here because they compile a fresh model, which would tax a
+    TPU bench's budget), plus proof the audit tier is strictly on-demand:
+    ``audit_report()`` on the live bench TrainStep must read counters in
+    microseconds and build nothing new."""
+    from tools.lint import run_analyzers
+
+    t0 = time.perf_counter()
+    findings, crashed = run_analyzers(("trace", "registry", "spmd"))
+    lint_s = time.perf_counter() - t0
+    builds_before = sum(step._compiled._compile_counts.values())
+    t0 = time.perf_counter()
+    report = step.audit_report()
+    report_us = (time.perf_counter() - t0) * 1e6
+    return {
+        "lint_wall_s": round(lint_s, 3),
+        "lint_findings": len(findings),
+        "lint_crashed": crashed,
+        "audit_report_us": round(report_us, 1),
+        "audit_builds_delta": (sum(step._compiled._compile_counts.values())
+                               - builds_before),
+        "cache_keys": report["n_cache_keys"],
+    }
 
 
 def _pure_jax_gpt_control(cfg, batch, seq, steps):

@@ -3,7 +3,7 @@
 Rebuild of the reference's well-formedness tier: PIR's verify pass
 (paddle/pir/src/core/ir_verify.cc, run after every pass pipeline) and the
 YAML-driven consistency checks its codegen applies to the op library. On
-the JAX rebuild the same guarantees are delivered by three CPU-only
+the JAX rebuild the same guarantees are delivered by five CPU-only
 analyzers that run at commit time:
 
 - :mod:`program_verify` — well-formedness pass over the recorded
@@ -15,10 +15,20 @@ analyzers that run at commit time:
   tensor truthiness, clock/entropy reads, global mutation under trace).
 - :mod:`registry_check` — promotes ``registry.alias_signature_report()``
   from advisory to enforced: every op row resolves, alias signatures
-  bind, AMP lists stay disjoint, profiler tags stay valid.
+  bind, AMP lists stay disjoint, profiler tags stay valid, legacy
+  ``op_compat`` names keep resolving.
+- :mod:`jaxpr_audit` — trace-level verification of what the jit
+  functionalizer hands to XLA: host callbacks, 64-bit dtype leaks,
+  donation/output aliasing, dead values, guard-family coverage, and the
+  recompilation audit (cache-key cardinality, static-key hygiene,
+  bucket-ladder growth). Also ``CompiledFunction.audit()`` /
+  ``audit_report()``.
+- :mod:`spmd_check` — static mesh-axis resolution for collectives,
+  shard_map/spmd regions and PartitionSpec annotations (SP4xx).
 
-One CLI drives all three: ``python -m tools.lint`` (exit 1 on any
-error-severity finding; ``--json`` for machine-readable output).
+One CLI drives all five: ``python -m tools.lint`` (exit 1 on any
+error-severity finding, 2 on an analyzer crash; ``--json`` for
+machine-readable output; ``--select``/``--ignore`` for code filters).
 """
 from __future__ import annotations
 
@@ -26,7 +36,11 @@ from dataclasses import dataclass, field
 
 __all__ = [
     "Finding",
+    "audit_compiled_function",
+    "audit_jaxpr",
     "check_registry",
+    "check_spmd_paths",
+    "check_spmd_source",
     "lint_paths",
     "lint_source",
     "verify_program",
@@ -65,6 +79,29 @@ def errors(findings) -> list:
     return [f for f in findings if f.severity == "error"]
 
 
+def iter_py_files(paths) -> list:
+    """Every ``.py`` file under the given files/directories, sorted, with
+    caches pruned. Shared by the source-scanning analyzers (trace, spmd)
+    so they walk identically. A path that does not exist raises: a typo'd
+    CI path must fail loudly, not lint zero files and report green."""
+    import os
+
+    files = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, names in os.walk(path):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git", ".jax_cache")]
+                files.extend(os.path.join(root, n)
+                             for n in names if n.endswith(".py"))
+        elif os.path.isfile(path) and path.endswith(".py"):
+            files.append(path)
+        else:
+            raise FileNotFoundError(
+                f"lint path '{path}' is not a directory or .py file")
+    return sorted(files)
+
+
 # Re-exported lazily-importable entry points (keep `import paddle_tpu`
 # cheap: the analyzers pull ast/inspect only when actually called).
 def verify_program(program, fetch_ids=None):
@@ -89,3 +126,27 @@ def check_registry(**kwargs):
     from .registry_check import check_registry as _impl
 
     return _impl(**kwargs)
+
+
+def audit_compiled_function(cf, **kwargs):
+    from .jaxpr_audit import audit_compiled_function as _impl
+
+    return _impl(cf, **kwargs)
+
+
+def audit_jaxpr(closed_jaxpr, **kwargs):
+    from .jaxpr_audit import audit_jaxpr as _impl
+
+    return _impl(closed_jaxpr, **kwargs)
+
+
+def check_spmd_paths(paths, **kwargs):
+    from .spmd_check import check_paths as _impl
+
+    return _impl(paths, **kwargs)
+
+
+def check_spmd_source(source, filename="<string>", **kwargs):
+    from .spmd_check import check_source as _impl
+
+    return _impl(source, filename, **kwargs)

@@ -1,0 +1,388 @@
+"""Jaxpr auditor: trace-level verification of compiled programs (JX3xx).
+
+PR 1's analysis tier stops at the AST (:mod:`trace_safety`) and the
+recorded static ``Program`` (:mod:`program_verify`); this pass inspects
+what the functionalizer actually hands to XLA — the ClosedJaxpr of every
+``CompiledFunction`` cache entry, re-derived with ``jax.make_jaxpr`` over
+the entry's recorded ``pure`` wrapper (trace only, no XLA compilation).
+TPU-fatal defects that only exist at this level:
+
+JX300  audit retrace failed    the entry's pure wrapper no longer traces
+JX301  host callback           pure_callback/io_callback/debug_callback
+                               (jax.debug.print) inside the compiled
+                               program — a per-step host round-trip on TPU
+JX302  64-bit dtype leak       float64/complex128 aval (error) or
+                               int64/uint64 aval (warning) in the program:
+                               silently 3-8x slower or unsupported on TPU
+JX303  dead value              a user output that is a trace-time constant
+                               (baked at trace), or a captured cell the
+                               program neither reads nor updates
+                               (over-capture) — warnings
+JX304  donation alias          a user-visible output aliases a donated
+                               cell buffer: the next step's donation
+                               invalidates the array the caller still holds
+JX305  dynamic shape           an aval whose dim is not a concrete int —
+                               XLA on TPU compiles static shapes only
+JX306  guard coverage          a guarded family whose recorded branch
+                               signature has no specialization (error), or
+                               that degraded to committed eager fallback
+                               (warning, with the recorded reason)
+
+Recompilation audit (cache-key cardinality, on the same findings stream):
+
+JX310  cache growth            distinct cache keys exceed the
+                               ``jaxpr_audit_max_cache_keys`` flag —
+                               unbounded retrace suspect (warning)
+JX311  float static key        ``static_key_fn`` returned a float-valued
+                               key: every distinct value compiles a new
+                               program (error)
+JX312  unhashable static key   ``static_key_fn`` result is unhashable —
+                               the cache lookup itself would raise (error)
+JX313  bucket ladder           a ``BucketedFunction`` ladder implying more
+                               programs than the cache-key budget, or a
+                               non-monotonic bucket list (error)
+
+Entry points: ``CompiledFunction.audit()`` / ``TrainStep.audit()`` (this
+module's :func:`audit_compiled_function`), and the ``jaxpr`` analyzer of
+``python -m tools.lint`` which audits a freshly built representative
+train step. ``audit_report()`` is the no-trace companion: per-cache-key
+build counts from counters maintained at build time, so the hot
+``CompiledFunction.__call__`` path carries zero audit cost.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from . import Finding
+
+_ANALYZER = "jaxpr"
+
+# primitives that escape to the host from inside a compiled program
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                   "callback", "host_callback_call", "outside_call"}
+_F64_DTYPES = {"float64", "complex128"}
+_I64_DTYPES = {"int64", "uint64"}
+
+
+def _iter_jaxprs(jaxpr):
+    """Yield ``jaxpr`` and every sub-jaxpr reachable through eqn params
+    (pjit/scan/while/cond bodies)."""
+    import jax
+
+    seen = []
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        seen.append(j)
+        for eqn in j.eqns:
+            for v in eqn.params.values():
+                vs = v if isinstance(v, (list, tuple)) else (v,)
+                for item in vs:
+                    if isinstance(item, jax.core.ClosedJaxpr):
+                        stack.append(item.jaxpr)
+                    elif isinstance(item, jax.core.Jaxpr):
+                        stack.append(item)
+    return seen
+
+
+def _aval_dtype(var):
+    aval = getattr(var, "aval", None)
+    return str(getattr(aval, "dtype", "")) if aval is not None else ""
+
+
+def _aval_shape(var):
+    aval = getattr(var, "aval", None)
+    return getattr(aval, "shape", ()) if aval is not None else ()
+
+
+def audit_jaxpr(closed_jaxpr, *, location: str = "",
+                n_cells: int = 0, n_user_outs: Optional[int] = None,
+                donated: bool = False, cell_names=None) -> List[Finding]:
+    """Walk one ClosedJaxpr and emit JX301-JX305 findings.
+
+    ``n_cells`` leading invars are the functionalizer's state cells;
+    outvars are laid out ``[user outputs..., new cell values..., guard
+    predicates...]`` with ``n_user_outs`` user leaves (None disables the
+    segment-aware checks JX303-outputs/JX304)."""
+    import jax
+
+    findings: List[Finding] = []
+
+    def add(code, severity, message, loc_suffix=""):
+        findings.append(Finding(
+            _ANALYZER, code, severity, message,
+            f"{location}{loc_suffix}" if location else loc_suffix))
+
+    jaxpr = closed_jaxpr.jaxpr
+    seen_cb = set()
+    seen_dtype = set()
+    for j in _iter_jaxprs(jaxpr):
+        for eqn in j.eqns:
+            pname = eqn.primitive.name
+            if pname in _CALLBACK_PRIMS and pname not in seen_cb:
+                seen_cb.add(pname)
+                add("JX301", "error",
+                    f"host callback primitive '{pname}' inside the compiled "
+                    "program — a per-step host round-trip stalls the TPU "
+                    "pipeline (jax.debug.print / io_callback / pure_callback "
+                    "under trace)")
+            for var in list(eqn.invars) + list(eqn.outvars):
+                dt = _aval_dtype(var)
+                if dt in _F64_DTYPES and dt not in seen_dtype:
+                    seen_dtype.add(dt)
+                    add("JX302", "error",
+                        f"{dt} value inside the compiled program ('{pname}') "
+                        "— f64 silently degrades or fails on TPU; cast to "
+                        "float32/bfloat16 before trace")
+                elif dt in _I64_DTYPES and dt not in seen_dtype:
+                    seen_dtype.add(dt)
+                    add("JX302", "warning",
+                        f"{dt} value inside the compiled program ('{pname}') "
+                        "— 64-bit ints are emulated on TPU")
+                for dim in _aval_shape(var):
+                    if not isinstance(dim, int):
+                        add("JX305", "error",
+                            f"dynamic dimension {dim!r} in an aval of "
+                            f"'{pname}' — XLA TPU programs are static-shape "
+                            "only")
+                        break
+
+    # 64-bit leaks on the program boundary (inputs/outputs) too
+    for var in list(jaxpr.invars) + list(jaxpr.outvars):
+        dt = _aval_dtype(var)
+        if dt in _F64_DTYPES and dt not in seen_dtype:
+            seen_dtype.add(dt)
+            add("JX302", "error",
+                f"{dt} value on the compiled program boundary — f64 "
+                "silently degrades or fails on TPU")
+
+    if n_user_outs is None:
+        return findings
+
+    used = set()
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if isinstance(v, jax.core.Var):
+                used.add(v)
+
+    cell_invars = list(jaxpr.invars[:n_cells])
+    outvars = list(jaxpr.outvars)
+    user_outs = outvars[:n_user_outs]
+    cell_outs = outvars[n_user_outs:n_user_outs + n_cells]
+    constvars = set(jaxpr.constvars)
+
+    # JX303: user outputs that are trace-time constants
+    for i, v in enumerate(user_outs):
+        if isinstance(v, jax.core.Literal) or v in constvars:
+            add("JX303", "warning",
+                f"output #{i} is a trace-time constant — it was baked in "
+                "during tracing (e.g. a live cell Tensor returned after its "
+                "value was restored) and will never change across calls",
+                f":out[{i}]")
+
+    # JX303: captured cells the program neither reads nor updates
+    for i, (cin, cout) in enumerate(zip(cell_invars, cell_outs)):
+        if cin not in used and cout is cin:
+            name = None
+            if cell_names and i < len(cell_names):
+                name = cell_names[i]
+            add("JX303", "warning",
+                f"captured cell #{i}{f' ({name})' if name else ''} is never "
+                "read or updated by the program — discovery over-captured "
+                "state", f":cell[{i}]")
+
+    # JX304: user-visible outputs aliasing donated cell buffers
+    if donated:
+        donated_vars = set(cell_invars)
+        cell_out_vars = {v for v in cell_outs if isinstance(v, jax.core.Var)}
+        for i, v in enumerate(user_outs):
+            if not isinstance(v, jax.core.Var):
+                continue
+            if v in donated_vars or v in cell_out_vars:
+                add("JX304", "error",
+                    f"output #{i} aliases a donated cell buffer — the next "
+                    "step's donation invalidates the array the caller still "
+                    "holds (return a copy, or disable donate_cells)",
+                    f":out[{i}]")
+
+    return findings
+
+
+def _audit_entry(cf, entry, *, location: str, donated: bool) -> List[Finding]:
+    """Retrace one cache entry's pure wrapper (no compilation) and audit
+    the resulting ClosedJaxpr."""
+    import jax
+    import numpy as np
+
+    pure = entry.get("pure") or getattr(entry.get("jitted"), "__wrapped__", None)
+    abstract_call = entry.get("abstract_call")
+    if pure is None or abstract_call is None:
+        return [Finding(_ANALYZER, "JX300", "error",
+                        "cache entry records no pure wrapper / abstract call "
+                        "to retrace (entry predates the audit tier?)",
+                        location)]
+    cells = entry["cells"]
+    try:
+        cell_sds = [jax.ShapeDtypeStruct(np.shape(c._value), c._value.dtype)
+                    for c in cells]
+        args, kwargs = abstract_call
+        closed, out_shape = jax.make_jaxpr(pure, return_shape=True)(
+            cell_sds, args, kwargs)
+    except Exception as e:
+        return [Finding(_ANALYZER, "JX300", "error",
+                        f"audit retrace failed: {str(e).splitlines()[0]}",
+                        location)]
+    n_user_outs = len(jax.tree_util.tree_leaves(out_shape[0]))
+    return audit_jaxpr(
+        closed, location=location, n_cells=len(cells),
+        n_user_outs=n_user_outs, donated=donated,
+        cell_names=[getattr(c, "name", None) for c in cells])
+
+
+def _contains_float(value) -> bool:
+    import numpy as np
+
+    if isinstance(value, (float, np.floating)):
+        return True
+    if isinstance(value, (tuple, list, set, frozenset)):
+        return any(_contains_float(v) for v in value)
+    if isinstance(value, dict):
+        return any(_contains_float(v) for v in list(value.keys()) + list(value.values()))
+    return False
+
+
+def _max_cache_keys(override=None) -> int:
+    if override is not None:
+        return int(override)
+    try:
+        from ..base.flags import get_flag
+
+        return int(get_flag("jaxpr_audit_max_cache_keys"))
+    except Exception:
+        return 32
+
+
+def audit_compiled_function(cf, max_cache_keys=None) -> List[Finding]:
+    """Audit every cache entry of one ``CompiledFunction`` plus the
+    recompilation heuristics. Tracing only — never compiles."""
+    findings: List[Finding] = []
+    name = getattr(cf, "name", "fn")
+
+    for idx, (key, entry) in enumerate(list(cf._cache.items())):
+        loc = f"{name}[{idx}]"
+        if entry.get("guarded"):
+            if entry.get("eager"):
+                findings.append(Finding(
+                    _ANALYZER, "JX306", "warning",
+                    "guard family committed to eager fallback: "
+                    f"{cf.fallback_reason or 'unrecorded reason'} — branch "
+                    "coverage lost, steps run uncompiled", loc))
+                continue
+            if entry["last"] not in entry["entries"]:
+                findings.append(Finding(
+                    _ANALYZER, "JX306", "error",
+                    f"recorded branch signature {entry['last']} has no "
+                    "specialized entry and no fallback — the next call on "
+                    "this path cannot resolve to a program", loc))
+            for outcomes, sub in entry["entries"].items():
+                findings.extend(_audit_entry(
+                    cf, sub, location=f"{loc}:guards={outcomes}",
+                    donated=False))
+        elif entry.get("eager"):
+            findings.append(Finding(
+                _ANALYZER, "JX306", "warning",
+                "entry committed to eager fallback: "
+                f"{cf.fallback_reason or 'unrecorded reason'}", loc))
+        else:
+            findings.extend(_audit_entry(
+                cf, entry, location=loc,
+                donated=bool(getattr(cf, "donate_cells", False))))
+
+    # ---- recompilation audit -------------------------------------------
+    limit = _max_cache_keys(max_cache_keys)
+    if len(cf._cache) > limit:
+        findings.append(Finding(
+            _ANALYZER, "JX310", "warning",
+            f"{len(cf._cache)} distinct cache keys (> {limit}) — every key "
+            "is one compiled program; unbounded key growth means unbounded "
+            "retrace (check static_key_fn and input-shape churn)", name))
+
+    key_fn = getattr(cf, "static_key_fn", None)
+    if key_fn is not None:
+        try:
+            static_key = key_fn()
+        except Exception as e:
+            findings.append(Finding(
+                _ANALYZER, "JX312", "error",
+                f"static_key_fn raised at audit time: {e}", name))
+        else:
+            try:
+                hash(static_key)
+            except TypeError:
+                findings.append(Finding(
+                    _ANALYZER, "JX312", "error",
+                    f"static_key_fn returned an unhashable "
+                    f"{type(static_key).__name__} — the compile-cache lookup "
+                    "itself raises on every call", name))
+            else:
+                if _contains_float(static_key):
+                    findings.append(Finding(
+                        _ANALYZER, "JX311", "error",
+                        f"static_key_fn returned a float-valued key "
+                        f"{static_key!r} — every distinct value compiles a "
+                        "new program (quantize it, or pass it as a traced "
+                        "input)", name))
+    return findings
+
+
+def audit_bucketed_function(bf, max_cache_keys=None) -> List[Finding]:
+    """Audit a ``BucketedFunction``: the wrapped cache plus the ladder
+    heuristics (JX313)."""
+    findings = audit_compiled_function(bf._compiled,
+                                       max_cache_keys=max_cache_keys)
+    name = bf._compiled.name
+    buckets = list(bf.buckets)
+    if any(b >= c for b, c in zip(buckets, buckets[1:])):
+        findings.append(Finding(
+            _ANALYZER, "JX313", "error",
+            f"bucket ladder {buckets} is not strictly increasing — "
+            "bucket_for resolves lengths to the wrong program", name))
+    limit = _max_cache_keys(max_cache_keys)
+    if len(buckets) > limit:
+        findings.append(Finding(
+            _ANALYZER, "JX313", "error",
+            f"bucket ladder has {len(buckets)} rungs (> {limit}) — each rung "
+            "is one compiled program per static key; this config implies "
+            "unbounded cache growth", name))
+    if not bf.bucket_axes:
+        findings.append(Finding(
+            _ANALYZER, "JX313", "warning",
+            "BucketedFunction declares no bucket_axes — every distinct "
+            "input shape compiles its own program (the ladder never "
+            "engages)", name))
+    return findings
+
+
+def record_demo_step():
+    """Build, run (two steps) and return the representative whole-step
+    ``TrainStep`` the ``jaxpr`` lint analyzer audits — one definition so
+    the CLI and the test gates audit the SAME program (mirrors
+    ``program_verify.record_demo_program``)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from ..jit.api import TrainStep
+
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    crit = nn.MSELoss()
+    step = TrainStep(model=model, optimizer=opt,
+                     loss_fn=lambda x, y: crit(model(x), y))
+    x = paddle.Tensor(np.ones((2, 8), np.float32), stop_gradient=True)
+    y = paddle.Tensor(np.zeros((2, 4), np.float32), stop_gradient=True)
+    step(x, y)
+    step(x, y)
+    return step

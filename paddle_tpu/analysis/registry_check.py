@@ -18,6 +18,10 @@ RC205  AMP ambiguity          an op name matching both the white and black
 RC206  unknown AMP override   _AMP_OVERRIDES key not in OP_DEFS
 RC207  invalid profiler tag   profiler_tag outside the known tag set, or
                               'custom' for a registered op
+RC208  dead legacy alias      _OP_COMPAT row (legacy PaddlePaddle op name)
+                              whose current-name target does not resolve,
+                              maps to itself, or chains into another
+                              legacy name
 
 The xpu tier (Kunlun-hardware fused kernels) is intentionally exempt from
 RC201 — those ops have no TPU binding and are excluded from
@@ -153,5 +157,22 @@ def check_registry(op_defs=None, aliases=None, registry=None) -> List[Finding]:
                 "(tag derivation broke)", name)
         elif tag not in _VALID_TAGS:
             add("RC207", f"profiler_tag '{tag}' is not a known tag", name)
+
+    # RC208: the legacy op_compat tier keeps resolving. Every legacy name
+    # must map (in ONE hop — chains rot silently) to a current name that
+    # the live registry serves, so old serialized programs keep loading
+    # across registry renames.
+    op_compat = getattr(registry, "_OP_COMPAT", {})
+    for legacy, current in op_compat.items():
+        if current == legacy:
+            add("RC208", "legacy op name maps to itself (drop the row, or "
+                "point it at the real current name)", legacy)
+        elif current in op_compat:
+            add("RC208", f"legacy op name chains into another legacy name "
+                f"'{current}' — op_compat rows must map to current names "
+                "in one hop", legacy)
+        elif registry._lookup(current) is None:
+            add("RC208", f"legacy op name's current-name target '{current}' "
+                "does not resolve in the live registry", legacy)
 
     return findings

@@ -1,0 +1,218 @@
+"""SPMD axis checker: static validation of mesh-axis usage (SP4xx).
+
+The sharding directions in PAPERS.md (cross-replica weight-update
+sharding, portable redistribution) only pay off if collective/mesh axis
+usage is checkable *before* a multichip run — a typo'd axis name today
+surfaces as an XLA `unbound axis name` error minutes into a pod job.
+This AST pass resolves every axis-name STRING LITERAL at a usage site
+against the declared mesh axes; dynamic axis expressions (the common
+``axes`` variable threaded through ``distributed/communication.py``) are
+out of static reach and skipped.
+
+Declared axes = the canonical hybrid mesh
+(``distributed.env.HYBRID_AXES``: pp/dp/sharding/sep/mp) plus any axes
+the SAME FILE declares via ``Mesh(devs, ("x", "y"))`` /
+``Mesh(..., axis_names=...)`` or ``build_mesh(degrees={"x": 2, ...})`` /
+``init_parallel_env(degrees=...)`` — test files and experiments carry
+their own meshes.
+
+SP401  unresolved collective axis   lax.psum/all_gather/ppermute/
+                                    axis_index/... over an axis literal
+                                    not in the declared mesh
+SP402  unresolved region axis       spmd(axes=...)/spmd_region/shard_map/
+                                    Group/new_group over an undeclared
+                                    axis literal
+SP403  unresolved sharding axis     PartitionSpec/P(...) entry not in the
+                                    declared mesh
+SP404  inconsistent annotation      the same axis named twice in one
+                                    PartitionSpec (illegal in GSPMD), or
+                                    twice in one region/group axes tuple
+
+All SP4xx findings are errors; suppress a deliberate site with
+``# noqa: SP4xx`` (shared noqa grammar with the trace linter).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set
+
+from . import Finding
+
+_ANALYZER = "spmd"
+
+# lax collectives / axis queries: callable attr or bare name -> index of the
+# axis-name argument and its keyword spelling
+_COLLECTIVES = {
+    "psum": (1, "axis_name"),
+    "pmax": (1, "axis_name"),
+    "pmin": (1, "axis_name"),
+    "pmean": (1, "axis_name"),
+    "pprod": (1, "axis_name"),
+    "psum_scatter": (1, "axis_name"),
+    "all_gather": (1, "axis_name"),
+    "all_to_all": (1, "axis_name"),
+    "ppermute": (1, "axis_name"),
+    "pshuffle": (1, "axis_name"),
+    "axis_index": (0, "axis_name"),
+    "axis_size": (0, "axis_name"),
+}
+_SPEC_CTORS = {"PartitionSpec", "P"}
+_REGION_FNS = {"spmd_region", "spmd", "shard_map", "Group", "new_group",
+               "pmap", "xmap"}
+
+_FALLBACK_HYBRID_AXES = ("pp", "dp", "sharding", "sep", "mp")
+
+
+def _hybrid_axes():
+    try:
+        from ..distributed.env import HYBRID_AXES
+
+        return tuple(HYBRID_AXES)
+    except Exception:
+        return _FALLBACK_HYBRID_AXES
+
+
+def _axis_literals(node) -> List[str]:
+    """String constants reachable in an axis expression: ``"mp"``,
+    ``("dp", "mp")``, ``["sep"]``. Anything dynamic yields nothing."""
+    out: List[str] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+    return out
+
+
+class _DeclaredAxes(ast.NodeVisitor):
+    """Collect mesh-axis names the file itself declares."""
+
+    def __init__(self):
+        self.axes: Set[str] = set()
+
+    def visit_Call(self, node):
+        fname = self._call_name(node)
+        if fname == "Mesh":
+            # Mesh(devices, axis_names) / Mesh(devices, axis_names=...)
+            cand = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    cand = kw.value
+            if cand is not None:
+                self.axes.update(_axis_literals(cand))
+        elif fname in ("build_mesh", "init_parallel_env"):
+            cand = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "degrees":
+                    cand = kw.value
+            if isinstance(cand, ast.Dict):
+                for k in cand.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        self.axes.add(k.value)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _call_name(node) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        return None
+
+
+class _SpmdChecker(ast.NodeVisitor):
+    def __init__(self, declared: Set[str], findings: List[Finding],
+                 filename: str):
+        self.declared = declared
+        self.findings = findings
+        self.filename = filename
+
+    def add(self, code, node, message):
+        self.findings.append(Finding(
+            _ANALYZER, code, "error", message,
+            f"{self.filename}:{node.lineno}"))
+
+    def _check_axes(self, code, node, names: Sequence[str], site: str):
+        for name in names:
+            if name not in self.declared:
+                self.add(code, node,
+                         f"{site} names mesh axis '{name}' which no "
+                         f"declared mesh provides (declared: "
+                         f"{sorted(self.declared)})")
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            self.add("SP404", node,
+                     f"{site} names axis {sorted(dupes)} more than once — "
+                     "an axis can shard at most one dimension")
+
+    def visit_Call(self, node):
+        fname = _DeclaredAxes._call_name(node)
+        if fname in _COLLECTIVES:
+            pos, kw_name = _COLLECTIVES[fname]
+            axis_node = node.args[pos] if len(node.args) > pos else None
+            for kw in node.keywords:
+                if kw.arg == kw_name:
+                    axis_node = kw.value
+            if axis_node is not None:
+                lits = _axis_literals(axis_node)
+                if lits:
+                    self._check_axes("SP401", node, lits,
+                                     f"collective '{fname}'")
+        elif fname in _SPEC_CTORS:
+            names: List[str] = []
+            for arg in node.args:
+                names.extend(_axis_literals(arg))
+            if names:
+                self._check_axes("SP403", node, names,
+                                 f"sharding spec '{fname}(...)'")
+        elif fname in _REGION_FNS:
+            axis_node = None
+            if fname == "spmd_region" and node.args:
+                axis_node = node.args[0]
+            elif fname == "Group" and node.args:
+                axis_node = node.args[0]
+            for kw in node.keywords:
+                if kw.arg in ("axes", "axis_name", "axis_names"):
+                    axis_node = kw.value
+            if axis_node is not None:
+                lits = _axis_literals(axis_node)
+                if lits:
+                    self._check_axes("SP402", node, lits,
+                                     f"SPMD region/group '{fname}'")
+        self.generic_visit(node)
+
+
+def check_source(source: str, filename: str = "<string>",
+                 declared_axes: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Check one module's source; returns (unsuppressed) findings."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Finding(_ANALYZER, "SP400", "error",
+                        f"syntax error: {e.msg}", f"{filename}:{e.lineno or 0}")]
+    decl = _DeclaredAxes()
+    decl.visit(tree)
+    declared = set(declared_axes if declared_axes is not None
+                   else _hybrid_axes())
+    declared |= decl.axes
+    findings: List[Finding] = []
+    _SpmdChecker(declared, findings, filename).visit(tree)
+    from .trace_safety import _apply_noqa
+
+    return _apply_noqa(findings, source)
+
+
+def check_paths(paths: Sequence[str],
+                declared_axes: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Check every ``.py`` file under the given files/directories (same
+    walking + fail-loud-on-typo contract as ``trace_safety.lint_paths``)."""
+    from . import iter_py_files
+
+    findings: List[Finding] = []
+    for fname in iter_py_files(paths):
+        with open(fname, "r", encoding="utf-8") as fh:
+            findings.extend(check_source(fh.read(), fname,
+                                         declared_axes=declared_axes))
+    return findings

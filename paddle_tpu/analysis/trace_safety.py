@@ -33,7 +33,6 @@ Suppression: a ``# noqa: TS1xx`` comment on the flagged line (bare
 from __future__ import annotations
 
 import ast
-import os
 import re
 from typing import Dict, List, Optional, Sequence
 
@@ -390,21 +389,10 @@ def lint_paths(paths: Sequence[str]) -> List[Finding]:
     """Lint every ``.py`` file under the given files/directories. A path
     that does not exist raises: a typo'd CI path must fail loudly, not
     lint zero files and report green."""
+    from . import iter_py_files
+
     findings: List[Finding] = []
-    files: List[str] = []
-    for path in paths:
-        if os.path.isdir(path):
-            for root, dirs, names in os.walk(path):
-                dirs[:] = [d for d in dirs
-                           if d not in ("__pycache__", ".git", ".jax_cache")]
-                files.extend(os.path.join(root, n)
-                             for n in names if n.endswith(".py"))
-        elif os.path.isfile(path) and path.endswith(".py"):
-            files.append(path)
-        else:
-            raise FileNotFoundError(
-                f"lint path '{path}' is not a directory or .py file")
-    for fname in sorted(files):
+    for fname in iter_py_files(paths):
         with open(fname, "r", encoding="utf-8") as fh:
             findings.extend(lint_source(fh.read(), fname))
     return findings

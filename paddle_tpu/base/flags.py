@@ -80,6 +80,10 @@ define_flag("embedding_deterministic", False, "deterministic embedding grad accu
 define_flag("static_verify_program", False,
             "run the analysis verify pass over a static Program before "
             "Executor.run compiles it (paddle_tpu.analysis.program_verify)")
+define_flag("jaxpr_audit_max_cache_keys", 32,
+            "CompiledFunction.audit() / BucketedFunction.audit() flag "
+            "threshold: more distinct compile-cache keys (or bucket-ladder "
+            "rungs) than this raises a JX310/JX313 unbounded-retrace finding")
 define_flag("cudnn_deterministic", False, "accepted for compat; XLA is deterministic by default")
 
 

@@ -114,3 +114,14 @@ class TrainStep:
     @property
     def fallback_reason(self):
         return self._compiled.fallback_reason
+
+    def audit(self, max_cache_keys=None):
+        """JX3xx findings over every compiled whole-step program (see
+        paddle_tpu.analysis.jaxpr_audit). On-demand only — never runs on
+        the step's hot path."""
+        return self._compiled.audit(max_cache_keys=max_cache_keys)
+
+    def audit_report(self) -> dict:
+        """Per-cache-key compile counts for the whole-step program cache
+        (no compilation, no tracing — counter reads only)."""
+        return self._compiled.audit_report()

@@ -89,6 +89,18 @@ class BucketedFunction:
     def num_compiled(self) -> int:
         return len(self._compiled._cache)
 
+    def audit(self, max_cache_keys=None):
+        """JX3xx findings: the wrapped function's program audits plus the
+        bucket-ladder growth heuristic (JX313)."""
+        from ..analysis.jaxpr_audit import audit_bucketed_function
+
+        return audit_bucketed_function(self, max_cache_keys=max_cache_keys)
+
+    def audit_report(self) -> dict:
+        report = self._compiled.audit_report()
+        report["buckets"] = list(self.buckets)
+        return report
+
     def __call__(self, *args, **kwargs):
         lengths = []
         for idx, axis in self.bucket_axes.items():

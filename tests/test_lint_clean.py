@@ -1,11 +1,12 @@
 """CI gate: the repo itself passes its own static analysis.
 
-Runs all three ``paddle_tpu.analysis`` analyzers over the live codebase
+Runs all five ``paddle_tpu.analysis`` analyzers over the live codebase
 and asserts ZERO error-severity findings, so a regression (a new
-jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug)
-fails tier-1 instead of rotting until pod scale. The ``python -m
-tools.lint`` CLI contract (exit 0, machine-readable JSON) is gated here
-too.
+jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug,
+a host callback in a compiled step, a typo'd mesh axis) fails tier-1
+instead of rotting until pod scale. The ``python -m tools.lint`` CLI
+contract (exit 0, machine-readable JSON, ``--include-tests``) is gated
+here too.
 """
 import json
 import os
@@ -26,6 +27,15 @@ def test_trace_safety_clean_over_source_tree():
     assert _errors(findings) == []
 
 
+def test_trace_safety_clean_over_tests_tree():
+    """ROADMAP item: the tests/ tree holds trace-safe idioms too —
+    deliberate violations carry # noqa with a reason."""
+    from paddle_tpu.analysis.trace_safety import lint_paths
+
+    findings = lint_paths([os.path.join(_REPO, "tests")])
+    assert _errors(findings) == []
+
+
 def test_registry_gate_green():
     from paddle_tpu.analysis.registry_check import check_registry
 
@@ -43,16 +53,43 @@ def test_program_verifier_green_on_recorded_program():
     assert _errors(verify_clone(main, main.clone(for_test=True))) == []
 
 
+def test_jaxpr_auditor_green_on_demo_step():
+    """The representative whole-step program audits clean: no callbacks,
+    no 64-bit leaks, no donation aliasing, full guard coverage, and
+    audit_report() reads counters without building anything new."""
+    from paddle_tpu.analysis.jaxpr_audit import record_demo_step
+
+    step = record_demo_step()
+    findings = step.audit()
+    assert [str(f) for f in findings] == []
+    before = step._compiled.stats["compiled_steps"]
+    report = step.audit_report()
+    assert report["n_cache_keys"] == 1
+    assert report["total_builds"] == 1
+    assert step._compiled.stats["compiled_steps"] == before
+
+
+def test_spmd_checker_clean_over_source_and_tests():
+    from paddle_tpu.analysis.spmd_check import check_paths
+
+    findings = check_paths([os.path.join(_REPO, "paddle_tpu"),
+                            os.path.join(_REPO, "tests")])
+    assert _errors(findings) == []
+
+
 def test_cli_exits_zero_with_machine_readable_findings(capsys):
-    """`tools.lint --json` over the repo: exit 0, parseable. Run in-process
-    (the three tests above already paid the analyzer costs once; a fresh
-    subprocess would re-import jax + paddle_tpu just to check exit code)."""
+    """`tools.lint --json --include-tests` over the repo: exit 0,
+    parseable. Run in-process (the tests above already paid the analyzer
+    costs once; a fresh subprocess would re-import jax + paddle_tpu just
+    to check exit code)."""
     import tools.lint as lint_cli
 
-    rc = lint_cli.main(["--json"])
+    rc = lint_cli.main(["--json", "--include-tests"])
     out = capsys.readouterr().out
     assert rc == 0, out
     payload = json.loads(out)
     assert payload["errors"] == 0
-    assert set(payload["analyzers"]) == {"trace", "registry", "program"}
+    assert payload["crashed"] == []
+    assert set(payload["analyzers"]) == {"trace", "registry", "program",
+                                         "jaxpr", "spmd"}
     assert isinstance(payload["findings"], list)

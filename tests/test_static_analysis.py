@@ -317,3 +317,331 @@ class TestRegistryGate:
             "inplace": None, "forward_only": True, "tier": "dense"}}
         findings = check_registry(op_defs=defs, aliases={})
         assert any(f.code == "RC201" for f in findings)
+
+    def test_op_compat_tier_green_and_served(self):
+        from paddle_tpu.ops import registry
+
+        assert registry.resolve_legacy("elementwise_add") == "add"
+        assert registry.get_op("reduce_sum") is registry.get_op("sum")
+        assert registry.get_op("matmul_v2") is not None
+
+    def test_op_compat_cycles_and_chains_do_not_resolve(self):
+        # runtime mirror of the RC208 one-hop contract: a cyclic or
+        # chained row returns None instead of recursing/serving two hops
+        from paddle_tpu.ops import registry
+
+        registry._OP_COMPAT["cyc_a"] = "cyc_b"
+        registry._OP_COMPAT["cyc_b"] = "cyc_a"
+        try:
+            assert registry.get_op("cyc_a") is None
+            assert registry.get_op("cyc_b") is None
+        finally:
+            del registry._OP_COMPAT["cyc_a"], registry._OP_COMPAT["cyc_b"]
+
+    def test_dead_legacy_alias_rejected(self):
+        from paddle_tpu.ops import registry
+
+        registry._OP_COMPAT["ancient_op"] = "no_such_current_op_xyz"
+        registry._OP_COMPAT["self_op"] = "self_op"
+        registry._OP_COMPAT["chain_op"] = "ancient_op"
+        try:
+            findings = [f for f in check_registry() if f.code == "RC208"]
+            assert {f.location for f in findings} == \
+                {"ancient_op", "self_op", "chain_op"}, [str(f) for f in findings]
+        finally:
+            for k in ("ancient_op", "self_op", "chain_op"):
+                del registry._OP_COMPAT[k]
+        assert check_registry() == []
+
+
+# ---------------------------------------------------------------- jaxpr
+class TestJaxprAuditor:
+    """Trace-level verification: the auditor walks the ClosedJaxpr of each
+    CompiledFunction cache entry (ISSUE 2 tentpole)."""
+
+    def test_demo_train_step_audits_clean(self):
+        from paddle_tpu.analysis.jaxpr_audit import record_demo_step
+
+        step = record_demo_step()
+        assert step.audit() == [], [str(f) for f in step.audit()]
+
+    def test_callback_inside_to_static_flagged(self):
+        import jax
+
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(x):
+            jax.debug.print("x={x}", x=x._value)
+            return x * 2
+
+        f(paddle.ones([3]))
+        assert "JX301" in _codes(f.audit())
+
+    def test_f64_literal_in_step_fn_flagged(self):
+        from jax.experimental import enable_x64
+
+        from paddle_tpu.jit.functionalize import functionalize
+
+        with enable_x64():
+            def step_fn(x):
+                return x * np.float64(2.0)  # seeded f64 leak
+
+            cf = functionalize(step_fn)
+            cf(paddle.Tensor(np.ones(3, np.float32)))
+            findings = cf.audit()
+        errors = [f for f in findings if f.code == "JX302"]
+        assert errors and all(f.severity == "error" for f in errors), \
+            [str(f) for f in findings]
+
+    def test_donated_cell_returned_as_output_flagged(self):
+        from paddle_tpu.jit.functionalize import functionalize
+
+        w = paddle.Tensor(np.ones(3, np.float32), stop_gradient=True)
+
+        @functionalize
+        def f(x):
+            out = w * x
+            w._replace_value(out._value)
+            return out
+
+        f(paddle.ones([3]))
+        assert "JX304" in _codes(f.audit())
+
+    def test_guard_family_covered_then_fallback_reported(self):
+        from paddle_tpu.jit.functionalize import functionalize
+
+        @functionalize
+        def g(x):
+            if paddle.sum(x) > 0:
+                return x * 2
+            return x * 3
+
+        g(paddle.ones([3]))
+        g(paddle.full([3], -1.0))  # second branch -> second specialization
+        assert g.audit() == [], [str(f) for f in g.audit()]
+        report = g.audit_report()
+        assert report["keys"][0]["specializations"] == 2
+
+        @functionalize
+        def h(x):
+            # host float conversion the guards can't see -> eager fallback
+            s = float(paddle.sum(x).numpy())  # noqa: TS101
+            return x * s
+
+        h(paddle.ones([3]))
+        findings = h.audit()
+        assert "JX306" in _codes(findings)
+
+    def test_float_and_unhashable_static_keys_flagged(self):
+        from paddle_tpu.jit.functionalize import functionalize
+
+        cf = functionalize(lambda x: x * 2, static_key_fn=lambda: 0.125)
+        cf(paddle.ones([3]))
+        assert "JX311" in _codes(cf.audit())
+        # numpy floating keys are just as unbounded as python floats
+        cf_np = functionalize(lambda x: x * 2,
+                              static_key_fn=lambda: np.float32(0.5))
+        assert "JX311" in _codes(cf_np.audit())
+        cf2 = functionalize(lambda x: x * 2, static_key_fn=lambda: [1])
+        assert "JX312" in _codes(cf2.audit())
+
+    def test_cache_key_cardinality_flagged(self):
+        from paddle_tpu.jit.functionalize import functionalize
+
+        cf = functionalize(lambda x: paddle.sum(x * 2))
+        for n in range(1, 5):
+            cf(paddle.ones([n]))
+        assert cf.audit(max_cache_keys=3) and \
+            "JX310" in _codes(cf.audit(max_cache_keys=3))
+        assert "JX310" not in _codes(cf.audit(max_cache_keys=64))
+
+    def test_bucket_ladder_heuristics(self):
+        from paddle_tpu.jit.bucketing import BucketedFunction
+
+        bf = BucketedFunction(lambda x: x * 2, bucket_axes={0: 0},
+                              min_len=1, max_len=2 ** 40)
+        assert "JX313" in _codes(bf.audit())
+        ok = BucketedFunction(lambda x: x * 2, bucket_axes={0: 0},
+                              min_len=16, max_len=4096)
+        assert "JX313" not in _codes(ok.audit())
+
+    def test_audit_report_triggers_no_compilation(self):
+        from paddle_tpu.jit.functionalize import functionalize
+
+        cf = functionalize(lambda x: paddle.sum(x * 2))
+        cf(paddle.ones([3]))
+        before_cache = dict(cf._cache)
+        before_counts = dict(cf._compile_counts)
+        before_stats = dict(cf.stats)
+        report = cf.audit_report()
+        assert report["n_cache_keys"] == 1
+        assert report["total_builds"] == 1
+        assert report["keys"][0]["builds"] == 1
+        assert cf._cache == before_cache
+        assert cf._compile_counts == before_counts
+        assert cf.stats == before_stats
+
+    def test_constant_output_warns(self):
+        from paddle_tpu.jit.functionalize import functionalize
+
+        w = paddle.Tensor(np.ones(3, np.float32), stop_gradient=True)
+
+        @functionalize
+        def f(x):
+            y = w + x  # w becomes a cell
+            return w   # the live cell Tensor: its value is restored post-
+                       # trace, so the output bakes in as a constant
+
+        f(paddle.ones([3]))
+        warns = [f_ for f_ in f.audit() if f_.code == "JX303"]
+        assert warns and all(f_.severity == "warning" for f_ in warns), \
+            [str(f_) for f_ in f.audit()]
+
+
+# ---------------------------------------------------------------- spmd
+_SPMD_BAD_SNIPPET = '''
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from paddle_tpu.distributed.spmd import spmd, spmd_region
+
+def comm(x):
+    return lax.psum(x, "tp")            # undeclared axis
+
+def region(x):
+    with spmd_region(["tp", "tp"]):     # undeclared + duplicated
+        return x
+
+def annot(x):
+    return P("dp", "dp")                # duplicate within one spec
+
+def annot2(x):
+    return P("tp", None)                # undeclared axis in a spec
+'''
+
+_SPMD_CLEAN_SNIPPET = '''
+import numpy as np
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from paddle_tpu.distributed.spmd import spmd_region
+
+mesh = Mesh(np.array(jax.devices()).reshape(1, -1), ("x", "y"))
+
+def comm(x):
+    return lax.psum(x, "x")             # file-declared axis
+
+def hybrid(x):
+    return lax.pmax(x, ("dp", "mp"))    # canonical hybrid axes
+
+def annot(x):
+    return P("dp", None, "y")
+
+def dynamic(x, axes):
+    return lax.psum(x, axes)            # dynamic: out of static reach
+'''
+
+
+class TestSpmdChecker:
+    def test_bad_snippet_trips_every_rule(self):
+        from paddle_tpu.analysis.spmd_check import check_source
+
+        findings = check_source(_SPMD_BAD_SNIPPET, "bad.py")
+        codes = _codes(findings)
+        assert {"SP401", "SP402", "SP403", "SP404"} <= codes, sorted(codes)
+        assert all(f.severity == "error" and
+                   f.location.startswith("bad.py:") for f in findings)
+
+    def test_clean_snippet_is_silent(self):
+        from paddle_tpu.analysis.spmd_check import check_source
+
+        assert check_source(_SPMD_CLEAN_SNIPPET, "clean.py") == []
+
+    def test_collective_over_undeclared_mesh_axis(self):
+        from paddle_tpu.analysis.spmd_check import check_source
+
+        src = "from jax import lax\ndef f(x):\n    return lax.psum(x, 'tp')\n"
+        findings = check_source(src, "s.py")
+        assert _codes(findings) == {"SP401"}
+
+    def test_declared_degrees_dict_counts(self):
+        from paddle_tpu.analysis.spmd_check import check_source
+
+        src = ("import paddle_tpu.distributed as dist\n"
+               "from jax import lax\n"
+               "dist.init_parallel_env(degrees={'ring': 4})\n"
+               "def f(x):\n    return lax.psum(x, 'ring')\n")
+        assert check_source(src, "s.py") == []
+
+    def test_noqa_suppression(self):
+        from paddle_tpu.analysis.spmd_check import check_source
+
+        src = ("from jax import lax\n"
+               "def f(x):\n"
+               "    return lax.psum(x, 'tp')  # noqa: SP401\n")
+        assert check_source(src, "s.py") == []
+
+    def test_syntax_error_reported_not_raised(self):
+        from paddle_tpu.analysis.spmd_check import check_source
+
+        assert _codes(check_source("def broken(:\n", "b.py")) == {"SP400"}
+
+    def test_check_paths_walks_and_fails_loud(self, tmp_path):
+        from paddle_tpu.analysis.spmd_check import check_paths
+
+        f = tmp_path / "mod.py"
+        f.write_text("from jax import lax\ndef f(x):\n"
+                     "    return lax.pmax(x, 'nope')\n")
+        assert _codes(check_paths([str(tmp_path)])) == {"SP401"}
+        with pytest.raises(FileNotFoundError):
+            check_paths([str(tmp_path / "missing_dir")])
+
+
+# ---------------------------------------------------------------- CLI
+class TestLintCli:
+    """--select/--ignore filters and the exit-code contract (ISSUE 2
+    satellite: 0 = clean, 1 = findings, 2 = analyzer crash)."""
+
+    def test_select_and_ignore_filters(self):
+        from paddle_tpu.analysis import Finding
+        from tools.lint import filter_findings
+
+        fs = [Finding("trace", "TS101", "error", "m"),
+              Finding("spmd", "SP401", "error", "m"),
+              Finding("jaxpr", "JX310", "warning", "m")]
+        assert [f.code for f in filter_findings(fs, ["TS"], None)] == ["TS101"]
+        assert [f.code for f in filter_findings(fs, ["SP4", "JX"], None)] == \
+            ["SP401", "JX310"]
+        assert [f.code for f in filter_findings(fs, None, ["TS1", "JX"])] == \
+            ["SP401"]
+
+    def test_crash_exits_two(self, capsys, monkeypatch):
+        import tools.lint as lint_cli
+
+        def boom(_paths, include_tests=False):
+            raise RuntimeError("analyzer exploded")
+
+        monkeypatch.setitem(lint_cli._RUNNERS, "spmd", boom)
+        rc = lint_cli.main(["--json", "--analyzer", "spmd"])
+        out = capsys.readouterr().out
+        assert rc == 2
+        import json as _json
+
+        payload = _json.loads(out)
+        assert payload["crashed"] == ["spmd"]
+        assert any(f["code"] == "SP999" for f in payload["findings"])
+
+    def test_findings_exit_one(self, capsys, tmp_path):
+        import tools.lint as lint_cli
+
+        bad = tmp_path / "bad.py"
+        bad.write_text("from jax import lax\ndef f(x):\n"
+                       "    return lax.psum(x, 'ghost_axis')\n")
+        rc = lint_cli.main(["--analyzer", "spmd", str(bad)])
+        assert rc == 1
+        capsys.readouterr()
+        # ...unless the family is deselected
+        rc = lint_cli.main(["--analyzer", "spmd", "--select", "TS", str(bad)])
+        assert rc == 0
+        capsys.readouterr()

@@ -1,17 +1,32 @@
 """``python -m tools.lint`` — the repo's static-analysis driver.
 
-Runs the three ``paddle_tpu.analysis`` analyzers and reports findings:
+Runs the five ``paddle_tpu.analysis`` analyzers and reports findings:
 
 - **trace**:    the trace-safety AST linter over ``paddle_tpu/`` (or the
                 paths given on the command line),
-- **registry**: the op-registry consistency gate,
+- **registry**: the op-registry consistency gate (including the legacy
+                ``op_compat`` alias tier),
 - **program**:  the Program verify pass, exercised on a freshly recorded
                 representative static program (build → verify → clone →
                 verify clone invariants), so IR-level regressions surface
-                without needing a checked-in graph.
+                without needing a checked-in graph,
+- **jaxpr**:    the trace-level auditor, exercised on a freshly compiled
+                representative whole-step TrainStep (build → run → audit
+                every cached program's ClosedJaxpr + the recompilation
+                heuristics),
+- **spmd**:     the static mesh-axis checker over the same paths as the
+                trace linter.
 
-Exit status 0 = no error-severity findings (warnings never gate).
+Exit-code contract (stable, CI-gateable):
+  0 = no error-severity findings (warnings never gate)
+  1 = at least one error-severity finding
+  2 = an analyzer crashed (the crash is reported as a finding too)
+
 ``--json`` prints one machine-readable object with every finding.
+``--select``/``--ignore`` filter findings by code prefix (e.g.
+``--select JX,SP4`` or ``--ignore PV008``) so CI can gate on specific
+families. ``--include-tests`` adds the ``tests/`` tree to the
+source-scanning analyzers (trace, spmd).
 """
 from __future__ import annotations
 
@@ -21,22 +36,36 @@ import os
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_ANALYZERS = ("trace", "registry", "program")
+_ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd")
 
 
-def _run_trace(paths):
+def _source_paths(paths, include_tests=False):
+    out = list(paths) if paths else [os.path.join(_REPO_ROOT, "paddle_tpu")]
+    tests_dir = os.path.join(_REPO_ROOT, "tests")
+    if include_tests and tests_dir not in out:
+        out.append(tests_dir)
+    return out
+
+
+def _run_trace(paths, include_tests=False):
     from paddle_tpu.analysis.trace_safety import lint_paths
 
-    return lint_paths(paths or [os.path.join(_REPO_ROOT, "paddle_tpu")])
+    return lint_paths(_source_paths(paths, include_tests))
 
 
-def _run_registry(_paths):
+def _run_spmd(paths, include_tests=False):
+    from paddle_tpu.analysis.spmd_check import check_paths
+
+    return check_paths(_source_paths(paths, include_tests))
+
+
+def _run_registry(_paths, include_tests=False):
     from paddle_tpu.analysis.registry_check import check_registry
 
     return check_registry()
 
 
-def _run_program(_paths):
+def _run_program(_paths, include_tests=False):
     """Record the shared representative program and verify it + its clone."""
     import numpy as np
 
@@ -65,26 +94,109 @@ def _run_program(_paths):
     return findings
 
 
+def _run_jaxpr(_paths, include_tests=False):
+    """Compile the shared representative whole-step TrainStep and audit
+    every cached program (trace-level verification + recompilation audit
+    + guard-family coverage, see analysis/jaxpr_audit.py)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis.jaxpr_audit import record_demo_step
+
+    step = record_demo_step()
+    findings = step.audit()
+    # a guarded program too, so the branch-coverage checks run per commit
+    from paddle_tpu.jit.functionalize import functionalize
+
+    @functionalize
+    def guarded(x):
+        if paddle.sum(x) > 0:
+            return x * 2
+        return x * 3
+
+    guarded(paddle.ones([4]))
+    findings += guarded.audit()
+    return findings
+
+
+_RUNNERS = {"trace": _run_trace, "registry": _run_registry,
+            "program": _run_program, "jaxpr": _run_jaxpr,
+            "spmd": _run_spmd}
+
+# analyzer -> its finding-code family prefix, so a crash finding
+# (<PREFIX>999) stays visible under --select filters for that family
+_FAMILY_PREFIX = {"trace": "TS", "registry": "RC", "program": "PV",
+                  "jaxpr": "JX", "spmd": "SP"}
+
+
+def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
+    """Run the named analyzers; returns ``(findings, crashed)`` where
+    ``crashed`` lists analyzers that raised (each crash is also appended
+    to the findings as an <NAME>999 error)."""
+    from paddle_tpu.analysis import Finding
+
+    findings = []
+    crashed = []
+    for name in selected:
+        try:
+            findings.extend(_RUNNERS[name](paths, include_tests=include_tests))
+        except Exception as e:
+            crashed.append(name)
+            findings.append(Finding(
+                name, f"{_FAMILY_PREFIX.get(name, name[:2].upper())}999",
+                "error",
+                f"analyzer '{name}' crashed: {type(e).__name__}: "
+                f"{str(e).splitlines()[0] if str(e) else ''}", "analyzer"))
+    return findings, crashed
+
+
+def _split_codes(values):
+    out = []
+    for v in values or []:
+        out.extend(c.strip().upper() for c in v.split(",") if c.strip())
+    return out
+
+
+def filter_findings(findings, select=None, ignore=None):
+    """Keep findings whose code matches a ``select`` prefix (all, when no
+    select is given) and matches no ``ignore`` prefix."""
+    if select:
+        findings = [f for f in findings
+                    if any(f.code.upper().startswith(p) for p in select)]
+    if ignore:
+        findings = [f for f in findings
+                    if not any(f.code.upper().startswith(p) for p in ignore)]
+    return findings
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.lint",
         description="paddle_tpu static analysis: trace-safety linter, "
-                    "registry consistency gate, Program verify pass")
+                    "registry consistency gate, Program verify pass, jaxpr "
+                    "auditor, SPMD axis checker")
     parser.add_argument("paths", nargs="*",
-                        help="files/directories for the trace linter "
-                             "(default: paddle_tpu/)")
+                        help="files/directories for the source-scanning "
+                             "analyzers (default: paddle_tpu/)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable output")
     parser.add_argument("--analyzer", action="append", choices=_ANALYZERS,
                         help="run only the named analyzer(s); default: all")
+    parser.add_argument("--include-tests", action="store_true",
+                        help="also scan the tests/ tree with the "
+                             "source-scanning analyzers (trace, spmd)")
+    parser.add_argument("--select", action="append", metavar="CODES",
+                        help="only report findings whose code starts with "
+                             "one of these comma-separated prefixes "
+                             "(e.g. --select TS,JX3)")
+    parser.add_argument("--ignore", action="append", metavar="CODES",
+                        help="drop findings whose code starts with one of "
+                             "these comma-separated prefixes")
     args = parser.parse_args(argv)
 
     selected = tuple(dict.fromkeys(args.analyzer)) if args.analyzer else _ANALYZERS
-    runners = {"trace": _run_trace, "registry": _run_registry,
-               "program": _run_program}
-    findings = []
-    for name in selected:
-        findings.extend(runners[name](args.paths))
+    findings, crashed = run_analyzers(selected, args.paths,
+                                      include_tests=args.include_tests)
+    findings = filter_findings(findings, _split_codes(args.select),
+                               _split_codes(args.ignore))
 
     from paddle_tpu.analysis import errors as _errors
 
@@ -93,6 +205,7 @@ def main(argv=None) -> int:
     if args.as_json:
         print(json.dumps({
             "analyzers": list(selected),
+            "crashed": crashed,
             "errors": n_errors,
             "warnings": n_warnings,
             "findings": [f.to_dict() for f in findings],
@@ -101,7 +214,10 @@ def main(argv=None) -> int:
         for f in findings:
             print(f)
         print(f"tools.lint: {n_errors} error(s), {n_warnings} warning(s) "
-              f"[{', '.join(selected)}]")
+              f"[{', '.join(selected)}]"
+              + (f" CRASHED: {', '.join(crashed)}" if crashed else ""))
+    if crashed:
+        return 2
     return 1 if n_errors else 0
 
 
