@@ -124,33 +124,65 @@ def bench_gpt(on_tpu):
 def _dispatcher_microbench(n=2000):
     """Eager dispatch overhead (VERDICT r5 top_next): ns/op through the
     framework's `primitive` path (unwrap, AMP hook, wrap, hooks) vs the
-    raw jnp call it bottoms out in, same 8x8 add. The ratio is the
-    framework tax per eager op — independent of which chip is attached."""
+    raw jnp call it bottoms out in, same 8x8 add — measured with the
+    kernel cache OFF (slow path) and ON (cache-hit steady state), on both
+    the no-grad and the grad (vjp-carrying) dispatch, plus the cache's own
+    hit rate. The grad-path cached/uncached ratio is the headline of the
+    fast-path PR: uncached pays a jax.vjp trace per op."""
     import jax.numpy as jnp
     import numpy as np
 
     import paddle_tpu as paddle
+    from paddle_tpu.base.flags import get_flag
+    from paddle_tpu.core import kernel_cache
 
     a = paddle.Tensor(np.ones((8, 8), np.float32), stop_gradient=True)
     b = paddle.Tensor(np.ones((8, 8), np.float32), stop_gradient=True)
     ja, jb = a._value, b._value
     jnp.add(ja, jb).block_until_ready()   # warm compile caches
-    paddle.add(a, b)
 
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = jnp.add(ja, jb)
-    out.block_until_ready()
-    raw_ns = (time.perf_counter() - t0) / n * 1e9
+    def _loop(fn, k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = fn()
+        (out._value if isinstance(out, paddle.Tensor) else out).block_until_ready()
+        return (time.perf_counter() - t0) / k * 1e9
 
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = paddle.add(a, b)
-    out._value.block_until_ready()
-    disp_ns = (time.perf_counter() - t0) / n * 1e9
+    raw_ns = _loop(lambda: jnp.add(ja, jb), n)
+
+    prev = get_flag("eager_kernel_cache")
+    ga = paddle.Tensor(np.ones((8, 8), np.float32), stop_gradient=False)
+    # snapshot the REAL workload's counters before the microbench resets
+    # them — hit_rate below only describes the microbench's own loops
+    workload_totals = kernel_cache.stats()["totals"]
+    try:
+        paddle.set_flags({"eager_kernel_cache": False})
+        paddle.add(a, b)
+        disp_ns = _loop(lambda: paddle.add(a, b), n)
+        # grad path uncached: every call re-traces jax.vjp (~ms), keep k small
+        paddle.add(ga, ga)
+        grad_ns = _loop(lambda: paddle.add(ga, ga), max(50, n // 20))
+
+        paddle.set_flags({"eager_kernel_cache": True})
+        kernel_cache.clear()
+        paddle.add(a, b)          # compile the cached executables once
+        paddle.add(ga, ga)
+        cached_ns = _loop(lambda: paddle.add(a, b), n)
+        grad_cached_ns = _loop(lambda: paddle.add(ga, ga), n)
+        cstats = kernel_cache.stats()["totals"]
+    finally:
+        paddle.set_flags({"eager_kernel_cache": prev})
+    looked_up = cstats["hits"] + cstats["misses"]
     return {"framework_ns_per_op": round(disp_ns),
             "raw_jnp_ns_per_op": round(raw_ns),
-            "overhead_x": round(disp_ns / raw_ns, 2)}
+            "overhead_x": round(disp_ns / raw_ns, 2),
+            "cached_ns_per_op": round(cached_ns),
+            "grad_ns_per_op": round(grad_ns),
+            "grad_cached_ns_per_op": round(grad_cached_ns),
+            "cache_speedup_x": round(disp_ns / cached_ns, 2),
+            "grad_cache_speedup_x": round(grad_ns / grad_cached_ns, 2),
+            "hit_rate": round(cstats["hits"] / looked_up, 4) if looked_up else None,
+            "workload_totals": workload_totals}
 
 
 def _lint_bench(step):

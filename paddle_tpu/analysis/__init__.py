@@ -21,7 +21,8 @@ analyzers that run at commit time:
   functionalizer hands to XLA: host callbacks, 64-bit dtype leaks,
   donation/output aliasing, dead values, guard-family coverage, and the
   recompilation audit (cache-key cardinality, static-key hygiene,
-  bucket-ladder growth). Also ``CompiledFunction.audit()`` /
+  bucket-ladder growth), and the eager kernel-cache audit (JX32x over
+  ``core.kernel_cache.stats()``). Also ``CompiledFunction.audit()`` /
   ``audit_report()``.
 - :mod:`spmd_check` — static mesh-axis resolution for collectives,
   shard_map/spmd regions and PartitionSpec annotations (SP4xx).
@@ -38,6 +39,7 @@ __all__ = [
     "Finding",
     "audit_compiled_function",
     "audit_jaxpr",
+    "audit_kernel_cache",
     "check_registry",
     "check_spmd_paths",
     "check_spmd_source",
@@ -138,6 +140,12 @@ def audit_jaxpr(closed_jaxpr, **kwargs):
     from .jaxpr_audit import audit_jaxpr as _impl
 
     return _impl(closed_jaxpr, **kwargs)
+
+
+def audit_kernel_cache(stats=None, **kwargs):
+    from .jaxpr_audit import audit_kernel_cache as _impl
+
+    return _impl(stats, **kwargs)
 
 
 def check_spmd_paths(paths, **kwargs):

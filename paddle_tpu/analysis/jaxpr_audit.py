@@ -42,6 +42,19 @@ JX313  bucket ladder           a ``BucketedFunction`` ladder implying more
                                programs than the cache-key budget, or a
                                non-monotonic bucket list (error)
 
+Eager kernel-cache audit (JX32x, over ``core.kernel_cache.stats()``
+counters — the per-op dispatch fast path, not the whole-step jit tier;
+see :func:`audit_kernel_cache`):
+
+JX320  bypass storm            an op whose fast-path bypasses are dominated
+                               by unhashable signatures: it never enters
+                               the cache and silently pays trace-per-call
+JX321  miss ladder             an op with more cache misses than the key
+                               budget and fewer hits than misses — its key
+                               churns and every step compiles anew
+JX322  eviction thrash         evictions rival hits across the cache: the
+                               LRU capacity is below the working set
+
 Entry points: ``CompiledFunction.audit()`` / ``TrainStep.audit()`` (this
 module's :func:`audit_compiled_function`), and the ``jaxpr`` analyzer of
 ``python -m tools.lint`` which audits a freshly built representative
@@ -363,6 +376,64 @@ def audit_bucketed_function(bf, max_cache_keys=None) -> List[Finding]:
     return findings
 
 
+def audit_kernel_cache(stats=None, max_keys_per_op=None,
+                       bypass_threshold=64) -> List[Finding]:
+    """JX32x: health of the eager dispatch kernel cache
+    (``core/kernel_cache.py``) from its ``stats()`` counters. Pure counter
+    arithmetic — safe to run on the live process or on a recorded
+    snapshot; pass ``stats`` (either the full ``stats()`` dict or its
+    per-op ``"ops"`` mapping) for seeded/offline audits."""
+    findings: List[Finding] = []
+    if stats is None:
+        from ..core import kernel_cache
+
+        stats = kernel_cache.stats()
+    ops = stats.get("ops", stats)
+    limit = _max_cache_keys(max_keys_per_op)
+
+    total_hits = 0
+    total_evictions = 0
+    # key=str: op names are arbitrary caller strings (a None or other
+    # non-string name must not crash the analyzer, just sort textually)
+    for op, s in sorted(ops.items(), key=lambda kv: str(kv[0])):
+        hits = int(s.get("hits", 0))
+        misses = int(s.get("misses", 0))
+        bypasses = int(s.get("bypasses", 0))
+        total_hits += hits
+        total_evictions += int(s.get("evictions", 0))
+
+        # only the 'unhashable' reason is a storm: hook gates (amp/
+        # discovery/observer) and array/PRNG-key captures (dropout's
+        # per-call key) are deliberate bypasses, not defects
+        reasons = s.get("bypass_reasons", {})
+        unhashable = int(reasons.get("unhashable", 0))
+        if unhashable >= bypass_threshold:
+            findings.append(Finding(
+                _ANALYZER, "JX320", "warning",
+                f"{unhashable} fast-path bypasses for unhashable signatures "
+                f"(of {bypasses} total) — the op never enters the kernel "
+                "cache and pays a fresh trace per call (make its attrs/"
+                "closure values hashable, or deny-list it deliberately)",
+                f"kernel_cache:{op}"))
+
+        if misses > limit and hits < misses:
+            findings.append(Finding(
+                _ANALYZER, "JX321", "warning",
+                f"{misses} cache misses vs {hits} hits (> {limit} distinct "
+                "signatures) — the op's key churns (per-step scalar attrs or "
+                "shape ladder?) and every miss compiles a new executable",
+                f"kernel_cache:{op}"))
+
+    if total_evictions > 0 and total_evictions >= max(total_hits, 1):
+        findings.append(Finding(
+            _ANALYZER, "JX322", "warning",
+            f"{total_evictions} evictions vs {total_hits} hits — the LRU "
+            "working set exceeds FLAGS_eager_kernel_cache_max_entries; "
+            "executables are rebuilt as fast as they are reused",
+            "kernel_cache"))
+    return findings
+
+
 def record_demo_step():
     """Build, run (two steps) and return the representative whole-step
     ``TrainStep`` the ``jaxpr`` lint analyzer audits — one definition so
@@ -372,17 +443,32 @@ def record_demo_step():
 
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
+    from ..base import global_state
     from ..jit.api import TrainStep
 
-    paddle.seed(0)
-    model = nn.Linear(8, 4)
-    opt = paddle.optimizer.SGD(learning_rate=0.1,
-                               parameters=model.parameters())
-    crit = nn.MSELoss()
-    step = TrainStep(model=model, optimizer=opt,
-                     loss_fn=lambda x, y: crit(model(x), y))
-    x = paddle.Tensor(np.ones((2, 8), np.float32), stop_gradient=True)
-    y = paddle.Tensor(np.zeros((2, 4), np.float32), stop_gradient=True)
-    step(x, y)
-    step(x, y)
+    # the demo needs a deterministic init, but an in-process health check
+    # must not reseed the caller's RNG stream: save/restore the generator
+    gen = global_state.default_generator
+    prev_seed = gen._seed
+    prev_cell = gen._cell
+    prev_key = None if prev_cell is None else prev_cell._value
+    try:
+        paddle.seed(0)
+        model = nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        crit = nn.MSELoss()
+        step = TrainStep(model=model, optimizer=opt,
+                         loss_fn=lambda x, y: crit(model(x), y))
+        x = paddle.Tensor(np.ones((2, 8), np.float32), stop_gradient=True)
+        y = paddle.Tensor(np.zeros((2, 4), np.float32), stop_gradient=True)
+        step(x, y)
+        step(x, y)
+    finally:
+        gen._seed = prev_seed
+        if prev_cell is None:
+            gen._cell = None  # recreate lazily from the restored seed
+        else:
+            gen._cell = prev_cell
+            prev_cell._replace_value(prev_key)
     return step

@@ -22,6 +22,10 @@ RC208  dead legacy alias      _OP_COMPAT row (legacy PaddlePaddle op name)
                               whose current-name target does not resolve,
                               maps to itself, or chains into another
                               legacy name
+RC209  dead deny-list entry   _KERNEL_CACHE_DENY name (eager kernel-cache
+                              opt-out, core/kernel_cache.py) that no longer
+                              resolves in the live registry — a renamed op
+                              would silently lose its fast-path exclusion
 
 The xpu tier (Kunlun-hardware fused kernels) is intentionally exempt from
 RC201 — those ops have no TPU binding and are excluded from
@@ -174,5 +178,14 @@ def check_registry(op_defs=None, aliases=None, registry=None) -> List[Finding]:
         elif registry._lookup(current) is None:
             add("RC208", f"legacy op name's current-name target '{current}' "
                 "does not resolve in the live registry", legacy)
+
+    # RC209: kernel-cache deny-list hygiene. A deny entry is a semantic
+    # exclusion from the eager fast path; if its name stops resolving the
+    # exclusion silently protects nothing (the renamed op gets cached).
+    for name in sorted(getattr(registry, "_KERNEL_CACHE_DENY", ())):
+        if registry.get_op(name) is None:
+            add("RC209", "kernel-cache deny-list entry does not resolve in "
+                "the live registry (op renamed? fix the _KERNEL_CACHE_DENY "
+                "spelling)", name)
 
     return findings

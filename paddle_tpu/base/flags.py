@@ -72,7 +72,15 @@ def set_flags(flags: Mapping[str, Any]) -> None:
 define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf and raise")
 define_flag("check_nan_inf_level", 0, "0: raise on nan/inf; >0: log only")
 define_flag("benchmark", False, "synchronize after each op for timing")
-define_flag("eager_jit_ops", True, "cache per-op jitted callables for eager dispatch")
+define_flag("eager_jit_ops", True, "superseded by eager_kernel_cache (kept for compat)")
+define_flag("eager_kernel_cache", True,
+            "eager dispatch fast path: serve ops from the signature-keyed "
+            "cache of jitted forward(+VJP) executables "
+            "(paddle_tpu.core.kernel_cache) when the call is semantically "
+            "transparent; 0 forces every op down the trace-per-call slow path")
+define_flag("eager_kernel_cache_max_entries", 512,
+            "LRU capacity of the eager kernel cache (one entry = one "
+            "compiled executable per op signature); <=0 means unbounded")
 define_flag("use_pallas_kernels", True, "use Pallas TPU kernels for fused ops when available")
 define_flag("log_level", 1, "framework log verbosity (higher = chattier)")
 define_flag("allocator_strategy", "xla", "memory allocator strategy (informational on TPU; XLA owns HBM)")

@@ -7,8 +7,12 @@ runs a reverse-topological ready-queue with dependency counting and gradient
 accumulation, writing ``.grad`` on leaf tensors.
 
 Differences from the reference, by design:
-- the VJP of every op comes from jax.vjp at forward time (residuals are
-  device arrays held by the closure) instead of hand-written GradNode classes;
+- the VJP of every op comes from jax at forward time instead of hand-written
+  GradNode classes. On the dispatch fast path (core/kernel_cache.py) the node
+  holds a :class:`~paddle_tpu.core.kernel_cache.CachedVJP` — a residual-
+  carrying handle onto a cached backward executable, applied lazily and
+  without tracing when backward() reaches the node; on the slow path it holds
+  the live jax.vjp closure (residuals are device arrays held by the closure);
 - for ``create_graph=True`` (higher-order grad, reference general_grad.h) the
   node re-runs the op's VJP *through the dispatcher* so the backward ops are
   themselves recorded on the tape;
@@ -60,7 +64,10 @@ class GradNode:
 
     def __init__(self, name, vjp_fn, inputs: List[Tensor], n_outputs: int, out_specs, recompute=None):
         self.name = name
-        self.vjp_fn = vjp_fn  # residual closure from jax.vjp (arrays -> arrays)
+        # arrays -> arrays backward: either the residual closure from an
+        # eager jax.vjp (slow path), or a kernel_cache.CachedVJP replaying a
+        # compiled backward executable (fast path — applying it never traces)
+        self.vjp_fn = vjp_fn
         self.inputs = [e if isinstance(e, Edge) else Edge(e) for e in inputs]
         self.n_outputs = n_outputs
         self.out_specs = out_specs  # (shape, dtype) per output for zero-fill

@@ -7,10 +7,19 @@ every framework op funnels through :func:`primitive`, which
 - applies AMP autocasting when an amp state is active (reference
   paddle/fluid/imperative/amp_auto_cast.cc),
 - runs the op's jax implementation (async XLA dispatch),
-- when grad is required, captures a VJP closure via jax.vjp and wires a
-  GradNode into the tape,
+- when grad is required, captures a VJP for the tape — from the
+  signature-keyed kernel cache (core/kernel_cache.py, the analog of the
+  reference's cached ad_func fast path) on the fast path, or a fresh
+  ``jax.vjp`` trace on the slow path,
 - optionally NaN/Inf-scans outputs (FLAGS_check_nan_inf, reference
   paddle/fluid/eager/nan_inf_utils.cc).
+
+Fast-path transparency contract: the kernel cache is consulted only when
+the dispatch is semantically invisible — no active AMP cast insertion, no
+discovery / static-capture / op-observer hooks, no tracer inputs, and a
+fully hashable signature. Every skip is a counted bypass
+(``kernel_cache.stats()``); ``FLAGS_eager_kernel_cache=0`` disables the
+path entirely.
 
 There is no KernelFactory/KernelKey here by design: on TPU, kernel selection
 is XLA compilation. The op "registry" is the set of python op functions plus
@@ -27,13 +36,36 @@ import jax.numpy as jnp
 
 from ..base import global_state
 from ..base.flags import get_flag
-from . import hooks
+from . import hooks, kernel_cache
 from .tensor import Tensor, unwrap
+
+# dtype -> is-inexact memo: `jnp.issubdtype` walks the numpy type lattice,
+# far too slow to pay per argument per op call.
+_DTYPE_IS_FLOAT: dict = {}
+# python scalar types whose floatness is content-independent; containers
+# (list/tuple) are deliberately NOT memoized — their dtype depends on content.
+_SCALAR_IS_FLOAT: dict = {float: True, int: False, bool: False,
+                          complex: True, str: False, bytes: False,
+                          type(None): False}
 
 
 def _is_float(v) -> bool:
+    dt = getattr(v, "dtype", None)
+    if dt is not None:
+        try:
+            return _DTYPE_IS_FLOAT[dt]
+        except KeyError:
+            r = bool(jnp.issubdtype(dt, jnp.inexact))
+            _DTYPE_IS_FLOAT[dt] = r
+            return r
+        except TypeError:
+            return bool(jnp.issubdtype(dt, jnp.inexact))
+    t = type(v)
+    r = _SCALAR_IS_FLOAT.get(t)
+    if r is not None:
+        return r
     try:
-        return jnp.issubdtype(jnp.asarray(v).dtype if not hasattr(v, "dtype") else v.dtype, jnp.inexact)
+        return bool(jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact))
     except Exception:
         return False
 
@@ -43,14 +75,20 @@ def _requires_grad(t) -> bool:
 
 
 def _check_nan_inf(name, values):
-    for v in values:
-        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact):
-            arr = np.asarray(v)
-            if not np.isfinite(arr).all():
-                from ..base.enforce import PreconditionNotMetError
+    """One batched device read per op (not one ``np.asarray`` round-trip
+    per output): every float output's ``isfinite`` collapses to a single
+    scalar on device; the lone host sync is the final ``bool()``."""
+    finite = [jnp.all(jnp.isfinite(v)) for v in values
+              if hasattr(v, "dtype") and _is_float(v)]
+    if not finite:
+        return
+    ok = finite[0]
+    for f in finite[1:]:
+        ok = jnp.logical_and(ok, f)
+    if not bool(ok):
+        from ..base.enforce import PreconditionNotMetError
 
-                raise PreconditionNotMetError(f"op '{name}' produced NaN/Inf output")
-
+        raise PreconditionNotMetError(f"op '{name}' produced NaN/Inf output")
 
 
 def _observe(name, out_list):
@@ -89,6 +127,20 @@ def primitive(
     return _primitive_impl(name, fn, tensor_args, attrs)
 
 
+def _fast_path_reason(amp):
+    """Transparency gate for the kernel cache: the active signature-changing
+    interception point that self-disables the fast path (None = go fast)."""
+    if amp is not None:
+        return "amp"
+    if hooks.discovery is not None:
+        return "discovery"
+    if hooks.static_capture is not None:
+        return "static_capture"
+    if hooks.op_observer is not None:
+        return "observer"
+    return None
+
+
 def _primitive_impl(name, fn, tensor_args, attrs):
     amp = global_state.amp_state()
     if amp is not None:
@@ -104,6 +156,30 @@ def _primitive_impl(name, fn, tensor_args, attrs):
         for i, a in enumerate(tensor_args)
         if grad_on and _requires_grad(a) and _is_float(values[i])
     ]
+
+    if get_flag("eager_kernel_cache"):
+        reason = _fast_path_reason(amp)
+        if reason is None:
+            entry = kernel_cache.lookup(name, fn, values, attrs, diff_idx)
+            if entry is not None:
+                try:
+                    result = kernel_cache.execute(entry, values)
+                except Exception:
+                    if entry.staged:
+                        # a proven executable failed at runtime (OOM, bad
+                        # input): that error is the caller's to see, not a
+                        # reason to demote the op to trace-per-call forever
+                        raise
+                    # the kernel refuses staging (data-dependent shapes,
+                    # host ops, RNG draws): poison the key so later calls
+                    # skip straight to the slow path, and serve this one
+                    # eagerly below.
+                    kernel_cache.poison(entry.key, name)
+                else:
+                    return _finish_fast(name, fn, values, attrs, diff_idx,
+                                        tensor_args, entry, result)
+        else:
+            kernel_cache.record_bypass(name, reason)
 
     if not diff_idx:
         out = fn(*values, **attrs)
@@ -125,7 +201,32 @@ def _primitive_impl(name, fn, tensor_args, attrs):
 
     outs = _wrap_outputs(name, out, stop_gradient=False)
     out_list = outs if isinstance(outs, tuple) else (outs,)
+    _record_grad_node(name, fn, values, attrs, diff_idx, tensor_args,
+                      vjp_fn, out_list)
+    _observe(name, out_list)
+    if hooks.static_capture is not None:
+        hooks.static_capture.record(name, fn, tensor_args, attrs, outs)
+    return outs
 
+
+def _finish_fast(name, fn, values, attrs, diff_idx, tensor_args, entry, result):
+    """Wrap a cache-hit execution: identical output wrapping, tape wiring
+    and observer taps as the slow path — only the trace is skipped."""
+    if not entry.has_vjp:
+        outs = _wrap_outputs(name, result, stop_gradient=True)
+        _observe(name, outs if isinstance(outs, tuple) else (outs,))
+        return outs
+    out, cached_vjp = result
+    outs = _wrap_outputs(name, out, stop_gradient=False)
+    out_list = outs if isinstance(outs, tuple) else (outs,)
+    _record_grad_node(name, fn, values, attrs, diff_idx, tensor_args,
+                      cached_vjp, out_list)
+    _observe(name, out_list)
+    return outs
+
+
+def _record_grad_node(name, fn, values, attrs, diff_idx, tensor_args,
+                      vjp_fn, out_list):
     from .autograd import GradNode
 
     node = GradNode(
@@ -139,17 +240,31 @@ def _primitive_impl(name, fn, tensor_args, attrs):
     for i, o in enumerate(out_list):
         o._grad_node = node
         o._output_index = i
+    return node
 
-    _observe(name, out_list)
-    if hooks.static_capture is not None:
-        hooks.static_capture.record(name, fn, tensor_args, attrs, outs)
-    return outs
+
+# Output-name interning (hot path): one precomputed tuple per (op, arity)
+# instead of an f-string allocation per output per call.
+_OUT_NAMES: dict = {}
+
+
+def _out_names(name: str, arity: int) -> tuple:
+    key = (name, arity)
+    try:
+        return _OUT_NAMES[key]
+    except KeyError:
+        names = (tuple(f"{name}_out{i}" for i in range(arity))
+                 if arity >= 0 else (f"{name}_out",))
+        _OUT_NAMES[key] = names
+        return names
 
 
 def _wrap_outputs(name, out, stop_gradient):
     if isinstance(out, (tuple, list)):
-        return tuple(Tensor(o, stop_gradient=stop_gradient, name=f"{name}_out{i}") for i, o in enumerate(out))
-    return Tensor(out, stop_gradient=stop_gradient, name=f"{name}_out")
+        names = _out_names(name, len(out))
+        return tuple(Tensor(o, stop_gradient=stop_gradient, name=names[i])
+                     for i, o in enumerate(out))
+    return Tensor(out, stop_gradient=stop_gradient, name=_out_names(name, -1)[0])
 
 
 def passthrough(name: str, fn: Callable, tensor_args: Sequence[Any], attrs: dict | None = None):

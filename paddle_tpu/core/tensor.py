@@ -28,6 +28,11 @@ from . import hooks
 
 
 def _to_jax(value, dtype=None):
+    if dtype is None and isinstance(value, jax.Array):
+        # hot path: op outputs are already device arrays (or tracers, which
+        # subclass jax.Array) — re-running jnp.asarray's dtype lattice on
+        # every output wrap was measurable per eager op
+        return value
     if isinstance(value, Tensor):
         value = value._value
     if dtype is not None:
@@ -53,7 +58,7 @@ class Tensor:
         "_grad",
         "_grad_node",
         "_output_index",
-        "name",
+        "_name",
         "persistable",
         "_backward_hooks",
         "_placements",
@@ -72,10 +77,7 @@ class Tensor:
         self._grad = None
         self._grad_node = None
         self._output_index = 0
-        if name is None:
-            _tensor_counter[0] += 1
-            name = f"generated_tensor_{_tensor_counter[0]}"
-        self.name = name
+        self._name = name  # None -> lazily derived on first access
         self.persistable = persistable
         self._backward_hooks = None
         self._placements = None  # auto-parallel placement annotation
@@ -85,6 +87,21 @@ class Tensor:
         self._version = 0
 
     # -------------------------------------------------- meta
+    @property
+    def name(self):
+        """Auto-generated names are derived lazily: allocating the counter
+        and the f-string per Tensor was measurable on the eager dispatch
+        hot path, and most tensors never have their name read."""
+        n = self._name
+        if n is None:
+            _tensor_counter[0] += 1
+            n = self._name = f"generated_tensor_{_tensor_counter[0]}"
+        return n
+
+    @name.setter
+    def name(self, value):
+        self._name = value
+
     @property
     def shape(self):
         return list(self._value.shape)
@@ -480,7 +497,7 @@ def _tensor_unflatten(aux, children):
     out._grad = None
     out._grad_node = None
     out._output_index = 0
-    out.name = name
+    out._name = name
     out.persistable = False
     out._backward_hooks = None
     out._placements = None
@@ -514,7 +531,7 @@ def _param_unflatten(aux, children):
     t = _tensor_unflatten(aux, children)
     p = Parameter.__new__(Parameter)
     for slot in (
-        "_value", "stop_gradient", "_grad", "_grad_node", "_output_index", "name",
+        "_value", "stop_gradient", "_grad", "_grad_node", "_output_index", "_name",
         "persistable", "_backward_hooks", "_placements", "_process_mesh",
         "is_parameter", "trainable", "_version",
     ):

@@ -9,23 +9,33 @@ from ...core.dispatch import primitive
 from ...core.tensor import Tensor, unwrap
 
 
+def _apply_affine(out, wb, has_w, has_b, shape=None):
+    """Scale/shift ``out`` by the trailing ``wb`` args. The norm kernels
+    close over presence BOOLEANS, never the weight/bias Tensors themselves:
+    a Tensor closure cell would make every call an array_capture
+    kernel-cache bypass, keeping the hottest norm ops on the
+    trace-per-call slow path."""
+    if has_w:
+        w = wb[0]
+        out = out * (w.reshape(shape) if shape is not None else w)
+    if has_b:
+        b = wb[1 if has_w else 0]
+        out = out + (b.reshape(shape) if shape is not None else b)
+    return out
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
     if isinstance(normalized_shape, int):
         normalized_shape = [normalized_shape]
     n_axes = len(normalized_shape)
+    has_w, has_b = weight is not None, bias is not None
 
     def fn(v, *wb):
         axes = tuple(range(v.ndim - n_axes, v.ndim))
         mean = jnp.mean(v, axis=axes, keepdims=True)
         var = jnp.var(v, axis=axes, keepdims=True)
         out = (v - mean) * jax_rsqrt(var + epsilon)
-        i = 0
-        if weight is not None:
-            out = out * wb[i]
-            i += 1
-        if bias is not None:
-            out = out + wb[i]
-        return out
+        return _apply_affine(out, wb, has_w, has_b)
 
     args = [x] + [t for t in (weight, bias) if t is not None]
     return primitive("layer_norm", fn, args)
@@ -78,18 +88,14 @@ def batch_norm(
     reduce_axes = tuple(i for i in range(v.ndim) if i != ch_axis)
     use_stats = (not training) if use_global_stats is None else use_global_stats
 
+    has_w, has_b = weight is not None, bias is not None
+
     if use_stats:
         def fn(v, m, var, *wb):
             shape = [1] * v.ndim
             shape[ch_axis] = v.shape[ch_axis]
             out = (v - m.reshape(shape)) * jax_rsqrt(var.reshape(shape) + epsilon)
-            i = 0
-            if weight is not None:
-                out = out * wb[i].reshape(shape)
-                i += 1
-            if bias is not None:
-                out = out + wb[i].reshape(shape)
-            return out
+            return _apply_affine(out, wb, has_w, has_b, shape)
 
         args = [x, running_mean, running_var] + [t for t in (weight, bias) if t is not None]
         return primitive("batch_norm_infer", fn, args)
@@ -101,13 +107,7 @@ def batch_norm(
         shape = [1] * v.ndim
         shape[ch_axis] = v.shape[ch_axis]
         out = (v - mean.reshape(shape)) * jax_rsqrt(var.reshape(shape) + epsilon)
-        i = 0
-        if weight is not None:
-            out = out * wb[i].reshape(shape)
-            i += 1
-        if bias is not None:
-            out = out + wb[i].reshape(shape)
-        return out, mean, var
+        return _apply_affine(out, wb, has_w, has_b, shape), mean, var
 
     args = [x] + [t for t in (weight, bias) if t is not None]
     out, batch_mean, batch_var = primitive("batch_norm", fn, args)
@@ -124,6 +124,8 @@ def batch_norm(
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    has_w, has_b = weight is not None, bias is not None
+
     def fn(v, *wb):
         ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
         spatial = tuple(i for i in range(2, v.ndim)) if ch_axis == 1 else tuple(range(1, v.ndim - 1))
@@ -132,19 +134,15 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None
         out = (v - mean) * jax_rsqrt(var + eps)
         shape = [1] * v.ndim
         shape[ch_axis] = v.shape[ch_axis]
-        i = 0
-        if weight is not None:
-            out = out * wb[i].reshape(shape)
-            i += 1
-        if bias is not None:
-            out = out + wb[i].reshape(shape)
-        return out
+        return _apply_affine(out, wb, has_w, has_b, shape)
 
     args = [x] + [t for t in (weight, bias) if t is not None]
     return primitive("instance_norm", fn, args)
 
 
 def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    has_w, has_b = weight is not None, bias is not None
+
     def fn(v, *wb):
         cl = not data_format.startswith("NC")
         if cl:
@@ -160,12 +158,7 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format=
         out = ((g - mean) * jax_rsqrt(var + epsilon)).reshape(v_t.shape)
         shape = [1] * out.ndim
         shape[1] = c
-        i = 0
-        if weight is not None:
-            out = out * wb[i].reshape(shape)
-            i += 1
-        if bias is not None:
-            out = out + wb[i].reshape(shape)
+        out = _apply_affine(out, wb, has_w, has_b, shape)
         if cl:
             out = jnp.moveaxis(out, 1, -1)
         return out

@@ -67,8 +67,14 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
     from ..base import global_state
 
     if training:
+        # draw the key OUTSIDE the kernel (dropout's pattern): a split()
+        # inside fn would advance the global generator under any staging
+        # trace, and the key in the closure keeps the op off the kernel
+        # cache (fresh randomness per call)
+        key = global_state.default_generator.split()
+
         def fn(v):
-            a = jax.random.uniform(global_state.default_generator.split(), v.shape, v.dtype, lower, upper)
+            a = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
             return jnp.where(v >= 0, v, a * v)
     else:
         mid = (lower + upper) / 2.0
@@ -138,8 +144,10 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from ..base import global_state
 
+    key = global_state.default_generator.split()  # see rrelu: key stays host-side
+
     def fn(v):
-        g = jax.random.gumbel(global_state.default_generator.split(), v.shape, v.dtype)
+        g = jax.random.gumbel(key, v.shape, v.dtype)
         y = jax.nn.softmax((v + g) / temperature, axis=axis)
         if hard:
             idx = jnp.argmax(y, axis=axis, keepdims=True)

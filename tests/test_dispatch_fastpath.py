@@ -1,0 +1,466 @@
+"""Eager dispatch fast path (core/kernel_cache.py): the signature-keyed
+cache of jitted forward(+VJP) executables must be semantically invisible.
+
+Covers the ISSUE 3 matrix: hit/miss/bypass accounting (grad on/off, AMP,
+observer, discovery, static capture, unhashable attrs, tracer inputs,
+deny-listed ops), numerical equivalence of cached vs uncached
+forward+backward, LRU eviction, ``stats()`` shape, lazy output naming,
+and the batched NaN/Inf scan.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core import hooks, kernel_cache
+from paddle_tpu.core.dispatch import primitive
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    """Every test starts from an empty cache with the fast path ON and
+    leaves the global flag state clean."""
+    prev = paddle.get_flags(["eager_kernel_cache",
+                             "eager_kernel_cache_max_entries"])
+    paddle.set_flags({"eager_kernel_cache": True,
+                      "eager_kernel_cache_max_entries": 512})
+    kernel_cache.clear()
+    yield
+    kernel_cache.clear()
+    paddle.set_flags(prev)
+
+
+def _t(arr, stop_gradient=True):
+    return paddle.Tensor(np.asarray(arr, np.float32), stop_gradient=stop_gradient)
+
+
+def _op_stats(name):
+    return kernel_cache.stats()["ops"].get(
+        name, {"hits": 0, "misses": 0, "bypasses": 0, "evictions": 0,
+               "bypass_reasons": {}})
+
+
+# ---------------------------------------------------------------------------
+# hit / miss accounting
+# ---------------------------------------------------------------------------
+
+def test_second_call_hits():
+    a, b = _t(np.ones((4, 4))), _t(np.ones((4, 4)))
+    paddle.add(a, b)
+    paddle.add(a, b)
+    s = _op_stats("add")
+    assert s["misses"] == 1 and s["hits"] == 1 and s["bypasses"] == 0
+
+
+def test_shape_and_dtype_churn_miss():
+    paddle.add(_t(np.ones((2, 2))), _t(np.ones((2, 2))))
+    paddle.add(_t(np.ones((3, 3))), _t(np.ones((3, 3))))  # new shape
+    x = paddle.Tensor(np.ones((2, 2), np.int32))
+    paddle.add(x, x)                                      # new dtype
+    assert _op_stats("add")["misses"] == 3
+
+
+def test_grad_on_off_are_distinct_entries():
+    a = _t(np.ones((4, 4)), stop_gradient=False)
+    b = _t(np.ones((4, 4)))
+    paddle.add(a, a)   # diff x diff
+    paddle.add(b, b)   # nondiff
+    paddle.add(a, a)
+    paddle.add(b, b)
+    s = _op_stats("add")
+    assert s["misses"] == 2 and s["hits"] == 2
+
+
+def test_scalar_arg_type_distinguishes_entries():
+    # 2, 2.0 and True are ==/hash-equal; serving one staged program for
+    # all three would return the wrong output dtype
+    xi = paddle.Tensor(np.array([3, 4], np.int32))
+    a = xi * 2
+    b = xi * 2.0
+    c = xi * True
+    assert a.dtype.name == "int32"
+    assert b.dtype.name == "float32"
+    assert c.dtype.name == "int32"
+    np.testing.assert_allclose(b.numpy(), [6.0, 8.0])
+    assert _op_stats("multiply")["misses"] == 3
+
+
+def test_kwonly_default_values_key_the_cache():
+    # kernel factories may parameterize via keyword-only defaults instead
+    # of closure cells; those values must key the cache too
+    def make(s):
+        def fn(v, *, scale=s):
+            return v * scale
+        return fn
+
+    x = _t(np.ones(3))
+    o2 = primitive("aux_kw", make(2.0), [x])
+    o3 = primitive("aux_kw", make(3.0), [x])
+    np.testing.assert_allclose(o2.numpy(), [2, 2, 2])
+    np.testing.assert_allclose(o3.numpy(), [3, 3, 3])
+    assert _op_stats("aux_kw")["misses"] == 2
+
+
+def test_layer_norm_is_cacheable():
+    # the hottest norm ops must not close over their weight/bias Tensors
+    # (that would be a permanent array_capture bypass — trace per call)
+    ln = paddle.nn.LayerNorm(8)
+    x = paddle.Tensor(np.random.randn(4, 8).astype(np.float32),
+                      stop_gradient=False)
+    paddle.sum(ln(x)).backward()
+    paddle.sum(ln(x)).backward()
+    s = _op_stats("layer_norm")
+    assert s["misses"] == 1 and s["hits"] == 1 and s["bypasses"] == 0
+
+
+def test_attr_closure_values_key_the_cache():
+    x = _t(np.arange(12).reshape(3, 4))
+    paddle.sum(x, axis=0)
+    paddle.sum(x, axis=1)   # different closed-over axis -> different entry
+    paddle.sum(x, axis=0)
+    s = _op_stats("sum")
+    assert s["misses"] == 2 and s["hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# numerical equivalence, cached vs uncached
+# ---------------------------------------------------------------------------
+
+def _fwd_bwd(seed=7):
+    rs = np.random.RandomState(seed)
+    x = paddle.Tensor(rs.randn(4, 8).astype(np.float32), stop_gradient=False)
+    w = paddle.Tensor(rs.randn(8, 8).astype(np.float32), stop_gradient=False)
+    h = paddle.matmul(x, w)
+    y = paddle.nn.functional.softmax(h, axis=-1)
+    loss = paddle.mean(y * y)
+    loss.backward()
+    return (np.asarray(loss.numpy()), x.grad.numpy().copy(),
+            w.grad.numpy().copy())
+
+
+def test_forward_backward_matches_slow_path():
+    cached = _fwd_bwd()
+    # steady state: run again so every op is a hit
+    cached2 = _fwd_bwd()
+    assert kernel_cache.stats()["totals"]["hits"] > 0
+    paddle.set_flags({"eager_kernel_cache": False})
+    slow = _fwd_bwd()
+    for c, c2, s in zip(cached, cached2, slow):
+        np.testing.assert_allclose(c, c2, rtol=0, atol=0)  # replay is stable
+        np.testing.assert_allclose(c, s, rtol=1e-5, atol=1e-6)
+
+
+def test_double_backward_still_works():
+    # create_graph routes through the recompute triple, not the cached VJP
+    x = paddle.Tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, x, create_graph=True)
+    (gg,) = paddle.grad(g, x)
+    np.testing.assert_allclose(gg.numpy(), [12.0], rtol=1e-6)
+
+
+def test_retain_graph_reapplies_cached_vjp():
+    x = paddle.Tensor(np.ones((3,), np.float32), stop_gradient=False)
+    y = paddle.sum(x * x)
+    y.backward(retain_graph=True)
+    g1 = x.grad.numpy().copy()
+    x.clear_grad()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), g1)
+
+
+# ---------------------------------------------------------------------------
+# bypass matrix: every interception point disables the fast path
+# ---------------------------------------------------------------------------
+
+def test_amp_bypasses():
+    a = _t(np.ones((4, 4)))
+    with paddle.amp.auto_cast(level="O1"):
+        paddle.matmul(a, a)
+    s = _op_stats("matmul")
+    assert s["hits"] == s["misses"] == 0
+    assert s["bypass_reasons"].get("amp", 0) >= 1
+
+
+def test_observer_bypasses():
+    a = _t(np.ones((2, 2)))
+    seen = []
+    hooks.op_observer = lambda name, vals: seen.append(name)
+    try:
+        paddle.add(a, a)
+    finally:
+        hooks.op_observer = None
+    assert seen == ["add"]
+    s = _op_stats("add")
+    assert s["misses"] == 0 and s["hits"] == 0
+    assert s["bypass_reasons"] == {"observer": 1}
+
+
+def test_discovery_and_static_capture_bypass():
+    a = _t(np.ones((2, 2)))
+
+    class _Disc:
+        def record_reads(self, args):
+            pass
+
+        def record_create(self, t):
+            pass
+
+    hooks.discovery = _Disc()
+    try:
+        paddle.add(a, a)
+    finally:
+        hooks.discovery = None
+
+    class _Cap:
+        def record(self, *args):
+            pass
+
+    hooks.static_capture = _Cap()
+    try:
+        paddle.add(a, a)
+    finally:
+        hooks.static_capture = None
+    s = _op_stats("add")
+    assert s["misses"] == 0 and s["hits"] == 0
+    assert s["bypass_reasons"] == {"discovery": 1, "static_capture": 1}
+
+
+def test_tracer_inputs_bypass():
+    a = _t(np.ones((2, 2)))
+
+    @jax.jit
+    def staged(v):
+        return paddle.add(paddle.Tensor(v), a)._value
+
+    staged(a._value)
+    assert _op_stats("add")["bypass_reasons"].get("tracer", 0) >= 1
+
+
+def test_unhashable_attrs_bypass():
+    out = primitive("aux_attr", lambda a, b, bad=None: jnp.add(a, b),
+                    [_t(np.ones(2)), _t(np.ones(2))],
+                    attrs={"bad": np.zeros(2)})
+    np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+    assert _op_stats("aux_attr")["bypass_reasons"] == {"array_capture": 1}
+
+
+def test_bound_method_kernels_bypass():
+    # a bound method's __code__/__closure__ drop the instance from any
+    # derivable key; two instances with different state must not collide
+    class Scaler:
+        def __init__(self, k):
+            self.k = k
+
+        def apply(self, v):
+            return v * self.k
+
+    a = _t(np.ones(3))
+    o2 = primitive("aux_bound", Scaler(2.0).apply, [a])
+    o3 = primitive("aux_bound", Scaler(3.0).apply, [a])
+    np.testing.assert_allclose(o2.numpy(), [2, 2, 2])
+    np.testing.assert_allclose(o3.numpy(), [3, 3, 3])
+    assert _op_stats("aux_bound")["bypass_reasons"] == {"unhashable": 2}
+
+
+def test_tensor_in_closure_bypasses():
+    a = _t(np.ones(3))
+    captured = _t(np.ones(3))
+    primitive("aux_capture", lambda v: v + captured._value, [a])
+    assert _op_stats("aux_capture")["bypass_reasons"] == {"array_capture": 1}
+
+
+def test_dropout_rng_key_bypasses_not_frozen():
+    # the per-call PRNG key lives in the kernel closure: caching it would
+    # replay identical masks forever — it must bypass instead
+    paddle.seed(0)
+    x = _t(np.ones((64,)), stop_gradient=False)
+    m1 = paddle.nn.functional.dropout(x, p=0.5)
+    m2 = paddle.nn.functional.dropout(x, p=0.5)
+    assert not np.array_equal(m1.numpy(), m2.numpy())
+    # counted under the deliberate reason, NOT the JX320 storm numerator
+    assert _op_stats("dropout")["bypass_reasons"] == {"array_capture": 2}
+
+
+def test_rng_ops_stay_random_and_generator_stays_clean():
+    # rrelu/gumbel_softmax draw their key host-side (closure -> bypass);
+    # randomness must differ per call and the global generator must never
+    # hold a tracer afterwards
+    from paddle_tpu.base import global_state
+
+    paddle.seed(123)
+    x = _t(-np.ones((128,)))
+    r1 = paddle.nn.functional.rrelu(x, training=True)
+    r2 = paddle.nn.functional.rrelu(x, training=True)
+    assert not np.array_equal(r1.numpy(), r2.numpy())
+    g1 = paddle.nn.functional.gumbel_softmax(_t(np.zeros((2, 8))))
+    g2 = paddle.nn.functional.gumbel_softmax(_t(np.zeros((2, 8))))
+    assert not np.array_equal(g1.numpy(), g2.numpy())
+    key = global_state.default_generator._key
+    assert not isinstance(key, jax.core.Tracer)
+    paddle.rand([4])  # the stream still serves draws
+
+
+def test_staging_rng_draw_detected_and_repaired():
+    # a custom kernel that splits the global key inside its body must be
+    # refused (poisoned), with the generator repaired and the slow path
+    # serving correct per-call randomness
+    from paddle_tpu.base import global_state
+
+    paddle.seed(7)
+
+    def bad_kernel(v):
+        k = global_state.default_generator.split()
+        return v + jax.random.uniform(k, v.shape, v.dtype)
+
+    x = _t(np.zeros((16,)))
+    o1 = primitive("aux_rng", bad_kernel, [x])
+    o2 = primitive("aux_rng", bad_kernel, [x])
+    assert not np.array_equal(o1.numpy(), o2.numpy())
+    assert not isinstance(global_state.default_generator._key, jax.core.Tracer)
+    assert _op_stats("aux_rng")["bypass_reasons"].get("trace_failed", 0) >= 2
+    assert kernel_cache.stats()["size"] == 0
+
+
+def test_poisoned_set_is_bounded():
+    paddle.set_flags({"eager_kernel_cache_max_entries": 2})
+
+    def dyn(v):
+        return v[np.asarray(v) > 0]
+
+    for n in range(2, 15):
+        primitive("aux_dyn2", dyn, [_t(np.ones((n,)))])
+    assert len(kernel_cache._poisoned) <= 8  # 4 * capacity
+
+
+def test_deny_listed_op_bypasses():
+    from paddle_tpu.ops.registry import kernel_cacheable
+
+    assert not kernel_cacheable("nonzero")
+    primitive("nonzero", lambda v: v, [_t(np.ones(2))])
+    assert _op_stats("nonzero")["bypass_reasons"].get("denied", 0) == 1
+
+
+def test_flag_off_disables_entirely():
+    paddle.set_flags({"eager_kernel_cache": False})
+    a = _t(np.ones((2, 2)))
+    paddle.add(a, a)
+    paddle.add(a, a)
+    assert kernel_cache.stats()["ops"] == {}
+
+
+def test_trace_failure_poisons_key():
+    a = _t(np.array([1.0, 0.0, 2.0]))
+
+    def dyn(v):
+        return v[np.asarray(v) > 0]  # host-dependent shape: untraceable
+
+    out1 = primitive("aux_dyn", dyn, [a])
+    out2 = primitive("aux_dyn", dyn, [a])
+    np.testing.assert_allclose(out1.numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(out2.numpy(), [1.0, 2.0])
+    s = _op_stats("aux_dyn")
+    # first call: counted miss, then poisoned; second call: pure bypass
+    assert s["bypass_reasons"].get("trace_failed", 0) >= 2
+    assert kernel_cache.stats()["size"] == 0
+
+
+# ---------------------------------------------------------------------------
+# eviction + stats shape
+# ---------------------------------------------------------------------------
+
+def test_lru_eviction_bounds_size():
+    paddle.set_flags({"eager_kernel_cache_max_entries": 4})
+    for n in range(2, 12):
+        x = _t(np.ones((n,)))
+        paddle.add(x, x)
+    s = kernel_cache.stats()
+    assert s["size"] == 4 and s["capacity"] == 4
+    assert s["ops"]["add"]["evictions"] == 6
+    assert s["totals"]["evictions"] == 6
+
+
+def test_stats_shape():
+    a = _t(np.ones(2))
+    paddle.add(a, a)
+    s = kernel_cache.stats()
+    assert set(s) == {"ops", "totals", "size", "capacity"}
+    assert set(s["totals"]) == {"hits", "misses", "bypasses", "evictions"}
+    row = s["ops"]["add"]
+    assert set(row) == {"hits", "misses", "bypasses", "evictions",
+                        "bypass_reasons"}
+    # snapshot is a copy: mutating it must not corrupt the live counters
+    row["hits"] = 999
+    assert kernel_cache.stats()["ops"]["add"]["hits"] != 999
+
+
+# ---------------------------------------------------------------------------
+# satellite: output naming + profiler/observer visibility unchanged
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("flag", [True, False])
+def test_output_names_stable_across_paths(flag):
+    paddle.set_flags({"eager_kernel_cache": flag})
+    a = _t(np.ones((4,)))
+    assert paddle.add(a, a).name == "add_out"
+    outs = paddle.split(_t(np.ones((6,))), 3)
+    assert [o.name for o in outs] == [f"split_out{i}" for i in range(3)]
+
+
+def test_generated_tensor_names_lazy_but_unique():
+    ts = [paddle.Tensor(np.zeros(1)) for _ in range(3)]
+    names = [t.name for t in reversed(ts)]
+    assert len(set(names)) == 3
+    assert all(n.startswith("generated_tensor_") for n in names)
+    t = paddle.Tensor(np.zeros(1), name="explicit")
+    assert t.name == "explicit"
+    t.name = "renamed"
+    assert t.name == "renamed"
+
+
+def test_observer_sees_same_values_both_paths():
+    a = _t(np.full((3,), 2.0))
+    recorded = {}
+
+    def observe(name, vals):
+        recorded.setdefault(name, []).append([np.asarray(v) for v in vals])
+
+    hooks.op_observer = observe
+    try:
+        paddle.add(a, a)  # observer active -> slow path
+    finally:
+        hooks.op_observer = None
+    fast = paddle.add(a, a)
+    np.testing.assert_array_equal(recorded["add"][0][0], fast.numpy())
+
+
+# ---------------------------------------------------------------------------
+# satellite: batched NaN/Inf scan
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("flag", [True, False])
+def test_nan_check_raises_on_both_paths(flag):
+    from paddle_tpu.base.enforce import PreconditionNotMetError
+
+    paddle.set_flags({"eager_kernel_cache": flag})
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        a = _t(np.ones((2,)))
+        paddle.add(a, a)  # finite: no raise
+        bad = _t(np.array([1.0, np.inf]))
+        with pytest.raises(PreconditionNotMetError):
+            paddle.add(bad, bad)
+        with pytest.raises(PreconditionNotMetError):
+            paddle.divide(a, _t(np.zeros(2)))
+    finally:
+        paddle.set_flags({"check_nan_inf": False})
+
+
+def test_nan_check_multi_output_and_int_outputs():
+    from paddle_tpu.core.dispatch import _check_nan_inf
+
+    _check_nan_inf("ok", [jnp.ones(3), jnp.arange(3)])  # ints are skipped
+    with pytest.raises(Exception):
+        _check_nan_inf("bad", [jnp.ones(3), jnp.array([np.nan])])

@@ -353,6 +353,21 @@ class TestRegistryGate:
                 del registry._OP_COMPAT[k]
         assert check_registry() == []
 
+    def test_dead_kernel_cache_deny_entry_rejected(self):
+        """RC209: a deny-list name that no longer resolves protects
+        nothing — the renamed op silently becomes cacheable."""
+        from paddle_tpu.analysis.registry_check import check_registry
+        from paddle_tpu.ops import registry
+
+        orig = registry._KERNEL_CACHE_DENY
+        registry._KERNEL_CACHE_DENY = orig | {"op_that_never_existed"}
+        try:
+            findings = [f for f in check_registry() if f.code == "RC209"]
+            assert [f.location for f in findings] == ["op_that_never_existed"]
+        finally:
+            registry._KERNEL_CACHE_DENY = orig
+        assert check_registry() == []
+
 
 # ---------------------------------------------------------------- jaxpr
 class TestJaxprAuditor:
@@ -364,6 +379,19 @@ class TestJaxprAuditor:
 
         step = record_demo_step()
         assert step.audit() == [], [str(f) for f in step.audit()]
+
+    def test_record_demo_step_preserves_rng_stream(self):
+        """An in-process health check must not reseed the caller's RNG."""
+        from paddle_tpu.analysis.jaxpr_audit import record_demo_step
+        from paddle_tpu.base import global_state
+
+        paddle.seed(42)
+        global_state.default_generator.split()
+        before = np.asarray(global_state.default_generator._key)
+        record_demo_step()
+        after = np.asarray(global_state.default_generator._key)
+        assert np.array_equal(before, after)
+        assert global_state.default_generator._seed == 42
 
     def test_callback_inside_to_static_flagged(self):
         import jax
@@ -497,6 +525,81 @@ class TestJaxprAuditor:
         warns = [f_ for f_ in f.audit() if f_.code == "JX303"]
         assert warns and all(f_.severity == "warning" for f_ in warns), \
             [str(f_) for f_ in f.audit()]
+
+
+# ---------------------------------------------------- kernel cache (JX32x)
+class TestKernelCacheAudit:
+    """ISSUE 3: the eager kernel-cache audit reads counters only (seeded
+    snapshots here; ``tools.lint``'s jaxpr analyzer feeds it live
+    ``kernel_cache.stats()``)."""
+
+    def _audit(self, ops, **kw):
+        from paddle_tpu.analysis.jaxpr_audit import audit_kernel_cache
+
+        return audit_kernel_cache({"ops": ops}, **kw)
+
+    @staticmethod
+    def _row(**kw):
+        row = {"hits": 0, "misses": 0, "bypasses": 0, "evictions": 0,
+               "bypass_reasons": {}}
+        row.update(kw)
+        return row
+
+    def test_unhashable_bypass_storm_flagged(self):
+        ops = {"mul": self._row(bypasses=500,
+                                bypass_reasons={"unhashable": 480, "amp": 20})}
+        found = self._audit(ops)
+        assert "JX320" in _codes(found)
+        assert all(f.severity == "warning" for f in found)
+        # hook-driven bypasses (amp/discovery) are deliberate, not a storm
+        ops = {"mul": self._row(bypasses=500, bypass_reasons={"amp": 500})}
+        assert "JX320" not in _codes(self._audit(ops))
+        # array/PRNG-key captures (dropout's per-call key) are by design
+        ops = {"dropout": self._row(bypasses=500,
+                                    bypass_reasons={"array_capture": 500})}
+        assert "JX320" not in _codes(self._audit(ops))
+        # below the threshold: too little signal to flag
+        ops = {"mul": self._row(bypasses=10,
+                                bypass_reasons={"unhashable": 10})}
+        assert "JX320" not in _codes(self._audit(ops))
+
+    def test_per_op_miss_ladder_flagged(self):
+        ops = {"exp": self._row(misses=200, hits=3)}
+        assert "JX321" in _codes(self._audit(ops, max_keys_per_op=32))
+        # a warm cache with many signatures but dominant hits is healthy
+        ops = {"exp": self._row(misses=200, hits=5000)}
+        assert "JX321" not in _codes(self._audit(ops, max_keys_per_op=32))
+        ops = {"exp": self._row(misses=8, hits=0)}
+        assert "JX321" not in _codes(self._audit(ops, max_keys_per_op=32))
+
+    def test_eviction_thrash_flagged(self):
+        ops = {"add": self._row(hits=10, evictions=50),
+               "mul": self._row(hits=5, evictions=30)}
+        assert "JX322" in _codes(self._audit(ops))
+        ops = {"add": self._row(hits=5000, evictions=12)}
+        assert "JX322" not in _codes(self._audit(ops))
+
+    def test_live_stats_audit_runs_clean_shapes(self):
+        """The no-snapshot form pulls the live process counters and always
+        returns a (possibly empty) warning-only list."""
+        from paddle_tpu.analysis.jaxpr_audit import audit_kernel_cache
+
+        found = audit_kernel_cache()
+        assert all(f.severity == "warning" for f in found)
+        assert all(f.code.startswith("JX32") for f in found)
+
+    def test_exercised_cache_stays_clean(self):
+        from paddle_tpu.analysis.jaxpr_audit import audit_kernel_cache
+        from paddle_tpu.core import kernel_cache
+
+        kernel_cache.clear()
+        try:
+            a = paddle.ones([4])
+            for _ in range(4):
+                paddle.add(a, a)
+            assert audit_kernel_cache() == []
+        finally:
+            kernel_cache.clear()
 
 
 # ---------------------------------------------------------------- spmd

@@ -13,7 +13,7 @@ Runs the five ``paddle_tpu.analysis`` analyzers and reports findings:
 - **jaxpr**:    the trace-level auditor, exercised on a freshly compiled
                 representative whole-step TrainStep (build → run → audit
                 every cached program's ClosedJaxpr + the recompilation
-                heuristics),
+                heuristics) plus the eager kernel-cache counters (JX32x),
 - **spmd**:     the static mesh-axis checker over the same paths as the
                 trace linter.
 
@@ -97,9 +97,11 @@ def _run_program(_paths, include_tests=False):
 def _run_jaxpr(_paths, include_tests=False):
     """Compile the shared representative whole-step TrainStep and audit
     every cached program (trace-level verification + recompilation audit
-    + guard-family coverage, see analysis/jaxpr_audit.py)."""
+    + guard-family coverage, see analysis/jaxpr_audit.py), then the eager
+    kernel-cache counters (JX32x over core.kernel_cache.stats())."""
     import paddle_tpu as paddle
-    from paddle_tpu.analysis.jaxpr_audit import record_demo_step
+    from paddle_tpu.analysis.jaxpr_audit import (audit_kernel_cache,
+                                                 record_demo_step)
 
     step = record_demo_step()
     findings = step.audit()
@@ -114,6 +116,15 @@ def _run_jaxpr(_paths, include_tests=False):
 
     guarded(paddle.ones([4]))
     findings += guarded.audit()
+    # exercise the eager fast path so a fresh CLI process audits live
+    # counters, not an empty dict (in-process runs also fold in whatever
+    # the session already dispatched — that's the point of the audit)
+    from paddle_tpu.base.flags import get_flag
+    if get_flag("eager_kernel_cache"):
+        a = paddle.ones([4])
+        for _ in range(3):
+            paddle.add(a, a)
+    findings += audit_kernel_cache()
     return findings
 
 
