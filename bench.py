@@ -118,6 +118,10 @@ def bench_gpt(on_tpu):
         extras["lint"] = _lint_bench(step)
     except Exception as e:
         extras["lint"] = {"error": str(e).split("\n")[0][:200]}
+    try:
+        extras["cost_model"] = _cost_model_bench(step)
+    except Exception as e:
+        extras["cost_model"] = {"error": str(e).split("\n")[0][:200]}
     return f"{name}_train_tokens_per_sec", tok_s, "tokens/sec", extras
 
 
@@ -196,7 +200,7 @@ def _lint_bench(step):
     from tools.lint import run_analyzers
 
     t0 = time.perf_counter()
-    findings, crashed = run_analyzers(("trace", "registry", "spmd"))
+    findings, crashed, timings = run_analyzers(("trace", "registry", "spmd"))
     lint_s = time.perf_counter() - t0
     builds_before = sum(step._compiled._compile_counts.values())
     t0 = time.perf_counter()
@@ -204,6 +208,7 @@ def _lint_bench(step):
     report_us = (time.perf_counter() - t0) * 1e6
     return {
         "lint_wall_s": round(lint_s, 3),
+        "lint_family_wall_s": timings,
         "lint_findings": len(findings),
         "lint_crashed": crashed,
         "audit_report_us": round(report_us, 1),
@@ -211,6 +216,34 @@ def _lint_bench(step):
                                - builds_before),
         "cache_keys": report["n_cache_keys"],
     }
+
+
+def _cost_model_bench(step):
+    """Static cost model on the live bench TrainStep (tentpole ISSUE 4):
+    analysis wall-time, estimated (liveness walk) vs measured (XLA
+    memory_analysis) peak bytes, and the program's step FLOPs — plus
+    proof the analysis stays off the hot path: running cost() must build
+    zero new programs (`audit_builds_delta == 0` with cost enabled)."""
+    builds_before = sum(step._compiled._compile_counts.values())
+    report = step.cost()
+    builds_delta = (sum(step._compiled._compile_counts.values())
+                    - builds_before)
+    out = {
+        "analysis_wall_s": round(report.analysis_seconds, 4),
+        "flops_per_step": report.flops,
+        "est_peak_bytes": int(report.peak_bytes),
+        "arithmetic_intensity": round(report.arithmetic_intensity, 3),
+        "audit_builds_delta": builds_delta,
+    }
+    try:
+        ma = step._compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is not None:
+        measured = int(ma.argument_size_in_bytes + ma.temp_size_in_bytes)
+        out["measured_peak_bytes"] = measured
+        out["peak_ratio"] = round(report.peak_bytes / max(measured, 1), 3)
+    return out
 
 
 def _pure_jax_gpt_control(cfg, batch, seq, steps):

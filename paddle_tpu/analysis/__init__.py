@@ -3,7 +3,7 @@
 Rebuild of the reference's well-formedness tier: PIR's verify pass
 (paddle/pir/src/core/ir_verify.cc, run after every pass pipeline) and the
 YAML-driven consistency checks its codegen applies to the op library. On
-the JAX rebuild the same guarantees are delivered by five CPU-only
+the JAX rebuild the same guarantees are delivered by six CPU-only
 analyzers that run at commit time:
 
 - :mod:`program_verify` — well-formedness pass over the recorded
@@ -25,9 +25,14 @@ analyzers that run at commit time:
   ``core.kernel_cache.stats()``). Also ``CompiledFunction.audit()`` /
   ``audit_report()``.
 - :mod:`spmd_check` — static mesh-axis resolution for collectives,
-  shard_map/spmd regions and PartitionSpec annotations (SP4xx).
+  shard_map/spmd regions and PartitionSpec annotations (SP4xx), with
+  one-hop cross-file mesh-declaration resolution.
+- :mod:`cost_model` — static FLOPs/bytes/collective-volume/peak-residency
+  walker over the same retraced ClosedJaxprs (CM5xx), feeding
+  ``CompiledFunction.cost()``, the planner's jaxpr-backed HBM estimates
+  and bench's ``extras.cost_model``.
 
-One CLI drives all five: ``python -m tools.lint`` (exit 1 on any
+One CLI drives all six: ``python -m tools.lint`` (exit 1 on any
 error-severity finding, 2 on an analyzer crash; ``--json`` for
 machine-readable output; ``--select``/``--ignore`` for code filters).
 """
@@ -40,9 +45,12 @@ __all__ = [
     "audit_compiled_function",
     "audit_jaxpr",
     "audit_kernel_cache",
+    "check_cost",
     "check_registry",
     "check_spmd_paths",
     "check_spmd_source",
+    "cost_compiled_function",
+    "cost_jaxpr",
     "lint_paths",
     "lint_source",
     "verify_program",
@@ -146,6 +154,24 @@ def audit_kernel_cache(stats=None, **kwargs):
     from .jaxpr_audit import audit_kernel_cache as _impl
 
     return _impl(stats, **kwargs)
+
+
+def cost_jaxpr(closed_jaxpr, **kwargs):
+    from .cost_model import cost_jaxpr as _impl
+
+    return _impl(closed_jaxpr, **kwargs)
+
+
+def cost_compiled_function(cf):
+    from .cost_model import cost_compiled_function as _impl
+
+    return _impl(cf)
+
+
+def check_cost(report, **kwargs):
+    from .cost_model import check_cost as _impl
+
+    return _impl(report, **kwargs)
 
 
 def check_spmd_paths(paths, **kwargs):

@@ -1,0 +1,591 @@
+"""Static jaxpr cost model (CM5xx): FLOPs / bytes / comm / peak residency.
+
+The analysis tier up to PR 2 verifies that a compiled program is
+*well-formed* (jaxpr_audit's JX3xx); this pass asks what it *costs*. A
+single static walker over ClosedJaxprs — reusing ``jaxpr_audit``'s
+retrace machinery (``jax.make_jaxpr`` over the entry's recorded ``pure``
+wrapper; trace only, never compiles) — computes per-equation and
+aggregate:
+
+- **FLOPs** — 2·M·N·K for ``dot_general``, 2·out·cin·k for convolutions,
+  one per output element for elementwise ops, one per input element for
+  reductions; ``scan`` bodies multiply by trip count, ``cond`` branches
+  take the max. The matmul share is tracked separately
+  (``matmul_flops``) for the arithmetic-intensity check.
+- **Bytes** — operand bytes read / result bytes written per equation
+  (aval numel × itemsize), the denominators of arithmetic intensity.
+- **Collective volume per mesh axis** — bytes moved by
+  psum/all_gather/ppermute/... attributed to each named axis (ring
+  all-reduce ≈ 2× one pass for psum/pmean, 1× for the rest; static
+  lower bound — axis sizes are a runtime property).
+- **Peak residency** — a liveness walk: every SSA value is live from its
+  defining equation to its last use, program arguments from entry to
+  their last use (donation semantics), constants and outputs to the end.
+  The running live-set maximum estimates the HBM high-water mark the way
+  XLA's ``memory_analysis`` reports ``argument + temp`` — the planner's
+  calibration target (scalar broadcasts/iota are treated as fused, not
+  materialized, matching XLA's fusion behavior).
+
+Everything lands in one :class:`CostReport`, exposed as
+``CompiledFunction/BucketedFunction/TrainStep.cost()`` (per-entry
+breakdown under ``.per_entry``) and per cached executable via
+``core.kernel_cache.cost_stats()``. Three consumers:
+
+1. the ``cost`` family of ``python -m tools.lint`` (:func:`check_cost`):
+
+   CM500  cost retrace failed    a cache entry no longer retraces
+   CM501  oversized intermediate one equation's result exceeds
+                                 ``FLAGS_cost_max_intermediate_bytes``
+   CM502  intensity cliff        a matmul-free program moving real bytes
+                                 below ``FLAGS_cost_min_arith_intensity``
+                                 flops/byte — memory-bound on TPU
+   CM503  comm-bound program     estimated collective seconds on one mesh
+                                 axis (volume / declared bandwidth model)
+                                 exceed estimated compute seconds
+   CM504  peak over HBM budget   liveness peak per device (under the
+                                 active Plan's degrees) exceeds
+                                 ``FLAGS_cost_hbm_budget_bytes``
+
+2. the parallelism planner (``distributed/auto_parallel/planner.py``):
+   jaxpr-backed ``estimate_per_device_bytes``/``estimate_step_cost``
+   that prefer measured-from-jaxpr numbers over the closed-form
+   transformer accounting, and ``compare_with_measured`` reporting all
+   three (closed-form / cost-model / XLA memory_analysis);
+3. ``bench.py`` ``extras.cost_model`` (analysis wall-time, estimated vs
+   measured peak, step FLOPs for gpt_tiny).
+
+The per-layer formulas ``hapi/dynamic_flops.py`` applies through its
+forward-hook API live here too (:func:`linear_flops` et al., MAC
+convention for parity with the reference's ``paddle.flops``) — one
+accounting, two front ends.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from . import Finding
+
+_ANALYZER = "cost"
+
+# collectives: primitive name -> volume multiplier per pass over the data
+# (ring all-reduce moves ~2x the buffer; gather/scatter/permute ~1x)
+_COLLECTIVE_FACTOR = {
+    "psum": 2.0, "psum2": 2.0, "pmean": 2.0, "pmax": 1.0, "pmin": 1.0,
+    "all_gather": 1.0, "all_gather_invariant": 1.0, "all_to_all": 1.0,
+    "ppermute": 1.0, "pshuffle": 1.0, "psum_scatter": 1.0,
+    "reduce_scatter": 1.0,
+}
+
+# result-moving primitives XLA reliably fuses into their consumer when the
+# operand is a scalar/empty: counting their full output as resident would
+# systematically overshoot memory_analysis
+_FUSED_EXPANSIONS = {"broadcast_in_dim", "iota"}
+
+# primitives whose cost is pure data movement (flops = 0; bytes counted)
+_MOVEMENT_PRIMS = {
+    "reshape", "broadcast_in_dim", "transpose", "squeeze", "slice",
+    "dynamic_slice", "dynamic_update_slice", "concatenate", "pad", "rev",
+    "gather", "scatter", "copy", "convert_element_type", "bitcast",
+    "bitcast_convert_type", "iota", "stop_gradient", "device_put",
+    "sharding_constraint", "split", "expand_dims",
+}
+
+_REDUCTIONS = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "reduce_xor", "argmax", "argmin", "reduce_precision",
+    "cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp",
+}
+
+
+# ---------------------------------------------------------------------------
+# shared layer-level formulas (hapi/dynamic_flops.py delegates here).
+# MAC convention (1 multiply-accumulate = 1 FLOP) for parity with the
+# reference's paddle.flops; the jaxpr walker below uses the standard
+# 2·MAC convention, matching bench.py's analytic step-FLOPs formulas.
+# ---------------------------------------------------------------------------
+
+def linear_flops(out_numel: int, in_features: int, has_bias: bool) -> int:
+    """Dense layer: one MAC per (output element, input feature)."""
+    return out_numel * in_features + (out_numel if has_bias else 0)
+
+
+def conv_flops(out_numel: int, cin_per_group: int, kernel_numel: int,
+               has_bias: bool) -> int:
+    """Convolution: one MAC per (output element, in-channel, kernel tap)."""
+    return out_numel * cin_per_group * kernel_numel + (
+        out_numel if has_bias else 0)
+
+
+def norm_flops(in_numel: int) -> int:
+    """Normalization layers: ~2 passes (stats + affine)."""
+    return 2 * in_numel
+
+
+def activation_flops(out_numel: int) -> int:
+    return out_numel
+
+
+def pool_flops(out_numel: int, kernel_numel: int) -> int:
+    return out_numel * kernel_numel
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CostReport:
+    """Aggregate static cost of one program (or one CompiledFunction's
+    costliest cached program, with ``per_entry`` holding every entry)."""
+
+    flops: float = 0.0
+    matmul_flops: float = 0.0          # dot/conv share of `flops`
+    bytes_read: float = 0.0
+    bytes_written: float = 0.0
+    comm_bytes: Dict[str, float] = dataclasses.field(default_factory=dict)
+    peak_bytes: int = 0                # liveness high-water mark
+    arg_bytes: int = 0                 # program inputs (cells + batch)
+    out_bytes: int = 0
+    largest_intermediate_bytes: int = 0
+    largest_intermediate_prim: str = ""
+    n_eqns: int = 0
+    by_primitive: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)
+    location: str = ""
+    # set by cost_compiled_function:
+    per_entry: Optional[Dict[str, "CostReport"]] = None
+    retrace_errors: List[str] = dataclasses.field(default_factory=list)
+    analysis_seconds: float = 0.0
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        """FLOPs per byte moved — the roofline x-coordinate."""
+        return self.flops / max(self.bytes_read + self.bytes_written, 1.0)
+
+    def to_dict(self) -> dict:
+        d = {
+            "flops": self.flops, "matmul_flops": self.matmul_flops,
+            "bytes_read": self.bytes_read, "bytes_written": self.bytes_written,
+            "comm_bytes": dict(self.comm_bytes),
+            "peak_bytes": self.peak_bytes, "arg_bytes": self.arg_bytes,
+            "out_bytes": self.out_bytes, "n_eqns": self.n_eqns,
+            "arithmetic_intensity": round(self.arithmetic_intensity, 4),
+            "largest_intermediate_bytes": self.largest_intermediate_bytes,
+            "largest_intermediate_prim": self.largest_intermediate_prim,
+            "location": self.location,
+            "analysis_seconds": round(self.analysis_seconds, 4),
+        }
+        if self.retrace_errors:
+            d["retrace_errors"] = list(self.retrace_errors)
+        if self.per_entry is not None:
+            d["per_entry"] = {k: {"flops": r.flops, "peak_bytes": r.peak_bytes}
+                              for k, r in self.per_entry.items()}
+        return d
+
+
+# ---------------------------------------------------------------------------
+# aval arithmetic
+# ---------------------------------------------------------------------------
+
+def _aval_numel(aval) -> int:
+    n = 1
+    for d in getattr(aval, "shape", ()) or ():
+        if not isinstance(d, int):
+            return 0  # dynamic dim: JX305's problem, not ours
+        n *= d
+    return n
+
+
+def _aval_bytes(aval) -> int:
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return 0  # token/abstract value
+    return _aval_numel(aval) * int(getattr(dtype, "itemsize", 4))
+
+
+def _var_bytes(var) -> int:
+    return _aval_bytes(getattr(var, "aval", None))
+
+
+def _sub_jaxprs(eqn):
+    """Every ClosedJaxpr/Jaxpr reachable through one eqn's params."""
+    import jax
+
+    out = []
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vs:
+            if isinstance(item, jax.core.ClosedJaxpr):
+                out.append(item.jaxpr)
+            elif isinstance(item, jax.core.Jaxpr):
+                out.append(item)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-equation FLOPs
+# ---------------------------------------------------------------------------
+
+def _dot_general_flops(eqn) -> float:
+    (lhs_c, rhs_c), (lhs_b, _rhs_b) = eqn.params["dimension_numbers"]
+    lhs = getattr(eqn.invars[0], "aval", None)
+    rhs = getattr(eqn.invars[1], "aval", None)
+    if lhs is None or rhs is None:
+        return 0.0
+    lshape, rshape = lhs.shape, rhs.shape
+    k = 1
+    for i in lhs_c:
+        k *= lshape[i]
+    batch = 1
+    for i in lhs_b:
+        batch *= lshape[i]
+    m = max(_aval_numel(lhs) // max(k * batch, 1), 1)
+    n = max(_aval_numel(rhs) // max(k * batch, 1), 1)
+    return 2.0 * batch * m * n * k
+
+
+def _conv_flops(eqn) -> float:
+    dn = eqn.params.get("dimension_numbers")
+    rhs = getattr(eqn.invars[1], "aval", None)
+    out = getattr(eqn.outvars[0], "aval", None)
+    if rhs is None or out is None:
+        return 0.0
+    rhs_spec = getattr(dn, "rhs_spec", None)
+    if rhs_spec is None:
+        return 2.0 * _aval_numel(out) * _aval_numel(rhs)
+    cin = rhs.shape[rhs_spec[1]]
+    kernel = 1
+    for i in rhs_spec[2:]:
+        kernel *= rhs.shape[i]
+    return 2.0 * _aval_numel(out) * cin * kernel
+
+
+def _eqn_flops(eqn) -> tuple:
+    """(flops, matmul_flops) for one equation, sub-jaxprs excluded."""
+    name = eqn.primitive.name
+    if name == "dot_general":
+        f = _dot_general_flops(eqn)
+        return f, f
+    if name.startswith("conv_general"):
+        f = _conv_flops(eqn)
+        return f, f
+    if name in _MOVEMENT_PRIMS:
+        return 0.0, 0.0
+    if name in _REDUCTIONS:
+        return float(sum(_aval_numel(getattr(v, "aval", None) or ())
+                         for v in eqn.invars
+                         if getattr(v, "aval", None) is not None)), 0.0
+    # default: one flop per output element (elementwise / select / compare)
+    return float(sum(_aval_numel(getattr(v, "aval", None))
+                     for v in eqn.outvars
+                     if getattr(v, "aval", None) is not None)), 0.0
+
+
+def _eqn_comm(eqn) -> Dict[str, float]:
+    """Collective volume per mesh axis for one equation (static single-pass
+    estimate × the ring factor; axis sizes are runtime properties)."""
+    name = eqn.primitive.name
+    factor = _COLLECTIVE_FACTOR.get(name)
+    if factor is None:
+        return {}
+    axes = eqn.params.get("axis_name", eqn.params.get("axes"))
+    if axes is None:
+        return {}
+    if not isinstance(axes, (list, tuple)):
+        axes = (axes,)
+    vol = factor * sum(_var_bytes(v) for v in eqn.invars)
+    return {str(ax): vol for ax in axes}
+
+
+def _is_fused_expansion(eqn) -> bool:
+    """True for broadcast-of-scalar / iota results: XLA fuses these into
+    their consumers, so charging their full output to the live set would
+    overshoot measured peaks by the batch size."""
+    if eqn.primitive.name not in _FUSED_EXPANSIONS:
+        return False
+    for v in eqn.invars:
+        if _aval_numel(getattr(v, "aval", None)) > 1:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the walker
+# ---------------------------------------------------------------------------
+
+def _scan_length(eqn) -> int:
+    length = eqn.params.get("length")
+    return int(length) if isinstance(length, int) and length > 0 else 1
+
+
+def _walk_jaxpr(jaxpr) -> CostReport:
+    """Cost one (open) Jaxpr: totals + liveness peak. Recurses into
+    pjit/scan/while/cond bodies; scan multiplies by trip count, cond takes
+    the max across branches, while counts one iteration (static lower
+    bound — trip counts are data)."""
+    import jax
+
+    rep = CostReport(n_eqns=len(jaxpr.eqns))
+
+    # ---- last-use table for the liveness walk ---------------------------
+    last_use: Dict = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if isinstance(v, jax.core.Var):
+                last_use[v] = i
+    n = len(jaxpr.eqns)
+    for v in jaxpr.outvars:
+        if isinstance(v, jax.core.Var):
+            last_use[v] = n  # live to the end
+
+    # program arguments + constants resident at entry (XLA argument size)
+    rep.arg_bytes = sum(_var_bytes(v) for v in jaxpr.invars)
+    rep.out_bytes = sum(_var_bytes(v) for v in jaxpr.outvars)
+    entry_vars = list(jaxpr.invars) + list(jaxpr.constvars)
+    live = {}
+    for v in entry_vars:
+        live[v] = _var_bytes(v)
+    live_bytes = sum(live.values())
+    peak = live_bytes
+    # arguments never read free right after entry (they still hit the peak
+    # once — XLA holds every argument at program start)
+    for v in entry_vars:
+        if v not in last_use:
+            live_bytes -= live.pop(v)
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        pname = eqn.primitive.name
+        in_b = sum(_var_bytes(v) for v in eqn.invars)
+        out_b = sum(_var_bytes(v) for v in eqn.outvars)
+
+        # container equations (pjit / scan / while / cond / remat /
+        # custom_vjp wrappers) carry NO cost of their own: everything —
+        # flops, bytes, comm — comes from the recursed body, otherwise
+        # every jit boundary double-counts its operand bytes and charges
+        # phantom per-output-element flops
+        subs = _sub_jaxprs(eqn)
+        sub_peak_extra = 0
+        if subs:
+            flops = mm = 0.0
+            sub_reports = [_walk_jaxpr(s) for s in subs]
+            mult = _scan_length(eqn) if pname == "scan" else 1
+            if pname == "cond":
+                best = max(sub_reports, key=lambda r: r.flops)
+                agg = [best]
+            else:
+                agg = sub_reports
+            for sr in agg:
+                flops += mult * sr.flops
+                mm += mult * sr.matmul_flops
+                rep.bytes_read += mult * sr.bytes_read
+                rep.bytes_written += mult * sr.bytes_written
+                for ax, vol in sr.comm_bytes.items():
+                    rep.comm_bytes[ax] = rep.comm_bytes.get(ax, 0.0) + mult * vol
+                for sub_prim, sub_row in sr.by_primitive.items():
+                    row = rep.by_primitive.setdefault(
+                        sub_prim, {"count": 0, "flops": 0.0, "bytes": 0.0})
+                    row["count"] += mult * sub_row["count"]
+                    row["flops"] += mult * sub_row["flops"]
+                    row["bytes"] += mult * sub_row["bytes"]
+                if sr.largest_intermediate_bytes > rep.largest_intermediate_bytes:
+                    rep.largest_intermediate_bytes = sr.largest_intermediate_bytes
+                    rep.largest_intermediate_prim = sr.largest_intermediate_prim
+            # the body's internal peak, minus its arguments (the outer
+            # operands already sit in the live set)
+            sub_peak_extra = max(
+                (sr.peak_bytes - sr.arg_bytes for sr in sub_reports),
+                default=0)
+            sub_peak_extra = max(sub_peak_extra, 0)
+        else:
+            flops, mm = _eqn_flops(eqn)
+            rep.bytes_read += in_b
+            rep.bytes_written += out_b
+            for ax, vol in _eqn_comm(eqn).items():
+                rep.comm_bytes[ax] = rep.comm_bytes.get(ax, 0.0) + vol
+            row = rep.by_primitive.setdefault(
+                pname, {"count": 0, "flops": 0.0, "bytes": 0.0})
+            row["count"] += 1
+            row["flops"] += flops
+            row["bytes"] += in_b + out_b
+
+        rep.flops += flops
+        rep.matmul_flops += mm
+
+        # ---- liveness update -------------------------------------------
+        materialized = 0 if _is_fused_expansion(eqn) else out_b
+        if materialized > rep.largest_intermediate_bytes:
+            rep.largest_intermediate_bytes = materialized
+            rep.largest_intermediate_prim = pname
+        for v in eqn.outvars:
+            if isinstance(v, jax.core.Var) and v in last_use and v not in live:
+                b = 0 if _is_fused_expansion(eqn) else _var_bytes(v)
+                live[v] = b
+                live_bytes += b
+        peak = max(peak, live_bytes + sub_peak_extra)
+        freed = set()
+        for v in eqn.invars:
+            if (isinstance(v, jax.core.Var) and v not in freed
+                    and last_use.get(v) == i):
+                freed.add(v)
+                live_bytes -= live.pop(v, 0)
+
+    rep.peak_bytes = int(peak)
+    return rep
+
+
+def cost_jaxpr(closed_jaxpr, *, location: str = "") -> CostReport:
+    """Cost one ClosedJaxpr. Static — never compiles, never executes."""
+    rep = _walk_jaxpr(closed_jaxpr.jaxpr)
+    rep.location = location
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# CompiledFunction / kernel-cache front ends
+# ---------------------------------------------------------------------------
+
+def cost_compiled_function(cf) -> CostReport:
+    """Cost every cache entry of one ``CompiledFunction`` (same retrace
+    machinery as ``audit_compiled_function`` — tracing only). Returns the
+    costliest entry's report with ``per_entry`` holding each entry and
+    ``retrace_errors`` any entries that no longer trace (CM500 feed)."""
+    import time
+
+    from .jaxpr_audit import retrace_entry
+
+    t0 = time.perf_counter()
+    name = getattr(cf, "name", "fn")
+    per_entry: Dict[str, CostReport] = {}
+    errors: List[str] = []
+
+    def one(entry, loc):
+        try:
+            closed, _n_user, _n_cells = retrace_entry(entry)
+        except Exception as e:
+            errors.append(f"{loc}: {str(e).splitlines()[0]}")
+            return
+        per_entry[loc] = cost_jaxpr(closed, location=loc)
+
+    for idx, (_key, entry) in enumerate(list(cf._cache.items())):
+        loc = f"{name}[{idx}]"
+        if entry.get("guarded"):
+            if entry.get("eager"):
+                continue
+            for outcomes, sub in entry["entries"].items():
+                one(sub, f"{loc}:guards={outcomes}")
+        elif not entry.get("eager"):
+            one(entry, loc)
+
+    if per_entry:
+        rep = max(per_entry.values(), key=lambda r: r.peak_bytes)
+    else:
+        rep = CostReport(location=name)
+    rep.per_entry = per_entry
+    rep.retrace_errors = errors
+    rep.analysis_seconds = time.perf_counter() - t0
+    return rep
+
+
+def cost_bucketed_function(bf) -> CostReport:
+    """Cost a ``BucketedFunction``'s wrapped cache (one entry per engaged
+    bucket rung)."""
+    return cost_compiled_function(bf._compiled)
+
+
+# ---------------------------------------------------------------------------
+# CM5xx checks (the `cost` lint family)
+# ---------------------------------------------------------------------------
+
+def _flag(name, override, fallback):
+    if override is not None:
+        return override
+    try:
+        from ..base.flags import get_flag
+
+        return get_flag(name)
+    except Exception:
+        return fallback
+
+
+def check_cost(report: CostReport, *, plan=None,
+               max_intermediate_bytes=None, hbm_budget_bytes=None,
+               min_arith_intensity=None, intensity_min_bytes=None,
+               bandwidth_gbps=None, device_tflops=None) -> List[Finding]:
+    """CM5xx findings over one :class:`CostReport` (and its per-entry
+    breakdown). ``plan`` is an optional ``auto_parallel.planner.Plan``:
+    when given, the CM504 peak check divides the traced single-program
+    peak across the plan's model-sharding degrees before comparing to the
+    HBM budget."""
+    max_inter = int(_flag("cost_max_intermediate_bytes",
+                          max_intermediate_bytes, 2 << 30))
+    hbm = int(_flag("cost_hbm_budget_bytes", hbm_budget_bytes, 16 << 30))
+    min_ai = float(_flag("cost_min_arith_intensity", min_arith_intensity, 0.25))
+    ai_floor = int(_flag("cost_intensity_min_bytes", intensity_min_bytes,
+                         32 << 20))
+    bw = float(_flag("cost_mesh_bandwidth_gbps", bandwidth_gbps, 100.0))
+    tflops = float(_flag("cost_device_tflops", device_tflops, 197.0))
+
+    findings: List[Finding] = []
+
+    for msg in report.retrace_errors:
+        findings.append(Finding(
+            _ANALYZER, "CM500", "error",
+            f"cost retrace failed: {msg}", report.location))
+
+    entries = (list(report.per_entry.items()) if report.per_entry
+               else [(report.location, report)])
+    for loc, rep in entries:
+        if rep.largest_intermediate_bytes > max_inter:
+            findings.append(Finding(
+                _ANALYZER, "CM501", "warning",
+                f"'{rep.largest_intermediate_prim}' materializes a "
+                f"{rep.largest_intermediate_bytes / 2**20:.0f} MiB "
+                f"intermediate (> {max_inter / 2**20:.0f} MiB budget, "
+                "FLAGS_cost_max_intermediate_bytes) — a single buffer this "
+                "size dominates the program's residency; reshape/chunk it",
+                loc))
+
+        moved = rep.bytes_read + rep.bytes_written
+        if (rep.matmul_flops == 0 and moved >= ai_floor
+                and rep.arithmetic_intensity < min_ai):
+            findings.append(Finding(
+                _ANALYZER, "CM502", "warning",
+                f"matmul-free program moving {moved / 2**20:.0f} MiB at "
+                f"{rep.arithmetic_intensity:.3f} flops/byte (< {min_ai}) — "
+                "memory-bound on TPU; the MXU idles while HBM streams "
+                "(fuse elementwise chains or batch this into a matmul path)",
+                loc))
+
+        if rep.comm_bytes and rep.flops > 0:
+            compute_s = rep.flops / (tflops * 1e12)
+            for ax, vol in sorted(rep.comm_bytes.items()):
+                comm_s = vol / (bw * 1e9)
+                if comm_s > compute_s:
+                    findings.append(Finding(
+                        _ANALYZER, "CM503", "warning",
+                        f"collective volume on axis '{ax}' "
+                        f"({vol / 2**20:.0f} MiB ≈ {comm_s * 1e3:.2f} ms at "
+                        f"{bw:.0f} GB/s) exceeds estimated compute "
+                        f"({compute_s * 1e3:.2f} ms at {tflops:.0f} TFLOP/s) "
+                        "— the step is communication-bound under the "
+                        "declared bandwidth model", loc))
+
+        shards = 1
+        if plan is not None:
+            shards = max(int(getattr(plan, "mp", 1))
+                         * int(getattr(plan, "pp", 1))
+                         * int(getattr(plan, "sep", 1)), 1)
+        per_device = rep.peak_bytes / shards
+        if per_device > hbm:
+            findings.append(Finding(
+                _ANALYZER, "CM504", "error",
+                f"estimated peak residency {per_device / 2**30:.2f} GiB "
+                f"per device (liveness peak {rep.peak_bytes / 2**30:.2f} GiB "
+                f"over {shards} model shard(s)) exceeds the "
+                f"{hbm / 2**30:.0f} GiB HBM budget "
+                "(FLAGS_cost_hbm_budget_bytes) — this program OOMs at "
+                "dispatch; raise the sharding degrees or cut the batch",
+                loc))
+
+    return findings

@@ -221,19 +221,26 @@ def audit_jaxpr(closed_jaxpr, *, location: str = "",
     return findings
 
 
-def _audit_entry(cf, entry, *, location: str, donated: bool) -> List[Finding]:
-    """Retrace one cache entry's pure wrapper (no compilation) and audit
-    the resulting ClosedJaxpr."""
+class RetraceError(RuntimeError):
+    """A cache entry that cannot be re-derived into a ClosedJaxpr."""
+
+
+def retrace_entry(entry):
+    """Re-derive one cache entry's ClosedJaxpr from its recorded ``pure``
+    wrapper + abstract call (``jax.make_jaxpr`` — trace only, no XLA
+    compilation). Shared by the JX3xx auditor and the cost model
+    (``analysis/cost_model.py``). Returns ``(closed_jaxpr, n_user_outs,
+    n_cells)``; raises :class:`RetraceError` when the entry predates the
+    audit tier or no longer traces."""
     import jax
     import numpy as np
 
     pure = entry.get("pure") or getattr(entry.get("jitted"), "__wrapped__", None)
     abstract_call = entry.get("abstract_call")
     if pure is None or abstract_call is None:
-        return [Finding(_ANALYZER, "JX300", "error",
-                        "cache entry records no pure wrapper / abstract call "
-                        "to retrace (entry predates the audit tier?)",
-                        location)]
+        raise RetraceError(
+            "cache entry records no pure wrapper / abstract call "
+            "to retrace (entry predates the audit tier?)")
     cells = entry["cells"]
     try:
         cell_sds = [jax.ShapeDtypeStruct(np.shape(c._value), c._value.dtype)
@@ -242,12 +249,22 @@ def _audit_entry(cf, entry, *, location: str, donated: bool) -> List[Finding]:
         closed, out_shape = jax.make_jaxpr(pure, return_shape=True)(
             cell_sds, args, kwargs)
     except Exception as e:
-        return [Finding(_ANALYZER, "JX300", "error",
-                        f"audit retrace failed: {str(e).splitlines()[0]}",
-                        location)]
+        raise RetraceError(
+            f"audit retrace failed: {str(e).splitlines()[0]}") from e
     n_user_outs = len(jax.tree_util.tree_leaves(out_shape[0]))
+    return closed, n_user_outs, len(cells)
+
+
+def _audit_entry(cf, entry, *, location: str, donated: bool) -> List[Finding]:
+    """Retrace one cache entry's pure wrapper (no compilation) and audit
+    the resulting ClosedJaxpr."""
+    try:
+        closed, n_user_outs, n_cells = retrace_entry(entry)
+    except RetraceError as e:
+        return [Finding(_ANALYZER, "JX300", "error", str(e), location)]
+    cells = entry["cells"]
     return audit_jaxpr(
-        closed, location=location, n_cells=len(cells),
+        closed, location=location, n_cells=n_cells,
         n_user_outs=n_user_outs, donated=donated,
         cell_names=[getattr(c, "name", None) for c in cells])
 
@@ -275,9 +292,14 @@ def _max_cache_keys(override=None) -> int:
         return 32
 
 
-def audit_compiled_function(cf, max_cache_keys=None) -> List[Finding]:
+def audit_compiled_function(cf, max_cache_keys=None,
+                            only_entry=None) -> List[Finding]:
     """Audit every cache entry of one ``CompiledFunction`` plus the
-    recompilation heuristics. Tracing only — never compiles."""
+    recompilation heuristics. Tracing only — never compiles.
+    ``only_entry`` restricts the per-entry RETRACE audits to that one
+    cache entry (by identity) — the runtime build hook's O(1) path; the
+    cheap non-retracing checks (guard coverage, cache-key heuristics)
+    always run."""
     findings: List[Finding] = []
     name = getattr(cf, "name", "fn")
 
@@ -298,6 +320,8 @@ def audit_compiled_function(cf, max_cache_keys=None) -> List[Finding]:
                     "specialized entry and no fallback — the next call on "
                     "this path cannot resolve to a program", loc))
             for outcomes, sub in entry["entries"].items():
+                if only_entry is not None and sub is not only_entry:
+                    continue
                 findings.extend(_audit_entry(
                     cf, sub, location=f"{loc}:guards={outcomes}",
                     donated=False))
@@ -307,6 +331,8 @@ def audit_compiled_function(cf, max_cache_keys=None) -> List[Finding]:
                 "entry committed to eager fallback: "
                 f"{cf.fallback_reason or 'unrecorded reason'}", loc))
         else:
+            if only_entry is not None and entry is not only_entry:
+                continue
             findings.extend(_audit_entry(
                 cf, entry, location=loc,
                 donated=bool(getattr(cf, "donate_cells", False))))

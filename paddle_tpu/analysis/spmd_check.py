@@ -14,7 +14,13 @@ Declared axes = the canonical hybrid mesh
 the SAME FILE declares via ``Mesh(devs, ("x", "y"))`` /
 ``Mesh(..., axis_names=...)`` or ``build_mesh(degrees={"x": 2, ...})`` /
 ``init_parallel_env(degrees=...)`` — test files and experiments carry
-their own meshes.
+their own meshes. A file whose axes would otherwise not resolve also
+gets ONE HOP of cross-file resolution: every ``from X import mesh``-style
+import is resolved to a file (relative to the importing file / the
+repo tree above it) and that file's OWN mesh declarations count too —
+the common "shared mesh module" layout. One hop only, and only when the
+first pass found something unresolved, so clean files never pay the
+extra parse.
 
 SP401  unresolved collective axis   lax.psum/all_gather/ppermute/
                                     axis_index/... over an axis literal
@@ -184,9 +190,90 @@ class _SpmdChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# one-hop import resolution: path -> (mtime, axes declared in that file).
+# Bounded by the source tree size; never follows the imported file's own
+# imports (one hop keeps the walk linear and the semantics predictable).
+_IMPORT_AXES_CACHE: dict = {}
+
+
+def _axes_declared_in_file(path: str) -> Set[str]:
+    import os
+
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return set()
+    cached = _IMPORT_AXES_CACHE.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except (OSError, SyntaxError):
+        axes: Set[str] = set()
+    else:
+        decl = _DeclaredAxes()
+        decl.visit(tree)
+        axes = decl.axes
+    _IMPORT_AXES_CACHE[path] = (mtime, axes)
+    return axes
+
+
+def _resolve_module(module: Optional[str], level: int,
+                    filename: str) -> Optional[str]:
+    """Map one ``from X import ...`` target to a file on disk: relative
+    imports resolve against the importing file's package, absolute ones
+    against the directory tree above it (the repo layout) — site-packages
+    are deliberately out of reach."""
+    import os
+
+    base = os.path.dirname(os.path.abspath(filename))
+    if level > 0:
+        for _ in range(level - 1):
+            base = os.path.dirname(base)
+        roots = [base]
+    else:
+        roots = []
+        d = base
+        for _ in range(8):
+            roots.append(d)
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    parts = module.split(".") if module else []
+    for root in roots:
+        cand = os.path.join(root, *parts) if parts else root
+        if os.path.isfile(cand + ".py"):
+            return cand + ".py"
+        init = os.path.join(cand, "__init__.py")
+        if os.path.isdir(cand) and os.path.isfile(init):
+            return init
+    return None
+
+
+def _one_hop_imported_axes(tree, filename: str) -> Set[str]:
+    """Mesh axes declared by the files this module imports from (ROADMAP
+    item: cross-file mesh declarations), one hop deep."""
+    axes: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        modules = [node.module] if node.module else [
+            a.name for a in node.names]  # `from . import mesh_defs`
+        for mod in modules:
+            path = _resolve_module(mod, node.level, filename)
+            if path:
+                axes |= _axes_declared_in_file(path)
+    return axes
+
+
 def check_source(source: str, filename: str = "<string>",
-                 declared_axes: Optional[Sequence[str]] = None) -> List[Finding]:
+                 declared_axes: Optional[Sequence[str]] = None,
+                 follow_imports: bool = True) -> List[Finding]:
     """Check one module's source; returns (unsuppressed) findings."""
+    import os
+
     try:
         tree = ast.parse(source, filename=filename)
     except SyntaxError as e:
@@ -199,6 +286,14 @@ def check_source(source: str, filename: str = "<string>",
     declared |= decl.axes
     findings: List[Finding] = []
     _SpmdChecker(declared, findings, filename).visit(tree)
+    if findings and follow_imports and os.path.isfile(filename):
+        # second pass with one-hop cross-file declarations — only paid by
+        # files that would otherwise report unresolved axes
+        extra = _one_hop_imported_axes(tree, filename)
+        if extra - declared:
+            declared |= extra
+            findings = []
+            _SpmdChecker(declared, findings, filename).visit(tree)
     from .trace_safety import _apply_noqa
 
     return _apply_noqa(findings, source)

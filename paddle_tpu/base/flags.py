@@ -92,6 +92,30 @@ define_flag("jaxpr_audit_max_cache_keys", 32,
             "CompiledFunction.audit() / BucketedFunction.audit() flag "
             "threshold: more distinct compile-cache keys (or bucket-ladder "
             "rungs) than this raises a JX310/JX313 unbounded-retrace finding")
+define_flag("jaxpr_audit_runtime", False,
+            "debug: run audit() + cost() on every CompiledFunction program "
+            "at BUILD time (cache misses only — the hot replay path is "
+            "untouched), logging JX3xx findings and the cost summary "
+            "through base.log instead of waiting for an on-demand call")
+define_flag("cost_max_intermediate_bytes", 2 << 30,
+            "cost-model lint (CM501): one equation materializing a result "
+            "larger than this is flagged as an oversized intermediate")
+define_flag("cost_hbm_budget_bytes", 16 << 30,
+            "cost-model lint (CM504): per-device HBM budget the liveness "
+            "peak-residency estimate is checked against (under the active "
+            "Plan's model-sharding degrees)")
+define_flag("cost_min_arith_intensity", 0.25,
+            "cost-model lint (CM502): matmul-free programs moving real "
+            "bytes below this flops/byte ratio are flagged memory-bound")
+define_flag("cost_intensity_min_bytes", 32 << 20,
+            "cost-model lint (CM502): programs moving fewer bytes than "
+            "this are never intensity-flagged (too small to matter)")
+define_flag("cost_mesh_bandwidth_gbps", 100.0,
+            "cost-model lint (CM503): declared per-link mesh bandwidth the "
+            "static collective volume is priced against")
+define_flag("cost_device_tflops", 197.0,
+            "cost-model lint (CM503): nominal device peak used to price "
+            "compute time against collective time")
 define_flag("cudnn_deterministic", False, "accepted for compat; XLA is deterministic by default")
 
 

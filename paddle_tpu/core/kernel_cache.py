@@ -50,8 +50,8 @@ import jax
 
 from ..base.flags import get_flag
 
-__all__ = ["CachedVJP", "clear", "execute", "lookup", "poison",
-           "record_bypass", "stats"]
+__all__ = ["CachedVJP", "clear", "cost_stats", "execute", "lookup",
+           "poison", "record_bypass", "stats"]
 
 
 class _Unhashable(Exception):
@@ -205,6 +205,46 @@ def stats() -> dict:
               for k in ("hits", "misses", "bypasses", "evictions")}
     return {"ops": ops, "totals": totals, "size": len(_cache),
             "capacity": int(get_flag("eager_kernel_cache_max_entries"))}
+
+
+def cost_stats(max_entries: Optional[int] = None) -> dict:
+    """Per-entry static cost of every cached executable: retrace each
+    entry's staged function from the (shape, dtype) specs its cache key
+    already records and run the analysis cost model over the jaxpr
+    (``analysis/cost_model.py`` — tracing only, no XLA compilation, no
+    counters touched). On-demand companion to :func:`stats`, which stays
+    a pure counter read; ``max_entries`` bounds the walk to the N most
+    recently used entries."""
+    import jax
+
+    from ..analysis.cost_model import cost_jaxpr
+
+    items = list(_cache.items())
+    if max_entries is not None and max_entries > 0:
+        items = items[-max_entries:]  # OrderedDict: tail = most recent
+    entries = []
+    totals = {"flops": 0.0, "bytes_read": 0.0, "bytes_written": 0.0,
+              "peak_bytes": 0}
+    for key, entry in items:
+        sds = [jax.ShapeDtypeStruct(tuple(part[0]), part[1])
+               for part in key[2] if part[0] != "__static__"]
+        row = {"op": entry.op, "has_vjp": entry.has_vjp}
+        try:
+            closed = jax.make_jaxpr(entry.fwd)(*sds)
+            rep = cost_jaxpr(closed, location=f"kernel_cache:{entry.op}")
+        except Exception as e:
+            row["error"] = str(e).splitlines()[0]
+            entries.append(row)
+            continue
+        row.update(flops=rep.flops, bytes_read=rep.bytes_read,
+                   bytes_written=rep.bytes_written, peak_bytes=rep.peak_bytes,
+                   arithmetic_intensity=round(rep.arithmetic_intensity, 4))
+        totals["flops"] += rep.flops
+        totals["bytes_read"] += rep.bytes_read
+        totals["bytes_written"] += rep.bytes_written
+        totals["peak_bytes"] = max(totals["peak_bytes"], rep.peak_bytes)
+        entries.append(row)
+    return {"entries": entries, "totals": totals, "n_entries": len(entries)}
 
 
 def clear(reset_stats: bool = True) -> None:

@@ -19,6 +19,18 @@ shape*: how to factor N devices into dp×mp×pp×sep. The chooser:
 The memory model follows the standard transformer accounting (params,
 grads, Adam moments, activations with remat) — the same quantities the
 reference's cost model estimates from the dist program.
+
+Two estimate tiers feed the pruning/scoring:
+
+- **closed-form** — the analytic transformer accounting below, available
+  before anything is traced;
+- **jaxpr-backed** — when a traced ``TrainStep`` is available, its static
+  ``CostReport`` (``analysis/cost_model.py``: liveness peak residency +
+  exact program FLOPs) is *preferred* over the closed-form spec: pass
+  ``cost_report=`` to :func:`estimate_per_device_bytes` /
+  :func:`estimate_step_cost`, or let :func:`compare_with_measured` report
+  all three tiers (closed-form / cost-model / XLA ``memory_analysis``)
+  side by side.
 """
 from __future__ import annotations
 
@@ -136,16 +148,95 @@ def calibrate_against_compiled(step, spec: ModelSpec, batch_size: int,
     }
 
 
+def compare_with_measured(step, spec: ModelSpec, batch_size: int,
+                          degrees: dict, param_bytes: int = 4,
+                          master_weights: bool = False) -> dict:
+    """All three memory-estimate tiers for one traced+run ``TrainStep``,
+    side by side:
+
+    - ``closed_form``: the analytic transformer accounting
+      (:func:`estimate_per_device_bytes` from the ``ModelSpec``);
+    - ``cost_model``: the static jaxpr walker's liveness peak
+      (``step.cost()`` — no compilation);
+    - ``xla``: the compiled program's ``memory_analysis`` ground truth
+      (argument + temp), ``None`` when the step has not run compiled.
+
+    Ratios are cost_model/xla and closed_form/xla (when xla is present) —
+    the calibration numbers the AutoTuner history and the bench's
+    ``extras.cost_model`` record."""
+    dp = degrees.get("dp_degree", 1)
+    mp = degrees.get("mp_degree", 1)
+    pp = degrees.get("pp_degree", 1)
+    sep = degrees.get("sep_degree", 1)
+    sharding = degrees.get("zero_sharding", degrees.get("sharding_degree", 1))
+
+    closed_form = int(estimate_per_device_bytes(
+        spec, batch_size, dp, mp, pp, sep, param_bytes=param_bytes,
+        master_weights=master_weights, sharding=sharding))
+    report = step.cost()
+    cost_model = estimate_per_device_bytes_from_report(
+        report, dp=dp, mp=mp, pp=pp, sep=sep, sharding=sharding)
+
+    out = {
+        "closed_form": {"peak_bytes": closed_form},
+        "cost_model": {
+            "peak_bytes": cost_model,
+            "program_peak_bytes": int(report.peak_bytes),
+            "arg_bytes": int(report.arg_bytes),
+            "flops": float(report.flops),
+            "analysis_seconds": round(report.analysis_seconds, 4),
+        },
+        "xla": None,
+    }
+    ma = step._compiled.memory_analysis()
+    if ma is not None:
+        measured = int(ma.argument_size_in_bytes + ma.temp_size_in_bytes)
+        out["xla"] = {
+            "peak_bytes": measured,
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+        }
+        out["cost_model_vs_xla"] = report.peak_bytes / max(measured, 1)
+        out["closed_form_vs_xla"] = closed_form / max(measured, 1)
+    return out
+
+
+def estimate_per_device_bytes_from_report(report, dp: int = 1, mp: int = 1,
+                                          pp: int = 1, sep: int = 1,
+                                          sharding: int = 1) -> int:
+    """Jaxpr-backed per-device HBM estimate from a traced step's
+    ``CostReport``: the program's argument bytes (params + optimizer
+    state + batch — the resident state XLA reports as argument size)
+    shard over mp·pp, the transient remainder of the liveness peak
+    (activations/grads) over dp·mp·sep. The ZeRO ``sharding`` degree is
+    ignored here — the traced single-replica program cannot separate the
+    optimizer-moment share of its arguments (documented tolerance vs the
+    closed-form spec: within ~4x on transformer steps, see
+    tests/test_cost_model.py)."""
+    state = int(report.arg_bytes)
+    transient = max(int(report.peak_bytes) - state, 0)
+    del sharding  # see docstring
+    return int(state / max(mp * pp, 1) + transient / max(dp * mp * sep, 1))
+
+
 def estimate_per_device_bytes(spec: ModelSpec, batch_size: int, dp: int,
                               mp: int, pp: int, sep: int = 1,
                               param_bytes: int = 2, master_weights: bool = True,
-                              remat: bool = True, sharding: int = 1) -> int:
+                              remat: bool = True, sharding: int = 1,
+                              cost_report=None) -> int:
     """Per-device HBM estimate: params + grads + Adam moments (+fp32
     master) sharded over mp·pp — with the optimizer-state component further
     divided by the ZeRO ``sharding`` degree (stage 1/2 shard moments and
     master weights over dp) — plus activations sharded over dp·mp·sep.
     Activation term uses the remat'd transformer footprint
-    (~2·s·h bytes/layer/sample boundaries instead of ~34·s·h full)."""
+    (~2·s·h bytes/layer/sample boundaries instead of ~34·s·h full).
+
+    When ``cost_report`` (a traced step's ``analysis.cost_model``
+    CostReport) is given, the measured-from-jaxpr path is preferred over
+    this closed-form accounting."""
+    if cost_report is not None:
+        return estimate_per_device_bytes_from_report(
+            cost_report, dp=dp, mp=mp, pp=pp, sep=sep, sharding=sharding)
     model_shard = spec.num_params / (mp * pp)
     # bf16 param + bf16-ish grad replicated over dp; 2 fp32 moments
     # (+ fp32 master) ZeRO-sharded
@@ -228,13 +319,17 @@ def choose_plan(spec: ModelSpec, n_devices: int, batch_size: int,
 
 def estimate_step_cost(spec: ModelSpec, batch_size: int, plan: Plan,
                        device_tflops: float = 197.0,
-                       ici_gbps: float = 100.0) -> dict:
+                       ici_gbps: float = 100.0,
+                       cost_report=None) -> dict:
     """Relative step-time model over a candidate plan (the reference
     Engine's cost-model pass, auto_parallel/static/cost/: compute + comm +
     bubble). Absolute numbers are nominal (bf16 peak, ICI link bw); only
     the RANKING between candidates matters.
 
-    - compute: 6·tokens·params FLOPs split over all devices;
+    - compute: 6·tokens·params FLOPs split over all devices — unless
+      ``cost_report`` (a traced step's CostReport, whose FLOPs already
+      include forward + backward + optimizer at the traced batch) is
+      given, in which case the measured-from-jaxpr FLOPs are preferred;
     - dp comm: one gradient all-reduce per step, 2·(dp-1)/dp ring factor;
     - mp comm: two activation all-reduces per layer (Megatron row+column),
       on the critical path;
@@ -242,7 +337,10 @@ def estimate_step_cost(spec: ModelSpec, batch_size: int, plan: Plan,
     """
     n = plan.dp * plan.mp * plan.pp * plan.sep
     tokens = batch_size * spec.seq_len
-    flops = 6.0 * tokens * spec.num_params
+    if cost_report is not None and cost_report.flops > 0:
+        flops = float(cost_report.flops)
+    else:
+        flops = 6.0 * tokens * spec.num_params
     compute_s = flops / (n * device_tflops * 1e12)
     grad_bytes = 2.0 * spec.num_params / (plan.mp * plan.pp)
     dp_comm_s = (2.0 * (plan.dp - 1) / max(plan.dp, 1)

@@ -3,12 +3,20 @@ python/paddle/hapi/dynamic_flops.py): forward-post hooks record each leaf
 layer's multiply-accumulate count from its real input/output shapes, summed
 over one dry forward. On TPU the number doubles as the MFU denominator —
 bench.py's analytic formulas are the model-specific fast path; this is the
-generic layer-walk."""
+generic layer-walk.
+
+The per-op formulas themselves live in ``analysis/cost_model.py``
+(``linear_flops``/``conv_flops``/...): the static jaxpr walker and this
+layer-hook front end share one accounting, so the two tiers cannot
+drift. The hook API (``custom_ops`` mapping layer classes to
+``fn(layer, x, y) -> flops``) is unchanged."""
 from __future__ import annotations
 
 from typing import Optional
 
 import numpy as np
+
+from ..analysis import cost_model as _cm
 
 
 def _numel(shape):
@@ -19,34 +27,30 @@ def _numel(shape):
 
 
 def _count_conv(layer, x, y):
-    k = _numel(layer.weight.shape[2:])
-    cin = int(layer.weight.shape[1])  # per-group in-channels
-    out_elems = _numel(y.shape)
-    flops = out_elems * cin * k
-    if getattr(layer, "bias", None) is not None:
-        flops += out_elems
-    return flops
+    return _cm.conv_flops(
+        _numel(y.shape),
+        int(layer.weight.shape[1]),      # per-group in-channels
+        _numel(layer.weight.shape[2:]),  # kernel taps
+        getattr(layer, "bias", None) is not None)
 
 
 def _count_linear(layer, x, y):
-    flops = _numel(y.shape) * int(layer.weight.shape[0])
-    if getattr(layer, "bias", None) is not None:
-        flops += _numel(y.shape)
-    return flops
+    return _cm.linear_flops(_numel(y.shape), int(layer.weight.shape[0]),
+                            getattr(layer, "bias", None) is not None)
 
 
 def _count_norm(layer, x, y):
-    return 2 * _numel(x.shape)
+    return _cm.norm_flops(_numel(x.shape))
 
 
 def _count_act(layer, x, y):
-    return _numel(y.shape)
+    return _cm.activation_flops(_numel(y.shape))
 
 
 def _count_pool(layer, x, y):
     ks = getattr(layer, "kernel_size", 2)
     k = _numel(ks) if isinstance(ks, (list, tuple)) else int(ks) ** 2
-    return _numel(y.shape) * k
+    return _cm.pool_flops(_numel(y.shape), k)
 
 
 _COUNTERS = {

@@ -125,3 +125,11 @@ class TrainStep:
         """Per-cache-key compile counts for the whole-step program cache
         (no compilation, no tracing — counter reads only)."""
         return self._compiled.audit_report()
+
+    def cost(self):
+        """Static ``CostReport`` of the whole-step program: FLOPs, bytes,
+        collective volume per mesh axis, and the liveness peak-residency
+        estimate the planner cross-checks against XLA ``memory_analysis``
+        (see analysis/cost_model.py). On-demand only — never runs on the
+        step's hot path."""
+        return self._compiled.cost()

@@ -101,6 +101,13 @@ class BucketedFunction:
         report["buckets"] = list(self.buckets)
         return report
 
+    def cost(self):
+        """Static ``CostReport`` over the engaged bucket rungs (one cache
+        entry per rung; ``.per_entry`` breaks them out)."""
+        from ..analysis.cost_model import cost_bucketed_function
+
+        return cost_bucketed_function(self)
+
     def __call__(self, *args, **kwargs):
         lengths = []
         for idx, axis in self.bucket_axes.items():

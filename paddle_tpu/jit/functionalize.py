@@ -262,7 +262,40 @@ class CompiledFunction:
         entry["abstract_call"] = _abstract_call(args, kwargs)
         self._cache[key] = entry
         self._compile_counts[key] = self._compile_counts.get(key, 0) + 1
+        self._maybe_runtime_audit(entry)
         return entry
+
+    def _maybe_runtime_audit(self, entry):
+        """FLAGS_jaxpr_audit_runtime: audit + cost each program at BUILD
+        time (cache misses only — steady-state replay never pays this),
+        logging through base.log so arbitrary user CompiledFunctions get
+        the analysis tier without on-demand calls. Only the just-built
+        entry is retraced (plus the cheap cache-shape heuristics) — a
+        ladder of N builds pays N retrace audits, not N²."""
+        from ..base.flags import get_flag
+
+        try:
+            if not get_flag("jaxpr_audit_runtime"):
+                return
+        except Exception:
+            return
+        log = get_logger()
+        try:
+            from ..analysis.cost_model import cost_jaxpr
+            from ..analysis.jaxpr_audit import (audit_compiled_function,
+                                                retrace_entry)
+
+            for f in audit_compiled_function(self, only_entry=entry):
+                log.warning("jaxpr_audit[%s]: %s", self.name, f)
+            closed, _n_user, _n_cells = retrace_entry(entry)
+            rep = cost_jaxpr(closed, location=self.name)
+            log.info(
+                "cost[%s]: flops=%.3e bytes=%.3e peak=%.1f MiB "
+                "intensity=%.3f",
+                self.name, rep.flops, rep.bytes_read + rep.bytes_written,
+                rep.peak_bytes / 2**20, rep.arithmetic_intensity)
+        except Exception as e:  # a debug aid must never sink the build
+            log.warning("jaxpr_audit_runtime failed for %s: %s", self.name, e)
 
     def _make_entry(self, ctx, guards):
         ctx.prune_tracer_cells()
@@ -309,6 +342,7 @@ class CompiledFunction:
             family["entries"][outcomes] = entry
             key = family.get("key")
             self._compile_counts[key] = self._compile_counts.get(key, 0) + 1
+            self._maybe_runtime_audit(entry)  # guard-miss builds too
         family["last"] = outcomes
         return outcomes
 
@@ -458,6 +492,17 @@ class CompiledFunction:
         from ..analysis.jaxpr_audit import audit_compiled_function
 
         return audit_compiled_function(self, max_cache_keys=max_cache_keys)
+
+    def cost(self):
+        """Static cost model of every cached program (FLOPs / bytes /
+        collective volume / liveness peak residency): a
+        ``analysis.cost_model.CostReport`` for the costliest entry, with
+        the per-entry breakdown under ``.per_entry``. Same retrace
+        machinery as ``audit()`` — tracing only, never compiles, never
+        touches the hot ``__call__`` path."""
+        from ..analysis.cost_model import cost_compiled_function
+
+        return cost_compiled_function(self)
 
 
 def functionalize(fn=None, *, static_key_fn=None, donate_cells=True, name=None):

@@ -1,12 +1,12 @@
 """CI gate: the repo itself passes its own static analysis.
 
-Runs all five ``paddle_tpu.analysis`` analyzers over the live codebase
+Runs all six ``paddle_tpu.analysis`` analyzers over the live codebase
 and asserts ZERO error-severity findings, so a regression (a new
 jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug,
-a host callback in a compiled step, a typo'd mesh axis) fails tier-1
-instead of rotting until pod scale. The ``python -m tools.lint`` CLI
-contract (exit 0, machine-readable JSON, ``--include-tests``) is gated
-here too.
+a host callback in a compiled step, a typo'd mesh axis, a cost-model
+budget blowout) fails tier-1 instead of rotting until pod scale. The
+``python -m tools.lint`` CLI contract (exit 0, machine-readable JSON
+with per-family wall-time, ``--include-tests``) is gated here too.
 """
 import json
 import os
@@ -77,6 +77,22 @@ def test_spmd_checker_clean_over_source_and_tests():
     assert _errors(findings) == []
 
 
+def test_cost_model_clean_on_demo_step():
+    """The representative whole-step program costs clean: no oversized
+    intermediates, no intensity cliff, no comm-bound axis, peak within
+    the HBM budget — and the report carries real numbers (a zeroed-out
+    walker would pass the finding gate while measuring nothing)."""
+    from paddle_tpu.analysis.cost_model import check_cost
+    from paddle_tpu.analysis.jaxpr_audit import record_demo_step
+
+    step = record_demo_step()
+    report = step.cost()
+    assert report.flops > 0 and report.peak_bytes > 0, report.to_dict()
+    assert report.retrace_errors == []
+    findings = check_cost(report)
+    assert [str(f) for f in findings] == []
+
+
 def test_cli_exits_zero_with_machine_readable_findings(capsys):
     """`tools.lint --json --include-tests` over the repo: exit 0,
     parseable. Run in-process (the tests above already paid the analyzer
@@ -91,5 +107,9 @@ def test_cli_exits_zero_with_machine_readable_findings(capsys):
     assert payload["errors"] == 0
     assert payload["crashed"] == []
     assert set(payload["analyzers"]) == {"trace", "registry", "program",
-                                         "jaxpr", "spmd"}
+                                         "jaxpr", "spmd", "cost"}
     assert isinstance(payload["findings"], list)
+    # per-family wall-time (CI satellite): one entry per analyzer run
+    assert set(payload["timings_s"]) == set(payload["analyzers"])
+    assert all(isinstance(v, (int, float)) and v >= 0
+               for v in payload["timings_s"].values())

@@ -1,6 +1,6 @@
 """``python -m tools.lint`` — the repo's static-analysis driver.
 
-Runs the five ``paddle_tpu.analysis`` analyzers and reports findings:
+Runs the six ``paddle_tpu.analysis`` analyzers and reports findings:
 
 - **trace**:    the trace-safety AST linter over ``paddle_tpu/`` (or the
                 paths given on the command line),
@@ -15,14 +15,19 @@ Runs the five ``paddle_tpu.analysis`` analyzers and reports findings:
                 every cached program's ClosedJaxpr + the recompilation
                 heuristics) plus the eager kernel-cache counters (JX32x),
 - **spmd**:     the static mesh-axis checker over the same paths as the
-                trace linter.
+                trace linter (one-hop cross-file mesh resolution),
+- **cost**:     the static jaxpr cost model (CM5xx) over the same
+                representative train step: oversized intermediates,
+                arithmetic-intensity cliffs, comm-bound collectives and
+                peak residency vs the FLAGS budgets.
 
 Exit-code contract (stable, CI-gateable):
   0 = no error-severity findings (warnings never gate)
   1 = at least one error-severity finding
   2 = an analyzer crashed (the crash is reported as a finding too)
 
-``--json`` prints one machine-readable object with every finding.
+``--json`` prints one machine-readable object with every finding plus
+per-family wall-time under ``timings_s``.
 ``--select``/``--ignore`` filter findings by code prefix (e.g.
 ``--select JX,SP4`` or ``--ignore PV008``) so CI can gate on specific
 families. ``--include-tests`` adds the ``tests/`` tree to the
@@ -36,7 +41,7 @@ import os
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd")
+_ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd", "cost")
 
 
 def _source_paths(paths, include_tests=False):
@@ -94,16 +99,30 @@ def _run_program(_paths, include_tests=False):
     return findings
 
 
+# the representative TrainStep is built once per process and shared by the
+# jaxpr and cost families (audit/cost are read-only on it): two model
+# builds + compiles for the same demo program would double the dominant
+# wall-time of a full lint run
+_demo_step_memo: list = []
+
+
+def _demo_step():
+    if not _demo_step_memo:
+        from paddle_tpu.analysis.jaxpr_audit import record_demo_step
+
+        _demo_step_memo.append(record_demo_step())
+    return _demo_step_memo[0]
+
+
 def _run_jaxpr(_paths, include_tests=False):
     """Compile the shared representative whole-step TrainStep and audit
     every cached program (trace-level verification + recompilation audit
     + guard-family coverage, see analysis/jaxpr_audit.py), then the eager
     kernel-cache counters (JX32x over core.kernel_cache.stats())."""
     import paddle_tpu as paddle
-    from paddle_tpu.analysis.jaxpr_audit import (audit_kernel_cache,
-                                                 record_demo_step)
+    from paddle_tpu.analysis.jaxpr_audit import audit_kernel_cache
 
-    step = record_demo_step()
+    step = _demo_step()
     findings = step.audit()
     # a guarded program too, so the branch-coverage checks run per commit
     from paddle_tpu.jit.functionalize import functionalize
@@ -128,25 +147,40 @@ def _run_jaxpr(_paths, include_tests=False):
     return findings
 
 
+def _run_cost(_paths, include_tests=False):
+    """Static cost model over the shared representative whole-step
+    TrainStep (same step the jaxpr family audits — retrace →
+    FLOPs/bytes/liveness walk, see analysis/cost_model.py): CM5xx
+    findings vs the FLAGS budgets."""
+    from paddle_tpu.analysis.cost_model import check_cost
+
+    return check_cost(_demo_step().cost())
+
+
 _RUNNERS = {"trace": _run_trace, "registry": _run_registry,
             "program": _run_program, "jaxpr": _run_jaxpr,
-            "spmd": _run_spmd}
+            "spmd": _run_spmd, "cost": _run_cost}
 
 # analyzer -> its finding-code family prefix, so a crash finding
 # (<PREFIX>999) stays visible under --select filters for that family
 _FAMILY_PREFIX = {"trace": "TS", "registry": "RC", "program": "PV",
-                  "jaxpr": "JX", "spmd": "SP"}
+                  "jaxpr": "JX", "spmd": "SP", "cost": "CM"}
 
 
 def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
-    """Run the named analyzers; returns ``(findings, crashed)`` where
-    ``crashed`` lists analyzers that raised (each crash is also appended
-    to the findings as an <NAME>999 error)."""
+    """Run the named analyzers; returns ``(findings, crashed, timings)``
+    where ``crashed`` lists analyzers that raised (each crash is also
+    appended to the findings as an <NAME>999 error) and ``timings`` maps
+    each analyzer family to its wall-time in seconds."""
+    import time
+
     from paddle_tpu.analysis import Finding
 
     findings = []
     crashed = []
+    timings = {}
     for name in selected:
+        t0 = time.perf_counter()
         try:
             findings.extend(_RUNNERS[name](paths, include_tests=include_tests))
         except Exception as e:
@@ -156,7 +190,8 @@ def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
                 "error",
                 f"analyzer '{name}' crashed: {type(e).__name__}: "
                 f"{str(e).splitlines()[0] if str(e) else ''}", "analyzer"))
-    return findings, crashed
+        timings[name] = round(time.perf_counter() - t0, 3)
+    return findings, crashed, timings
 
 
 def _split_codes(values):
@@ -204,8 +239,8 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     selected = tuple(dict.fromkeys(args.analyzer)) if args.analyzer else _ANALYZERS
-    findings, crashed = run_analyzers(selected, args.paths,
-                                      include_tests=args.include_tests)
+    findings, crashed, timings = run_analyzers(selected, args.paths,
+                                               include_tests=args.include_tests)
     findings = filter_findings(findings, _split_codes(args.select),
                                _split_codes(args.ignore))
 
@@ -219,13 +254,15 @@ def main(argv=None) -> int:
             "crashed": crashed,
             "errors": n_errors,
             "warnings": n_warnings,
+            "timings_s": timings,
             "findings": [f.to_dict() for f in findings],
         }, indent=2))
     else:
         for f in findings:
             print(f)
+        timing_txt = ", ".join(f"{k} {v:.2f}s" for k, v in timings.items())
         print(f"tools.lint: {n_errors} error(s), {n_warnings} warning(s) "
-              f"[{', '.join(selected)}]"
+              f"[{timing_txt}]"
               + (f" CRASHED: {', '.join(crashed)}" if crashed else ""))
     if crashed:
         return 2
