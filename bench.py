@@ -122,6 +122,10 @@ def bench_gpt(on_tpu):
         extras["cost_model"] = _cost_model_bench(step)
     except Exception as e:
         extras["cost_model"] = {"error": str(e).split("\n")[0][:200]}
+    try:
+        extras["pipeline"] = _pipeline_bench(step, cfg, batch, seq)
+    except Exception as e:
+        extras["pipeline"] = {"error": str(e).split("\n")[0][:200]}
     return f"{name}_train_tokens_per_sec", tok_s, "tokens/sec", extras
 
 
@@ -244,6 +248,94 @@ def _cost_model_bench(step):
         out["measured_peak_bytes"] = measured
         out["peak_ratio"] = round(report.peak_bytes / max(measured, 1), 3)
     return out
+
+
+def _pipeline_bench(step, cfg, batch, seq, n_batches=16):
+    """Async train-loop pipeline proof (ISSUE 5 tentpole) on the live bench
+    TrainStep: the same compiled program driven twice over the same 16
+    loader batches from the same snapshot of model/optimizer state —
+
+    - **sync loop**: plain DataLoader, the classic ``float(loss.numpy())``
+      per step (one blocking D2H each iteration);
+    - **async loop**: ``DataLoader(device_prefetch=2)`` (H2D staged by a
+      background thread while the step computes) + ``MetricBuffer``
+      (losses stay device arrays; one batched readback at the end).
+
+    Reports the per-step breakdown from ``profiler.pipeline_stats``
+    (h2d_wait/h2d_issue/dispatch/host_sync + overlap ratio), proves the
+    async steady state issues ZERO host syncs per step, and checks the
+    two loops' loss streams are bit-identical."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.hapi.metric_buffer import MetricBuffer
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.profiler.pipeline import pipeline_stats, timed
+
+    entry = step._compiled.last_entry
+    cells = entry["cells"]
+    snap = [jnp.array(c._value) for c in cells]  # copies survive donation
+    lr_host = step._lr_host
+
+    rs = np.random.RandomState(1)
+    samples = [rs.randint(0, cfg.vocab_size, (seq,)).astype(np.int64)
+               for _ in range(n_batches * batch)]
+
+    def restore():
+        for c, v in zip(cells, snap):
+            c._value = jnp.array(v)
+        step._lr_host = lr_host
+
+    def run_sync():
+        losses = []
+        t0 = time.perf_counter()
+        for ids in DataLoader(samples, batch_size=batch, drop_last=True):
+            loss = step(ids)
+            losses.append(float(np.asarray(loss.numpy())))  # noqa: TS107 (the sync baseline under measurement)
+        return losses, time.perf_counter() - t0
+
+    def run_async():
+        pipeline_stats.reset()
+        buf = MetricBuffer()
+        t0 = time.perf_counter()
+        for ids in DataLoader(samples, batch_size=batch, drop_last=True,
+                              device_prefetch=2):
+            with timed(pipeline_stats.add_dispatch):
+                loss = step(ids)
+            buf.append("loss", loss)
+            pipeline_stats.step()
+        loop_s = time.perf_counter() - t0
+        steady = pipeline_stats.summary()  # BEFORE the flush: steady state
+        losses = buf.flush()["loss"]["values"]
+        return losses, loop_s, steady
+
+    # two interleaved rounds each, best-of: on a loaded 2-core CPU host the
+    # run-to-run swing dwarfs the pipeline effect (the prefetch thread also
+    # contends with XLA compute for cores there — on TPU the device does
+    # the compute and the overlap is pure win); the breakdown and the
+    # zero-sync proof are the portable part of this report
+    sync_s = async_s = float("inf")
+    sync_losses = async_losses = steady = None
+    for _ in range(2):
+        restore()
+        losses, dt = run_sync()
+        if dt < sync_s:
+            sync_losses, sync_s = losses, dt
+        restore()
+        losses, dt, st = run_async()
+        if dt < async_s:
+            async_losses, async_s, steady = losses, dt, st
+    restore()
+    tokens = batch * seq * n_batches
+    return {
+        **steady,
+        "sync_tokens_per_sec": round(tokens / sync_s, 1),
+        "async_tokens_per_sec": round(tokens / async_s, 1),
+        "speedup_x": round(sync_s / async_s, 3),
+        "losses_bit_identical": bool(
+            np.array_equal(np.asarray(sync_losses), np.asarray(async_losses))),
+    }
 
 
 def _pure_jax_gpt_control(cfg, batch, seq, steps):

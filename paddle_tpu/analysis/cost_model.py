@@ -319,11 +319,109 @@ def _scan_length(eqn) -> int:
     return int(length) if isinstance(length, int) and length > 0 else 1
 
 
+_CMP_PRIMS = ("lt", "le", "gt", "ge")
+_FLIP_CMP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+
+
+def _while_trip_count(eqn) -> int:
+    """Static trip count of a ``while`` equation for the counter pattern
+    (``cond: counter <op> bound``, ``body: counter += step``, all three of
+    init/bound/step literals) — the shape every pipelined loop lowered
+    from ``lax.while_loop`` with static bounds takes. Anything else falls
+    back to ``FLAGS_cost_while_default_trips`` (default 1: the historical
+    single-iteration lower bound — trip counts are data)."""
+    import math
+
+    import jax
+
+    from ..base.flags import get_flag
+
+    try:
+        fallback = max(int(get_flag("cost_while_default_trips")), 1)
+    except Exception:
+        fallback = 1
+    try:
+        cond = eqn.params["cond_jaxpr"].jaxpr
+        body = eqn.params["body_jaxpr"].jaxpr
+        cn = int(eqn.params.get("cond_nconsts", 0))
+        bn = int(eqn.params.get("body_nconsts", 0))
+    except (KeyError, AttributeError, TypeError):
+        return fallback
+    Literal = jax.core.Literal
+    carry_outer = list(eqn.invars)[cn + bn:]
+    cond_const_outer = list(eqn.invars)[:cn]
+    cond_const_vars = list(cond.invars)[:cn]
+    carry_cond_vars = list(cond.invars)[cn:]
+
+    # the predicate equation producing the cond output
+    pred_var = cond.outvars[0]
+    pred = next((e for e in cond.eqns if pred_var in e.outvars), None)
+    if pred is None or pred.primitive.name not in _CMP_PRIMS or \
+            len(pred.invars) != 2:
+        return fallback
+
+    def concrete(v):
+        """Literal value of ``v`` inside the cond scope, through one hop
+        of cond-consts to the outer invars."""
+        if isinstance(v, Literal):
+            return v.val
+        for cv, ov in zip(cond_const_vars, cond_const_outer):
+            if v is cv and isinstance(ov, Literal):
+                return ov.val
+        return None
+
+    lhs, rhs = pred.invars
+    op = pred.primitive.name
+    idx = next((i for i, cv in enumerate(carry_cond_vars)
+                if lhs is cv or rhs is cv), None)
+    if idx is None:
+        return fallback
+    counter_is_lhs = lhs is carry_cond_vars[idx]
+    bound = concrete(rhs if counter_is_lhs else lhs)
+    init_v = carry_outer[idx] if idx < len(carry_outer) else None
+    init = init_v.val if isinstance(init_v, Literal) else None
+
+    # the body's increment of that carry position
+    carry_body_vars = list(body.invars)[bn:]
+    if idx >= len(carry_body_vars) or idx >= len(body.outvars):
+        return fallback
+    out_v = body.outvars[idx]
+    step = None
+    for e in body.eqns:
+        if out_v in e.outvars and len(e.invars) == 2 \
+                and e.primitive.name in ("add", "add_any", "sub"):
+            x, y = e.invars
+            if x is carry_body_vars[idx] and isinstance(y, Literal):
+                step = -y.val if e.primitive.name == "sub" else y.val
+            elif y is carry_body_vars[idx] and isinstance(x, Literal) \
+                    and e.primitive.name != "sub":
+                step = x.val
+            break
+    if bound is None or init is None or step is None:
+        return fallback
+    try:
+        bound, init, step = float(bound), float(init), float(step)
+    except (TypeError, ValueError):
+        return fallback
+    if not counter_is_lhs:  # normalize to `counter <op> bound`
+        op = _FLIP_CMP[op]
+    if op in ("gt", "ge"):  # count-down loop -> mirrored count-up
+        init, bound, step = -init, -bound, -step
+        op = "lt" if op == "gt" else "le"
+    if step <= 0:
+        return fallback
+    span = bound - init + (1.0 if op == "le" else 0.0)
+    # a successful derivation is authoritative, including 0 (a loop whose
+    # guard statically never passes costs nothing)
+    return max(int(math.ceil(span / step)), 0)
+
+
 def _walk_jaxpr(jaxpr) -> CostReport:
     """Cost one (open) Jaxpr: totals + liveness peak. Recurses into
     pjit/scan/while/cond bodies; scan multiplies by trip count, cond takes
-    the max across branches, while counts one iteration (static lower
-    bound — trip counts are data)."""
+    the max across branches, while multiplies by the statically derived
+    counter trip count when the loop has one (else the
+    FLAGS_cost_while_default_trips lower bound)."""
     import jax
 
     rep = CostReport(n_eqns=len(jaxpr.eqns))
@@ -369,7 +467,12 @@ def _walk_jaxpr(jaxpr) -> CostReport:
         if subs:
             flops = mm = 0.0
             sub_reports = [_walk_jaxpr(s) for s in subs]
-            mult = _scan_length(eqn) if pname == "scan" else 1
+            if pname == "scan":
+                mult = _scan_length(eqn)
+            elif pname == "while":
+                mult = _while_trip_count(eqn)
+            else:
+                mult = 1
             if pname == "cond":
                 best = max(sub_reports, key=lambda r: r.flops)
                 agg = [best]

@@ -25,6 +25,19 @@ TS105  global mutation      `global` declaration inside a traced region
 TS106  mutable static arg   list/dict/set default on a traced function
                             (non-hashable static args defeat the compile
                             cache key)
+TS107  per-step host sync   .numpy()/.item()/.tolist()/.block_until_ready()
+                            or float(<name/attr/subscript>) inside a
+                            train-step loop — a loop calling a
+                            step/train_step/train_batch callable WITH
+                            arguments (`opt.step()`/`profiler.step()` do
+                            not qualify) — or inside a ``train_batch``
+                            method body (unconditionally: that IS the
+                            per-step path). One blocking readback per step
+                            serializes H2D, dispatch and D2H — keep losses
+                            device-resident in a MetricBuffer and sync at
+                            log/epoch boundaries (ISSUE 5). Unlike
+                            TS101-106 this rule scans HOST loop code, not
+                            traced regions.
 
 Suppression: a ``# noqa: TS1xx`` comment on the flagged line (bare
 ``# noqa`` suppresses every rule on that line). Findings carry
@@ -314,6 +327,107 @@ def _collect_kernels(tree):
     return kernels
 
 
+# ---------------------------------------------------------------------------
+# TS107: per-step host syncs in train-step loops (host-side rule)
+# ---------------------------------------------------------------------------
+
+# callables whose invocation marks a loop as a *train-step loop*: the
+# TrainStep convention (`step(...)` / `self._train_step(...)`), explicit
+# train_step functions, and hapi's train_batch
+_STEP_CALL_NAMES = {"step", "train_step", "_train_step", "train_batch"}
+# zero-arg methods that force a blocking device→host readback
+_SYNC_CALL_ATTRS = {"numpy", "item", "tolist", "block_until_ready"}
+# builtins that materialize a scalar from a device value
+_SYNC_BUILTINS = {"float"}
+
+
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    return getattr(f, "attr", "")
+
+
+def _is_step_call(call: ast.Call) -> bool:
+    """A call that drives one training step, with at least one argument
+    (positional or keyword). The generic name ``step`` counts only as a
+    bare-name call — the TrainStep convention ``step(batch)`` — so
+    ``optimizer.step()`` / ``scheduler.step(metric)`` never mark a loop;
+    the unambiguous method names (``train_step``/``_train_step``/
+    ``train_batch``) count in either form."""
+    name = _call_name(call)
+    if name not in _STEP_CALL_NAMES or not (call.args or call.keywords):
+        return False
+    if name == "step" and not isinstance(call.func, ast.Name):
+        return False
+    return True
+
+
+def _body_nodes(body, include_loops):
+    """Every AST node in ``body`` without descending into nested scopes;
+    ``include_loops=False`` additionally stops at nested loops."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _SCOPE_NODES):
+            continue
+        if not include_loops and isinstance(node, (ast.For, ast.AsyncFor,
+                                                   ast.While)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _flag_step_syncs(body, findings, filename, region, force=False):
+    """Flag host syncs in a step region. The step-call gate looks only at
+    the SHALLOW body (a step call inside a nested loop marks that inner
+    loop, not this one — so an epoch loop's boundary sync stays legal),
+    but once a region qualifies, syncs are collected through nested loops
+    too: an inner `for` inside the step loop still runs per step.
+    ``force=True`` (the ``train_batch`` body, which IS the per-step path)
+    skips the gate."""
+    if not force and not any(
+            isinstance(n, ast.Call) and _is_step_call(n)
+            for n in _body_nodes(body, include_loops=False)):
+        return
+    for n in _body_nodes(body, include_loops=True):
+        if not isinstance(n, ast.Call):
+            continue
+        name = _call_name(n)
+        if (isinstance(n.func, ast.Attribute) and name in _SYNC_CALL_ATTRS
+                and not n.args and not n.keywords):
+            sync = f".{name}()"
+        elif (isinstance(n.func, ast.Name) and name in _SYNC_BUILTINS
+                and n.args
+                and isinstance(n.args[0], (ast.Name, ast.Attribute,
+                                           ast.Subscript))):
+            # float(loss) / float(self.loss) / float(out[0]) sync a device
+            # value; compound host arithmetic (float(done/total),
+            # float(time.time())) does not involve the device
+            sync = f"{name}(...)"
+        else:
+            continue
+        findings.append(Finding(
+            _ANALYZER, "TS107", "error",
+            f"per-step host sync {sync} inside {region} — one blocking "
+            "readback per step serializes H2D/dispatch/D2H; keep the value "
+            "device-resident (MetricBuffer) and sync at log/epoch "
+            "boundaries", f"{filename}:{n.lineno}"))
+
+
+def _scan_step_loops(tree, findings, filename):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            _flag_step_syncs(node.body, findings, filename,
+                             "a train-step loop")
+        elif (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "train_batch"):
+            # the per-step entry point itself: a syntactic sync here runs
+            # once per training step no matter how the loop is written
+            _flag_step_syncs(node.body, findings, filename,
+                             "train_batch (runs once per step)", force=True)
+
+
 def lint_source(source: str, filename: str = "<string>") -> List[Finding]:
     """Lint one module's source text; returns (unsuppressed) findings."""
     try:
@@ -352,6 +466,9 @@ def lint_source(source: str, filename: str = "<string>") -> List[Finding]:
     # region 3: kernels handed to primitive()/passthrough()
     for kernel, region in _collect_kernels(tree):
         check_region(kernel, region)
+
+    # host-side rule: per-step host syncs in train-step loops (TS107)
+    _scan_step_loops(tree, findings, filename)
 
     # a region nested inside another traced region (a kernel def inside a
     # @to_static body) is visited from both roots; keep one finding per

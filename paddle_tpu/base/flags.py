@@ -117,6 +117,18 @@ define_flag("cost_device_tflops", 197.0,
             "cost-model lint (CM503): nominal device peak used to price "
             "compute time against collective time")
 define_flag("cudnn_deterministic", False, "accepted for compat; XLA is deterministic by default")
+define_flag("device_prefetch", 0,
+            "DataLoader default for device_prefetch=N: stage N collated "
+            "batches onto the device ahead of the train loop "
+            "(io/device_prefetch.py DeviceLoader); 0 disables")
+define_flag("metric_sync_every", 0,
+            "hapi.Model.fit default for how often (in steps) the "
+            "MetricBuffer materializes device metrics to host floats; "
+            "0 defers to the loop's log_freq (log-boundary syncs only)")
+define_flag("cost_while_default_trips", 1,
+            "cost model: trip-count multiplier assumed for a while-loop "
+            "whose counter pattern cannot be statically derived (1 keeps "
+            "the historical single-iteration lower bound)")
 
 
 def enable_check_model_nan_inf():

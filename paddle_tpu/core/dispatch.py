@@ -267,12 +267,44 @@ def _wrap_outputs(name, out, stop_gradient):
     return Tensor(out, stop_gradient=stop_gradient, name=_out_names(name, -1)[0])
 
 
+def _passthrough_bypass_reason():
+    if hooks.discovery is not None:
+        return "discovery"
+    if hooks.static_capture is not None:
+        return "static_capture"
+    if hooks.op_observer is not None:
+        return "observer"
+    return None
+
+
 def passthrough(name: str, fn: Callable, tensor_args: Sequence[Any], attrs: dict | None = None):
-    """Non-differentiable op (integer/bool outputs, comparisons, argmax...)."""
+    """Non-differentiable op (integer/bool outputs, comparisons, argmax...).
+
+    Served from the kernel cache on the same transparency contract as
+    :func:`primitive` (no AMP gate — passthrough never autocasts): the
+    comparison/argmax ops that pepper eager control flow replay compiled
+    executables instead of re-tracing per call."""
     attrs = attrs or {}
     if hooks.discovery is not None:
         hooks.discovery.record_reads(tensor_args)
     values = [unwrap(a) for a in tensor_args]
+    if get_flag("eager_kernel_cache"):
+        reason = _passthrough_bypass_reason()
+        if reason is None:
+            entry = kernel_cache.lookup(name, fn, values, attrs, ())
+            if entry is not None:
+                try:
+                    result = kernel_cache.execute(entry, values)
+                except Exception:
+                    if entry.staged:
+                        raise
+                    kernel_cache.poison(entry.key, name)
+                else:
+                    outs = _wrap_outputs(name, result, stop_gradient=True)
+                    _observe(name, outs if isinstance(outs, tuple) else (outs,))
+                    return outs
+        else:
+            kernel_cache.record_bypass(name, reason)
     out = fn(*values, **attrs)
     outs = _wrap_outputs(name, out, stop_gradient=True)
     _observe(name, outs if isinstance(outs, tuple) else (outs,))

@@ -5,4 +5,5 @@ from .callbacks import (  # noqa: F401
     ModelCheckpoint,
     ProgBarLogger,
 )
+from .metric_buffer import MetricBuffer  # noqa: F401
 from .model import Model  # noqa: F401

@@ -68,6 +68,18 @@ class CallbackList:
         return dispatch
 
 
+def _scalar(v) -> float:
+    """Format-time materialization: host scalars (python or numpy) pass
+    through; device values (Tensor / jax array) take one counted host sync
+    — the fit loop hands callbacks floats at its sync boundaries, so
+    steady-state logging never pays this."""
+    if isinstance(v, (float, int, np.floating, np.integer)):
+        return float(v)
+    from .metric_buffer import to_float
+
+    return to_float(v)
+
+
 class ProgBarLogger(Callback):
     """Per-epoch throughput/metric logging (reference ProgBarLogger; prints a
     summary line per log_freq steps instead of a terminal progress bar)."""
@@ -85,7 +97,7 @@ class ProgBarLogger(Callback):
     def on_train_batch_end(self, step, logs=None):
         if self.verbose and step % self.log_freq == 0:
             logs = logs or {}
-            msgs = [f"{k}: {np.asarray(v).reshape(-1)[0]:.4f}" for k, v in logs.items()]
+            msgs = [f"{k}: {_scalar(v):.4f}" for k, v in logs.items()]
             ips = (step + 1) / max(time.time() - self._start, 1e-9)
             print(f"Epoch {self.epoch}: step {step}/{self.steps} "
                   f"[{ips:.1f} step/s] " + " ".join(msgs))
@@ -93,7 +105,7 @@ class ProgBarLogger(Callback):
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
             logs = logs or {}
-            msgs = [f"{k}: {np.asarray(v).reshape(-1)[0]:.4f}" for k, v in logs.items()]
+            msgs = [f"{k}: {_scalar(v):.4f}" for k, v in logs.items()]
             print(f"Epoch {epoch} done in {time.time() - self._start:.1f}s " + " ".join(msgs))
 
 

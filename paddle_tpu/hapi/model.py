@@ -4,7 +4,11 @@ Model.prepare/fit/evaluate/predict/save/load + summary).
 TPU-native: train/eval batches run through a jit-compiled step (the
 paddle_tpu.jit functionalizer), so `Model.fit` trains at whole-program XLA
 speed out of the box — the reference's dygraph loop pays per-op dispatch
-instead. Metrics accumulate host-side per step.
+instead. The fit loop is async end-to-end (ISSUE 5): losses stay on the
+device in a ``MetricBuffer`` and materialize only at log/epoch boundaries,
+and ``device_prefetch=N`` stages upcoming batches onto the device while the
+current step computes — the steady-state step issues zero blocking host
+syncs.
 """
 from __future__ import annotations
 
@@ -13,9 +17,10 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..core.tensor import Tensor
-from ..io import DataLoader, Dataset
+from ..io import DataLoader, Dataset, DeviceLoader
 from ..metric.metrics import Metric
 from .callbacks import config_callbacks
+from .metric_buffer import MetricBuffer, to_float
 
 
 def _to_list(x):
@@ -57,8 +62,13 @@ class Model:
 
         self._train_step = TrainStep(model=model, optimizer=self._optimizer, loss_fn=fn)
 
-    def train_batch(self, inputs, labels=None, update=True):
-        """One optimizer step; returns the loss (reference train_batch)."""
+    def train_batch(self, inputs, labels=None, update=True, sync=True):
+        """One optimizer step; returns the loss (reference train_batch).
+
+        ``sync=True`` (the reference contract) materializes a python float
+        — one blocking device→host read. ``sync=False`` returns the loss
+        as a device-resident Tensor so async loops (``fit``) can defer the
+        readback to a ``MetricBuffer`` boundary."""
         if self._optimizer is None or self._loss is None:
             raise RuntimeError("call prepare(optimizer, loss) before training")
         self.network.train()
@@ -66,7 +76,9 @@ class Model:
             self._build_train_step()
         batch = _to_list(inputs) + _to_list(labels)
         loss = self._train_step(*batch)
-        return [float(np.asarray(loss.numpy()))]
+        if sync:
+            return [to_float(loss)]
+        return [loss]
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -74,7 +86,7 @@ class Model:
         losses = []
         if self._loss is not None and labels is not None:
             loss = self._loss(outputs, *_to_list(labels))
-            losses = [float(np.asarray(loss.numpy()))]
+            losses = [to_float(loss)]
         metric_outs = []
         for m in self._metrics:
             computed = m.compute(outputs, *_to_list(labels))
@@ -87,17 +99,45 @@ class Model:
         return [o.numpy() if isinstance(o, Tensor) else o for o in _to_list(out)]
 
     # ------------------------------------------------------------ loops
-    def _make_loader(self, data, batch_size, shuffle):
+    def _make_loader(self, data, batch_size, shuffle, num_workers=0,
+                     device_prefetch=None):
         if data is None or isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
-            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers,
+                              device_prefetch=device_prefetch)
         return data  # any iterable of batches
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
-        loader = self._make_loader(train_data, batch_size, shuffle)
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            device_prefetch=None, sync_every=None):
+        """Train over ``train_data``. The loop is non-blocking by design:
+        per-step losses stay device-resident in a :class:`MetricBuffer`
+        and materialize only every ``sync_every`` steps (defaults to
+        ``log_freq``, or ``FLAGS_metric_sync_every`` when set) and at
+        epoch boundaries; ``device_prefetch=N`` double-buffers H2D batch
+        staging (``FLAGS_device_prefetch`` sets the default). Callbacks
+        keep the float-valued ``logs`` contract: between boundaries they
+        receive the LAST materialized loss (fresh every ``sync_every``-th
+        step) rather than a device handle — only an explicit
+        ``sync_every=0`` passes device values through."""
+        from ..base.flags import get_flag
+        from ..profiler.pipeline import pipeline_stats, timed
+
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, device_prefetch)
+        if device_prefetch and loader is not None and loader is train_data:
+            # caller-supplied loader/iterable (a Dataset got a fresh loader
+            # above with device_prefetch wired in): wrap — never mutate the
+            # caller's object — unless it already prefetches on its own
+            already = (isinstance(loader, DeviceLoader)
+                       or bool(getattr(loader, "device_prefetch", 0)))
+            if not already:
+                loader = DeviceLoader(loader, depth=int(device_prefetch))
+        if sync_every is None:
+            sync_every = int(get_flag("metric_sync_every")) or log_freq
         try:
             steps = len(loader)
         except TypeError:
@@ -108,14 +148,33 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         logs = {}
+        buf = MetricBuffer(sync_every=sync_every)
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             for step, batch in enumerate(loader):
                 xs, ys = self._split_batch(batch)
                 cbks.on_train_batch_begin(step)
-                losses = self.train_batch(xs, ys)
-                logs = {"loss": losses[0]}
+                with timed(pipeline_stats.add_dispatch):
+                    losses = self.train_batch(xs, ys, sync=False)
+                buf.append("loss", losses[0])
+                pipeline_stats.step()
+                if buf.should_sync(step):
+                    # log boundary (aligned with ProgBarLogger's cadence):
+                    # one batched readback covering every step since the
+                    # previous boundary
+                    logs = dict(buf.materialize())
+                else:
+                    # keep the logs contract float-valued without syncing:
+                    # callbacks see the last boundary's float (step 0 is
+                    # always a boundary when sync_every >= 1); only an
+                    # explicit sync_every=0 hands them the device value
+                    val = buf.last_float("loss")
+                    logs = {"loss": val if val is not None
+                            else buf.latest("loss")}
                 cbks.on_train_batch_end(step, logs)
+            report = buf.flush()
+            if "loss" in report:
+                logs = {"loss": report["loss"]["last"]}
             cbks.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch % eval_freq == 0 or epoch == epochs - 1):
                 eval_logs = self.evaluate(eval_data, batch_size=batch_size,

@@ -7,6 +7,7 @@ from .dataloader import (  # noqa: F401
     default_convert_fn,
     get_worker_info,
 )
+from .device_prefetch import DeviceLoader  # noqa: F401
 from .dataset import (  # noqa: F401
     ChainDataset,
     ComposeDataset,

@@ -322,9 +322,18 @@ class DataLoader:
         persistent_workers: bool = False,
         worker_mode: str = "thread",
         shm_capacity: int = 64 << 20,
+        device_prefetch: Optional[int] = None,
     ):
+        from ..base.flags import get_flag
+
         self.dataset = dataset
         self.return_list = return_list
+        # device_prefetch=N stages N collated batches onto the device ahead
+        # of the loop (io/device_prefetch.py); None defers to
+        # FLAGS_device_prefetch, 0 disables
+        self.device_prefetch = (int(get_flag("device_prefetch"))
+                                if device_prefetch is None
+                                else int(device_prefetch))
         self.num_workers = num_workers if use_buffer_reader else 0
         self.prefetch_factor = max(prefetch_factor, 1)
         self.worker_init_fn = worker_init_fn
@@ -361,10 +370,16 @@ class DataLoader:
 
     def __iter__(self):
         if self._iterable:
-            return _IterableIter(self)
-        if self.worker_mode == "process" and self.num_workers > 0:
-            return _ProcessMapIter(self)
-        return _MapIter(self)
+            it = _IterableIter(self)
+        elif self.worker_mode == "process" and self.num_workers > 0:
+            it = _ProcessMapIter(self)
+        else:
+            it = _MapIter(self)
+        if self.device_prefetch > 0:
+            from .device_prefetch import _PrefetchIter
+
+            return _PrefetchIter(it, self.device_prefetch)
+        return it
 
     def __len__(self):
         if self._iterable:

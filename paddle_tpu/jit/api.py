@@ -72,6 +72,10 @@ class TrainStep:
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self._lr_cell = Tensor(jnp.asarray(0.0, jnp.float32), name="lr_cell")
+        # host-side mirror of the cell's value: the device scalar re-uploads
+        # only when the schedule actually moves, so a constant-LR steady
+        # state issues zero H2D transfers per step
+        self._lr_host = 0.0
 
         def step_fn(*batch):
             loss = self.loss_fn(*batch)
@@ -105,10 +109,16 @@ class TrainStep:
             self._compiled = CompiledFunction(step_fn, static_key_fn=static_key, name="train_step")
 
     def __call__(self, *batch):
-        import jax.numpy as jnp
-
         # refresh the LR cell from the schedule before entering the program
-        self._lr_cell._replace_value(jnp.asarray(self.optimizer.get_lr(), jnp.float32))
+        # — but only when the value changed (the compiled program threads
+        # the cell through as donated state, so the device scalar persists
+        # across steps on its own)
+        lr = self.optimizer.get_lr()
+        if lr != self._lr_host:
+            import jax.numpy as jnp
+
+            self._lr_cell._replace_value(jnp.asarray(lr, jnp.float32))
+            self._lr_host = lr
         return self._compiled(*batch)
 
     @property

@@ -178,15 +178,23 @@ class CompiledFunction:
         self._compile_counts: Dict[Any, int] = {}
 
     def _cache_key(self, args, kwargs):
+        # treedefs are hashable and compare structurally — keying on the
+        # object skips a per-call str() of the whole tree structure
         treedef, sig = _tree_key((args, kwargs))
         extra = self.static_key_fn() if self.static_key_fn else None
-        return (str(treedef), sig, extra)
+        return (treedef, sig, extra)
 
     def __call__(self, *args, **kwargs):
         key = self._cache_key(args, kwargs)
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build(key, args, kwargs)
+        # memoized per key: memory_analysis only needs the last call's
+        # abstract (shape, dtype) tree, which cannot change while the key
+        # doesn't — steady-state steps skip the tree_map
+        if key != getattr(self, "_last_key", None):
+            self._last_call = _abstract_call(args, kwargs)
+            self._last_key = key
         self.last_entry = entry
         if entry.get("eager"):
             self.stats["eager_steps"] += 1
@@ -353,7 +361,6 @@ class CompiledFunction:
         re-runs the right specialization (cells not donated → originals
         intact). Unseen signatures build a new specialization from a fresh
         side-effect-free discovery — no committed eager steps."""
-        self._last_call = _abstract_call(args, kwargs)
         guard = family["last"]
         entry = family["entries"][guard]
         try:
@@ -421,7 +428,6 @@ class CompiledFunction:
         ).memory_analysis()
 
     def _run(self, entry, args, kwargs):
-        self._last_call = _abstract_call(args, kwargs)
         cells = entry["cells"]
         cell_vals = [c._value for c in cells]
         if self.donate_cells:

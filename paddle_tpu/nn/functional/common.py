@@ -41,19 +41,23 @@ def one_hot(x, num_classes, name=None):
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
     if not training or p == 0.0:
         return x if isinstance(x, Tensor) else Tensor(x)
+    # the key is split host-side and threaded as a TRACED argument (not a
+    # closure cell): the kernel-cache signature stays hashable, so dropout
+    # replays one compiled executable per shape with per-call randomness
+    # riding in as data (ROADMAP eager-dispatch leftover)
     key = global_state.default_generator.split()
 
-    def fn(v):
+    def fn(v, k):
         shape = list(v.shape)
         if axis is not None:
             axes = axis if isinstance(axis, (list, tuple)) else [axis]
             shape = [s if i in [a % v.ndim for a in axes] else 1 for i, s in enumerate(shape)]
-        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
         if mode == "upscale_in_train":
             return jnp.where(keep, v / (1.0 - p), 0.0)
         return jnp.where(keep, v, 0.0)
 
-    return primitive("dropout", fn, [x])
+    return primitive("dropout", fn, [x, key])
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -76,13 +80,13 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha_p = -alpha * scale
     key = global_state.default_generator.split()
 
-    def fn(v):
-        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+    def fn(v, k):  # key threaded as a traced arg — see dropout
+        keep = jax.random.bernoulli(k, 1.0 - p, v.shape)
         a = ((1.0 - p) * (1.0 + p * alpha_p**2)) ** -0.5
         b = -a * alpha_p * p
         return a * jnp.where(keep, v, alpha_p) + b
 
-    return primitive("alpha_dropout", fn, [x])
+    return primitive("alpha_dropout", fn, [x, key])
 
 
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):

@@ -67,20 +67,21 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
     from ..base import global_state
 
     if training:
-        # draw the key OUTSIDE the kernel (dropout's pattern): a split()
-        # inside fn would advance the global generator under any staging
-        # trace, and the key in the closure keeps the op off the kernel
-        # cache (fresh randomness per call)
+        # draw the key OUTSIDE the kernel and thread it as a traced
+        # argument (dropout's pattern): a split() inside fn would advance
+        # the global generator under any staging trace, and a key in the
+        # closure would keep the op off the kernel cache
         key = global_state.default_generator.split()
 
-        def fn(v):
-            a = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
+        def fn(v, k):
+            a = jax.random.uniform(k, v.shape, v.dtype, lower, upper)
             return jnp.where(v >= 0, v, a * v)
-    else:
-        mid = (lower + upper) / 2.0
 
-        def fn(v):
-            return jnp.where(v >= 0, v, mid * v)
+        return primitive("rrelu", fn, [x, key])
+    mid = (lower + upper) / 2.0
+
+    def fn(v):
+        return jnp.where(v >= 0, v, mid * v)
 
     return primitive("rrelu", fn, [x])
 
@@ -144,10 +145,10 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from ..base import global_state
 
-    key = global_state.default_generator.split()  # see rrelu: key stays host-side
+    key = global_state.default_generator.split()  # see rrelu: split host-side, traced in
 
-    def fn(v):
-        g = jax.random.gumbel(key, v.shape, v.dtype)
+    def fn(v, k):
+        g = jax.random.gumbel(k, v.shape, v.dtype)
         y = jax.nn.softmax((v + g) / temperature, axis=axis)
         if hard:
             idx = jnp.argmax(y, axis=axis, keepdims=True)
@@ -156,7 +157,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             y = hard_y + y - jax.lax.stop_gradient(y)
         return y
 
-    return primitive("gumbel_softmax", fn, [x])
+    return primitive("gumbel_softmax", fn, [x, key])
 
 
 def glu(x, axis=-1, name=None):

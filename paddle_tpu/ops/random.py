@@ -169,7 +169,10 @@ def standard_gamma(x, name=None):
 
     from ..core.dispatch import passthrough
 
-    return passthrough("standard_gamma", lambda a: jr.gamma(_key(), a), [x])
+    # key split host-side and threaded as a traced arg (dropout's pattern):
+    # a _key() inside the kernel would draw under the staging trace
+    return passthrough("standard_gamma", lambda a, k: jr.gamma(k, a),
+                       [x, _key()])
 
 
 def dirichlet(alpha, name=None):
@@ -178,4 +181,5 @@ def dirichlet(alpha, name=None):
 
     from ..core.dispatch import passthrough
 
-    return passthrough("dirichlet", lambda a: jr.dirichlet(_key(), a), [alpha])
+    return passthrough("dirichlet", lambda a, k: jr.dirichlet(k, a),
+                       [alpha, _key()])

@@ -1,3 +1,4 @@
+from .pipeline import PipelineStats, pipeline_stats  # noqa: F401
 from .profiler import (  # noqa: F401
     Profiler,
     ProfilerState,

@@ -1,0 +1,108 @@
+"""Per-step input-pipeline breakdown: where does a train step's wall time go?
+
+The async XLA dispatch model hides host→device transfer and host-side
+dispatch behind device compute — but only when the loop around the compiled
+step actually lets it (no per-step ``.numpy()``, batches staged ahead of
+consumption). This module is the observability half of that contract: the
+``DeviceLoader`` (io/device_prefetch.py), ``MetricBuffer``
+(hapi/metric_buffer.py) and the hapi/bench train loops report their waits
+into one process-global :class:`PipelineStats`, and ``bench.py`` publishes
+the summary under ``extras.pipeline``:
+
+- ``h2d_wait_us``   — time the consumer blocked waiting for the next
+  device-resident batch (0 when prefetch keeps up: the H2D overlapped the
+  previous step's compute);
+- ``h2d_issue_us``  — time the prefetch worker spent issuing
+  ``jax.device_put`` (the transfer cost that is being hidden);
+- ``dispatch_us``   — time inside the compiled step call (enqueue + for
+  synchronous backends the compute itself);
+- ``host_sync_us``  / ``host_syncs_per_step`` — time and count of blocking
+  device→host reads (metric materialization). The steady-state target is
+  **zero per step**: syncs belong at log/epoch boundaries.
+- ``overlap_ratio`` — fraction of issued H2D time the consumer never
+  waited for (1.0 = transfers fully hidden).
+
+Recording costs two ``perf_counter`` calls per event — cheap enough to
+leave on; ``reset()`` starts a fresh window.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class PipelineStats:
+    """Thread-safe accumulator for the per-step pipeline breakdown."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self.steps = 0
+            self.h2d_wait_s = 0.0
+            self.h2d_issue_s = 0.0
+            self.dispatch_s = 0.0
+            self.host_sync_s = 0.0
+            self.host_syncs = 0
+
+    # ------------------------------------------------------------ recording
+    def add_h2d_wait(self, seconds: float):
+        with self._lock:
+            self.h2d_wait_s += seconds
+
+    def add_h2d_issue(self, seconds: float):
+        with self._lock:
+            self.h2d_issue_s += seconds
+
+    def add_dispatch(self, seconds: float):
+        with self._lock:
+            self.dispatch_s += seconds
+
+    def add_host_sync(self, seconds: float, count: int = 1):
+        with self._lock:
+            self.host_sync_s += seconds
+            self.host_syncs += count
+
+    def step(self, n: int = 1):
+        with self._lock:
+            self.steps += n
+
+    # ------------------------------------------------------------ reporting
+    def summary(self) -> dict:
+        with self._lock:
+            steps = max(self.steps, 1)
+            if self.h2d_issue_s > 0:
+                overlap = 1.0 - min(self.h2d_wait_s / self.h2d_issue_s, 1.0)
+            else:
+                overlap = None
+            return {
+                "steps": self.steps,
+                "h2d_wait_us": round(self.h2d_wait_s / steps * 1e6, 1),
+                "h2d_issue_us": round(self.h2d_issue_s / steps * 1e6, 1),
+                "dispatch_us": round(self.dispatch_s / steps * 1e6, 1),
+                "host_sync_us": round(self.host_sync_s / steps * 1e6, 1),
+                "host_syncs_per_step": round(self.host_syncs / steps, 4),
+                "overlap_ratio": (round(overlap, 4)
+                                  if overlap is not None else None),
+            }
+
+
+pipeline_stats = PipelineStats()
+
+
+class timed:
+    """``with timed(stats.add_dispatch): step(batch)`` — records the span."""
+
+    __slots__ = ("_sink", "_t0")
+
+    def __init__(self, sink):
+        self._sink = sink
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._sink(time.perf_counter() - self._t0)

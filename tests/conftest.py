@@ -53,3 +53,8 @@ def pytest_configure(config):
         "markers",
         "slow: heavy integration tests (large compiles / subprocesses); "
         "deselect with -m 'not slow' for the <5-minute quick loop")
+    config.addinivalue_line(
+        "markers",
+        "serial: multi-process rendezvous tests sensitive to machine load; "
+        "run isolated (pytest -m serial) when diagnosing flakes — they "
+        "retry once on transient TCPStore/segfault infra failures")

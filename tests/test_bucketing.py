@@ -50,7 +50,7 @@ def test_variable_seqlen_finetune_compiles_log2_programs():
     for seq_len in (17, 23, 31, 33, 48, 64, 20, 57):
         ids = paddle.to_tensor(rs.randint(0, 50, (2, seq_len)).astype(np.int64))
         labels = paddle.to_tensor(rs.randint(0, 50, (2, seq_len)).astype(np.int64))
-        losses.append(float(step(ids, labels).numpy()))
+        losses.append(float(step(ids, labels).numpy()))  # noqa: TS107 (test asserts per-step loss on purpose)
 
     assert all(np.isfinite(losses))
     assert step._compiled.num_compiled <= 3, step._compiled.num_compiled

@@ -62,6 +62,75 @@ class TestWalker:
         assert rep.flops >= 10 * 2 * 16 ** 3
         assert rep.flops < 11 * 2 * 16 ** 3  # body counted 10x, not more
 
+    def test_while_counter_trip_count_derived_statically(self):
+        # ISSUE 5 satellite: the counter pattern (init/bound/step literals)
+        # multiplies the body cost by the derived trip count instead of
+        # the old single-iteration lower bound
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            def body(c):
+                i, v = c
+                return i + 1, v @ x
+
+            return jax.lax.while_loop(lambda c: c[0] < 8, body, (0, x))
+
+        rep = cost_jaxpr(jax.make_jaxpr(f)(jnp.ones((16, 16), jnp.float32)))
+        assert rep.flops >= 8 * 2 * 16 ** 3
+        assert rep.flops < 9 * 2 * 16 ** 3  # body counted 8x, not more
+
+    def test_while_countdown_and_le_bounds(self):
+        import jax
+        import jax.numpy as jnp
+
+        def down(x):
+            return jax.lax.while_loop(
+                lambda c: c[0] > 0, lambda c: (c[0] - 1, c[1] @ x), (4, x))
+
+        rep = cost_jaxpr(jax.make_jaxpr(down)(jnp.ones((8, 8), jnp.float32)))
+        assert 4 * 2 * 8 ** 3 <= rep.flops < 5 * 2 * 8 ** 3
+
+        def le(x):  # 0, 2, 4, 6, 8 -> 5 trips
+            return jax.lax.while_loop(
+                lambda c: c[0] <= 8, lambda c: (c[0] + 2, c[1] @ x), (0, x))
+
+        rep = cost_jaxpr(jax.make_jaxpr(le)(jnp.ones((8, 8), jnp.float32)))
+        assert 5 * 2 * 8 ** 3 <= rep.flops < 6 * 2 * 8 ** 3
+
+    def test_while_statically_dead_loop_costs_zero_body(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):  # guard never passes: body must not be charged
+            return jax.lax.while_loop(
+                lambda c: c[0] < 0, lambda c: (c[0] + 1, c[1] @ x), (5, x))
+
+        rep = cost_jaxpr(jax.make_jaxpr(f)(jnp.ones((8, 8), jnp.float32)))
+        assert rep.flops < 2 * 8 ** 3  # no full matmul body charged
+
+    def test_while_dynamic_bound_falls_back_to_flag(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.base.flags import get_flag, set_flags
+
+        def f(x, n):
+            return jax.lax.while_loop(
+                lambda c: c[0] < n, lambda c: (c[0] + 1, c[1] @ x), (0, x))
+
+        closed = jax.make_jaxpr(f)(jnp.ones((8, 8), jnp.float32),
+                                   jnp.int32(5))
+        one = cost_jaxpr(closed)
+        assert 2 * 8 ** 3 <= one.flops < 2 * 2 * 8 ** 3  # lower bound: 1 trip
+        prev = get_flag("cost_while_default_trips")
+        try:
+            set_flags({"cost_while_default_trips": 3})
+            three = cost_jaxpr(closed)
+            assert three.flops == pytest.approx(3 * one.flops)
+        finally:
+            set_flags({"cost_while_default_trips": prev})
+
     def test_liveness_peak_frees_dead_values(self):
         import jax
         import jax.numpy as jnp

@@ -1,0 +1,304 @@
+"""DeviceLoader + MetricBuffer: the async train-loop pipeline (ISSUE 5).
+
+Covers the tentpole's correctness contract: device prefetch preserves
+batch order and values (sync-path equivalence), shuts down cleanly when
+the consumer stops early, places batches sharded when a mesh is
+installed; the MetricBuffer syncs only at boundaries and its flushed
+floats are bit-identical to the per-step ``float(...)`` path.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi.metric_buffer import MetricBuffer, to_float
+from paddle_tpu.io import DataLoader, DeviceLoader
+from paddle_tpu.profiler.pipeline import PipelineStats, pipeline_stats
+
+
+def _dataset(n=12, shape=(4,), seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randn(*shape).astype(np.float32) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# DeviceLoader
+# ---------------------------------------------------------------------------
+
+def test_device_loader_preserves_order_and_values():
+    data = _dataset(12)
+    base = DataLoader(data, batch_size=3)
+    sync_batches = [b.numpy().copy() for b in base]
+    dev_batches = [b.numpy().copy() for b in DeviceLoader(base, depth=2)]
+    assert len(dev_batches) == len(sync_batches) == 4
+    for s, d in zip(sync_batches, dev_batches):
+        np.testing.assert_array_equal(s, d)
+
+
+def test_device_loader_is_reiterable_and_has_len():
+    loader = DeviceLoader(DataLoader(_dataset(8), batch_size=2), depth=1)
+    assert len(loader) == 4
+    assert sum(1 for _ in loader) == 4
+    assert sum(1 for _ in loader) == 4  # fresh pass, fresh thread
+
+
+def test_device_loader_batches_are_device_resident_tensors():
+    (batch,) = list(DeviceLoader(DataLoader(_dataset(3), batch_size=3)))
+    assert isinstance(batch, paddle.Tensor)
+    assert isinstance(batch._value, jax.Array)
+
+
+def test_device_loader_early_break_shuts_worker_down():
+    base = DataLoader(_dataset(40), batch_size=2)
+    it = iter(DeviceLoader(base, depth=2))
+    next(it)
+    thread = it._thread
+    it.close()
+    thread.join(timeout=5)
+    assert not thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_device_loader_propagates_worker_errors():
+    class Exploding:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if i >= 2:
+                raise ValueError("boom at index 2")
+            return np.zeros(3, np.float32)
+
+    it = iter(DeviceLoader(DataLoader(Exploding(), batch_size=1), depth=1))
+    next(it)
+    with pytest.raises(ValueError, match="boom"):
+        for _ in range(4):
+            next(it)
+    assert not it._thread.is_alive()
+
+
+def test_device_loader_dict_and_tuple_batches():
+    data = [{"x": np.full((2,), i, np.float32), "y": i} for i in range(4)]
+    out = list(DeviceLoader(DataLoader(data, batch_size=2), depth=1))
+    assert len(out) == 2 and set(out[0].keys()) == {"x", "y"}
+    np.testing.assert_array_equal(out[0]["x"].numpy(),
+                                  [[0.0, 0.0], [1.0, 1.0]])
+
+
+def test_dataloader_device_prefetch_sugar():
+    loader = DataLoader(_dataset(8), batch_size=2, device_prefetch=2)
+    from paddle_tpu.io.device_prefetch import _PrefetchIter
+
+    it = iter(loader)
+    assert isinstance(it, _PrefetchIter)
+    got = [b.numpy() for b in it]
+    want = [b.numpy() for b in DataLoader(_dataset(8), batch_size=2)]
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_device_prefetch_flag_sets_the_default():
+    prev = paddle.get_flags("device_prefetch")["device_prefetch"]
+    from paddle_tpu.io.device_prefetch import _PrefetchIter
+
+    try:
+        paddle.set_flags({"device_prefetch": 1})
+        assert isinstance(iter(DataLoader(_dataset(4), batch_size=2)),
+                          _PrefetchIter)
+        # explicit argument wins over the flag
+        assert not isinstance(
+            iter(DataLoader(_dataset(4), batch_size=2, device_prefetch=0)),
+            _PrefetchIter)
+    finally:
+        paddle.set_flags({"device_prefetch": prev})
+
+
+def test_device_loader_sharded_placement_over_dp_mesh():
+    from paddle_tpu.distributed import env as dist_env
+
+    env = dist_env.instance()
+    prev_mesh, prev_deg = env.mesh, dict(env.axis_degrees)
+    try:
+        env.build_mesh({"dp": 8})
+        data = _dataset(16, shape=(6,))
+        batches = list(DeviceLoader(DataLoader(data, batch_size=8), depth=1))
+        sharding = batches[0]._value.sharding
+        # leading dim 8 divides dp=8 -> batch axis sharded over "dp"
+        assert "dp" in str(sharding.spec), sharding
+        assert len(batches[0]._value.devices()) == 8
+        # non-divisible leading dim -> replicated, still mesh-placed
+        odd = list(DeviceLoader(DataLoader(_dataset(3, shape=(5,)),
+                                           batch_size=3), depth=1))
+        assert odd[0]._value.sharding.spec == ()  # fully replicated
+    finally:
+        env.mesh, env.axis_degrees = prev_mesh, prev_deg
+
+
+def test_device_loader_records_pipeline_stats():
+    pipeline_stats.reset()
+    for _ in DeviceLoader(DataLoader(_dataset(6), batch_size=2), depth=1):
+        pipeline_stats.step()
+    s = pipeline_stats.summary()
+    assert s["steps"] == 3
+    assert s["h2d_issue_us"] > 0
+    assert s["host_syncs_per_step"] == 0
+
+
+# ---------------------------------------------------------------------------
+# MetricBuffer
+# ---------------------------------------------------------------------------
+
+def test_metric_buffer_flush_is_bit_identical_to_per_step_floats():
+    rs = np.random.RandomState(3)
+    vals = [paddle.Tensor(rs.randn(1).astype(np.float32).reshape(()))
+            for _ in range(7)]
+    per_step = [float(np.asarray(v.numpy())) for v in vals]
+    buf = MetricBuffer()
+    for v in vals:
+        buf.append("loss", v)
+    report = buf.flush()["loss"]
+    assert report["values"] == per_step  # bit-identical floats
+    assert report["last"] == per_step[-1]
+    assert report["mean"] == float(np.mean(per_step))
+
+
+def test_metric_buffer_sync_every_boundaries():
+    # same modulo-0 cadence ProgBarLogger prints on (step % k == 0), so
+    # the logger always receives materialized floats
+    buf = MetricBuffer(sync_every=3)
+    assert [buf.should_sync(s) for s in range(7)] == [
+        True, False, False, True, False, False, True]
+    assert not MetricBuffer().should_sync(0)  # 0/None: explicit flush only
+
+
+def test_metric_buffer_materialize_clears_pending_keeps_history():
+    buf = MetricBuffer(sync_every=2)
+    buf.append("loss", paddle.Tensor(np.float32(1.5)))
+    buf.append("loss", paddle.Tensor(np.float32(2.5)))
+    out = buf.materialize()
+    assert out == {"loss": 2.5}
+    assert buf.latest("loss") == 2.5
+    buf.append("loss", paddle.Tensor(np.float32(3.5)))
+    report = buf.flush()["loss"]
+    assert report["values"] == [1.5, 2.5, 3.5]
+    assert buf.flush() == {}  # history cleared by the epoch flush
+
+
+def test_metric_buffer_counts_host_syncs():
+    stats = pipeline_stats
+    stats.reset()
+    buf = MetricBuffer()
+    for i in range(5):
+        buf.append("loss", paddle.Tensor(np.float32(i)))
+        stats.step()
+    assert stats.summary()["host_syncs_per_step"] == 0  # steady state
+    buf.materialize()
+    assert stats.summary()["host_syncs_per_step"] == pytest.approx(0.2)
+
+
+def test_to_float_matches_plain_conversion_and_counts():
+    pipeline_stats.reset()
+    t = paddle.Tensor(np.float32(4.25))
+    assert to_float(t) == 4.25
+    assert pipeline_stats.host_syncs == 1
+    assert isinstance(PipelineStats().summary(), dict)  # fresh instances work
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: Model.fit through the async pipeline
+# ---------------------------------------------------------------------------
+
+def _fit_linear(device_prefetch, sync_every, seed=7):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Callback
+
+    paddle.seed(seed)
+    rs = np.random.RandomState(seed)
+    xs = rs.randn(24, 4).astype(np.float32)
+    ys = (xs @ rs.randn(4, 1).astype(np.float32)).astype(np.float32)
+    data = [(xs[i], ys[i]) for i in range(len(xs))]
+
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    seen = []
+
+    class Spy(Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            seen.append(float(np.asarray(logs["loss"])))
+
+    model.fit(DataLoader(data, batch_size=4), epochs=2, verbose=0,
+              callbacks=[Spy()], device_prefetch=device_prefetch,
+              sync_every=sync_every)
+    return seen, [p.numpy().copy() for p in net.parameters()]
+
+
+def test_fit_async_pipeline_matches_sync_path_bitwise():
+    sync_losses, sync_params = _fit_linear(device_prefetch=0, sync_every=1)
+    async_losses, async_params = _fit_linear(device_prefetch=2, sync_every=4)
+    assert sync_losses == async_losses  # bit-identical epoch losses
+    for s, a in zip(sync_params, async_params):
+        np.testing.assert_array_equal(s, a)
+
+
+def test_fit_logs_stay_float_valued_for_callbacks():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Callback
+
+    paddle.seed(5)
+    rs = np.random.RandomState(5)
+    data = [(rs.randn(4).astype(np.float32),
+             rs.randn(1).astype(np.float32)) for _ in range(12)]
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.01, parameters=net.parameters()), loss=nn.MSELoss())
+    seen = []
+
+    class Spy(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            seen.append(logs["loss"])
+
+    model.fit(DataLoader(data, batch_size=4), epochs=1, verbose=0,
+              callbacks=[Spy()], sync_every=2)
+    assert len(seen) == 3
+    # every step hands callbacks a python float (boundary steps fresh,
+    # in-between steps the last boundary's value) — never a device handle
+    assert all(isinstance(v, float) for v in seen), seen
+    assert seen[1] == seen[0]  # step 1 carries the step-0 boundary float
+
+
+def test_fit_does_not_mutate_a_caller_supplied_loader():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(3)
+    rs = np.random.RandomState(3)
+    data = [(rs.randn(4).astype(np.float32),
+             rs.randn(1).astype(np.float32)) for _ in range(8)]
+    loader = DataLoader(data, batch_size=4)
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.01, parameters=net.parameters()), loss=nn.MSELoss())
+    model.fit(loader, epochs=1, verbose=0, device_prefetch=2)
+    assert loader.device_prefetch == 0  # caller's object untouched
+    from paddle_tpu.io.device_prefetch import _PrefetchIter
+
+    assert not isinstance(iter(loader), _PrefetchIter)
+
+
+def test_fit_steady_state_issues_zero_host_syncs():
+    pipeline_stats.reset()
+    _fit_linear(device_prefetch=2, sync_every=1000)  # boundary only at step 0
+    s = pipeline_stats.summary()
+    assert s["steps"] == 12  # 6 batches x 2 epochs
+    # one materialize at step 0 per epoch + one epoch flush per epoch:
+    # bounded, not per-step
+    assert s["host_syncs_per_step"] <= 4 / 12 + 1e-9

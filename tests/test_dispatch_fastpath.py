@@ -87,6 +87,54 @@ def test_scalar_arg_type_distinguishes_entries():
     assert _op_stats("multiply")["misses"] == 3
 
 
+def test_passthrough_ops_cache_too():
+    # ISSUE 5 satellite: comparisons/argmax (non-differentiable dispatch)
+    # ride the same fast path as primitive — slow-path-only before
+    a = _t(np.arange(6).reshape(2, 3))
+    e1 = paddle.equal(a, a)
+    e2 = paddle.equal(a, a)
+    np.testing.assert_array_equal(e1.numpy(), e2.numpy())
+    s = _op_stats("equal")
+    assert s["misses"] == 1 and s["hits"] == 1 and s["bypasses"] == 0
+    m1 = paddle.argmax(a, axis=1)
+    m2 = paddle.argmax(a, axis=1)
+    np.testing.assert_array_equal(m1.numpy(), [2, 2])
+    np.testing.assert_array_equal(m2.numpy(), [2, 2])
+    s = _op_stats("argmax")
+    assert s["misses"] == 1 and s["hits"] == 1
+
+
+def test_passthrough_bypasses_under_hooks():
+    a = _t(np.ones((2, 2)))
+    seen = []
+    hooks.op_observer = lambda name, vals: seen.append(name)
+    try:
+        paddle.equal(a, a)
+    finally:
+        hooks.op_observer = None
+    s = _op_stats("equal")
+    assert s["misses"] == 0 and s["hits"] == 0
+    assert s["bypass_reasons"] == {"observer": 1}
+    assert seen == ["equal"]
+
+
+def test_passthrough_random_ops_thread_their_key():
+    # standard_gamma/dirichlet split the key host-side and pass it as a
+    # traced arg: cached executable, fresh randomness, clean generator
+    from paddle_tpu.base import global_state
+    from paddle_tpu.ops import random as R
+
+    paddle.seed(11)
+    alpha = _t(np.full((8,), 2.0))
+    d1 = R.standard_gamma(alpha)
+    d2 = R.standard_gamma(alpha)
+    assert not np.array_equal(d1.numpy(), d2.numpy())
+    s = _op_stats("standard_gamma")
+    assert s["misses"] == 1 and s["hits"] == 1 and s["bypasses"] == 0
+    assert not isinstance(global_state.default_generator._key,
+                          jax.core.Tracer)
+
+
 def test_kwonly_default_values_key_the_cache():
     # kernel factories may parameterize via keyword-only defaults instead
     # of closure cells; those values must key the cache too
@@ -272,22 +320,27 @@ def test_tensor_in_closure_bypasses():
     assert _op_stats("aux_capture")["bypass_reasons"] == {"array_capture": 1}
 
 
-def test_dropout_rng_key_bypasses_not_frozen():
-    # the per-call PRNG key lives in the kernel closure: caching it would
-    # replay identical masks forever — it must bypass instead
+def test_dropout_rng_key_threads_as_traced_arg_and_caches():
+    # ISSUE 5 satellite: the per-call PRNG key is split host-side and
+    # threaded as a TRACED argument, so dropout serves from the kernel
+    # cache (one executable per shape) with fresh randomness riding in as
+    # data — no more per-call array_capture bypass
     paddle.seed(0)
     x = _t(np.ones((64,)), stop_gradient=False)
     m1 = paddle.nn.functional.dropout(x, p=0.5)
     m2 = paddle.nn.functional.dropout(x, p=0.5)
     assert not np.array_equal(m1.numpy(), m2.numpy())
-    # counted under the deliberate reason, NOT the JX320 storm numerator
-    assert _op_stats("dropout")["bypass_reasons"] == {"array_capture": 2}
+    s = _op_stats("dropout")
+    assert s["misses"] == 1 and s["hits"] == 1 and s["bypasses"] == 0
+    # gradients flow through the cached executable
+    paddle.sum(m1).backward()
+    assert x.grad is not None
 
 
 def test_rng_ops_stay_random_and_generator_stays_clean():
-    # rrelu/gumbel_softmax draw their key host-side (closure -> bypass);
-    # randomness must differ per call and the global generator must never
-    # hold a tracer afterwards
+    # rrelu/gumbel_softmax thread their key like dropout (traced arg ->
+    # cache hit); randomness must differ per call and the global generator
+    # must never hold a tracer afterwards
     from paddle_tpu.base import global_state
 
     paddle.seed(123)
@@ -298,6 +351,9 @@ def test_rng_ops_stay_random_and_generator_stays_clean():
     g1 = paddle.nn.functional.gumbel_softmax(_t(np.zeros((2, 8))))
     g2 = paddle.nn.functional.gumbel_softmax(_t(np.zeros((2, 8))))
     assert not np.array_equal(g1.numpy(), g2.numpy())
+    for op in ("rrelu", "gumbel_softmax"):
+        s = _op_stats(op)
+        assert s["misses"] == 1 and s["hits"] == 1 and s["bypasses"] == 0, (op, s)
     key = global_state.default_generator._key
     assert not isinstance(key, jax.core.Tracer)
     paddle.rand([4])  # the stream still serves draws

@@ -48,7 +48,7 @@ def test_train_step_loss_decreases():
                      loss_fn=lambda x: crit(model(x), x))
     first = float(step(ids).numpy())
     for _ in range(10):
-        last = float(step(ids).numpy())
+        last = float(step(ids).numpy())  # noqa: TS107 (test asserts per-step loss on purpose)
     assert last < first, (first, last)
 
 

@@ -78,7 +78,7 @@ def test_train_step_loss_decreases():
     step = TrainStep(model=model, optimizer=opt, loss_fn=lambda x: crit(model(x), x))
     first = float(step(ids).numpy())
     for _ in range(4):
-        last = float(step(ids).numpy())
+        last = float(step(ids).numpy())  # noqa: TS107 (test asserts per-step loss on purpose)
     assert np.isfinite(last) and last < first
 
 

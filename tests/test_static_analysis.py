@@ -239,6 +239,106 @@ class TestTraceSafetyLinter:
         findings = lint_source("def broken(:\n", "bad.py")
         assert _codes(findings) == {"TS000"}
 
+    # ---- TS107: per-step host syncs in train-step loops (ISSUE 5) ------
+    def test_ts107_sync_inside_step_loop_flagged(self):
+        src = ('for i, batch in enumerate(loader):\n'
+               '    loss = step(batch)\n'
+               '    losses.append(float(loss.numpy()))\n')
+        findings = lint_source(src, "loop.py")
+        assert _codes(findings) == {"TS107"}
+        assert findings[0].location == "loop.py:3"
+
+    def test_ts107_block_until_ready_in_train_batch_loop(self):
+        src = ('while running:\n'
+               '    out = model.train_batch(xs, ys)\n'
+               '    out[0].block_until_ready()\n')
+        assert _codes(lint_source(src, "loop.py")) == {"TS107"}
+
+    def test_ts107_train_batch_body_is_a_step_region(self):
+        src = ('class M:\n'
+               '    def train_batch(self, xs):\n'
+               '        loss = self._train_step(*xs)\n'
+               '        return [float(loss.numpy())]\n')
+        assert _codes(lint_source(src, "m.py")) == {"TS107"}
+        # unconditional: a train_batch computing its loss inline (no
+        # step-named call) is still the per-step path
+        src_inline = ('class M:\n'
+                      '    def train_batch(self, x):\n'
+                      '        loss = self.model(x).mean()\n'
+                      '        return [float(loss)]\n')
+        assert _codes(lint_source(src_inline, "m.py")) == {"TS107"}
+
+    def test_ts107_keyword_style_step_call_marks_the_loop(self):
+        src = ('for batch in loader:\n'
+               '    loss = m.train_batch(inputs=xs, labels=ys)\n'
+               '    v = float(loss[0].numpy())\n')
+        assert _codes(lint_source(src, "loop.py")) == {"TS107"}
+
+    def test_ts107_sync_in_nested_loop_inside_step_loop_flagged(self):
+        # the inner for runs once per training step: still a per-step sync
+        src = ('for batch in loader:\n'
+               '    loss = step(batch)\n'
+               '    for k in range(3):\n'
+               '        rows.append(float(loss))\n')
+        findings = lint_source(src, "loop.py")
+        assert _codes(findings) == {"TS107"}
+        assert findings[0].location == "loop.py:4"
+
+    def test_ts107_zero_arg_step_calls_do_not_mark_a_loop(self):
+        # optimizer.step()/profiler.step()/scheduler.step() are not train
+        # steps, and host arithmetic in float()/int() is not a device sync
+        src = ('for batch in loader:\n'
+               '    opt.step()\n'
+               '    elapsed = int(time.time())\n'
+               '    ratio = float(done / total)\n')
+        assert lint_source(src, "loop.py") == []
+
+    def test_ts107_scheduler_step_with_metric_does_not_mark_epoch_loop(self):
+        # ReduceOnPlateau-style scheduler.step(metric): the epoch loop's
+        # boundary sync stays sanctioned — only bare-name step(...) (the
+        # TrainStep convention) marks a loop under the generic name
+        src = ('for epoch in range(10):\n'
+               '    for batch in loader:\n'
+               '        loss = step(batch)\n'
+               '    scheduler.step(loss)\n'
+               '    print(float(loss.numpy()))\n')
+        assert lint_source(src, "loop.py") == []
+
+    def test_ts107_host_float_of_compound_expr_in_step_loop_is_clean(self):
+        src = ('for batch in loader:\n'
+               '    loss = step(batch)\n'
+               '    pct = float(i / n)\n'        # host arithmetic: clean
+               '    bad = float(loss)\n')        # device scalar: flagged
+        findings = lint_source(src, "loop.py")
+        assert _codes(findings) == {"TS107"}
+        assert [f.location for f in findings] == ["loop.py:4"]
+
+    def test_ts107_sync_after_the_loop_is_clean(self):
+        src = ('for batch in loader:\n'
+               '    loss = step(batch)\n'
+               'final = float(loss.numpy())\n')
+        assert lint_source(src, "loop.py") == []
+
+    def test_ts107_epoch_level_sync_outside_step_loop_is_clean(self):
+        # the sync sits in the OUTER (epoch) loop, after the inner step
+        # loop: a boundary sync, exactly the sanctioned pattern
+        src = ('for epoch in range(10):\n'
+               '    for batch in loader:\n'
+               '        loss = step(batch)\n'
+               '    epoch_loss = float(loss.numpy())\n')
+        assert lint_source(src, "loop.py") == []
+
+    def test_ts107_loop_without_step_call_is_clean(self):
+        src = ('for t in tensors:\n'
+               '    rows.append(t.numpy())\n')
+        assert lint_source(src, "loop.py") == []
+
+    def test_ts107_noqa_suppresses(self):
+        src = ('for batch in loader:\n'
+               '    loss = step(batch)\n'
+               '    v = float(loss.numpy())  # noqa: TS107\n')
+        assert lint_source(src, "loop.py") == []
+
 
 # ---------------------------------------------------------------- registry
 class TestRegistryGate:
