@@ -193,3 +193,10 @@ try:
     __all__.append("geometric")
 except ImportError:
     pass
+
+try:
+    from . import serving  # noqa: F401
+
+    __all__.append("serving")
+except ImportError:
+    pass

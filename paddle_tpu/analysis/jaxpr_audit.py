@@ -55,6 +55,21 @@ JX321  miss ladder             an op with more cache misses than the key
 JX322  eviction thrash         evictions rival hits across the cache: the
                                LRU capacity is below the working set
 
+Serving audit (JX33x, over a ``serving.ServingEngine``'s warm-compile
+counters — the multi-tenant continuous-batching tier; see
+:func:`audit_serving`, reported under the ``serving`` lint family):
+
+JX330  serving retrace         the engine's batched program compiled new
+                               specializations AFTER warmup — per-request
+                               recompiles in the steady state break the
+                               latency SLO (a request outside the warmed
+                               ladder, or a shape leaking past the
+                               pad-to-bucket step) (error)
+JX331  cold ladder             the engine serves without warmup, or rungs
+                               of its bucket ladder were never
+                               warm-compiled: the first live request on a
+                               cold rung pays the compile (warning)
+
 Entry points: ``CompiledFunction.audit()`` / ``TrainStep.audit()`` (this
 module's :func:`audit_compiled_function`), and the ``jaxpr`` analyzer of
 ``python -m tools.lint`` which audits a freshly built representative
@@ -458,6 +473,91 @@ def audit_kernel_cache(stats=None, max_keys_per_op=None,
             "executables are rebuilt as fast as they are reused",
             "kernel_cache"))
     return findings
+
+
+def audit_serving(engine) -> List[Finding]:
+    """JX33x: the serving tier's retrace-free contract, from a
+    ``ServingEngine``'s (or any duck-typed equivalent's) warm-compile
+    counters. Pure counter reads — safe on a live engine mid-traffic.
+
+    The contract: after ``warmup()`` compiled every rung of the bucket
+    ladder, steady-state traffic replays those executables and NEVER
+    traces again — ``compiles_after_warmup`` must stay 0. Anything else
+    means a per-request compile is hiding inside the latency SLO.
+    """
+    findings: List[Finding] = []
+    name = "serving"
+    delta = getattr(engine, "compiles_after_warmup", None)
+    if delta is None:
+        findings.append(Finding(
+            "serving", "JX331", "warning",
+            "engine serves without warmup(): the first request on every "
+            "bucket rung pays its compile inside the request latency",
+            name))
+    elif delta > 0:
+        findings.append(Finding(
+            "serving", "JX330", "error",
+            f"{delta} new compiled specialization(s) AFTER warmup — "
+            "steady-state serving must replay the warmed ladder only; a "
+            "request shape is escaping the pad-to-bucket step or the "
+            "ladder does not cover the traffic", name))
+
+    # ladder coverage: rungs never warmed serve their first request cold
+    predictor = getattr(engine, "predictor", None)
+    prog = getattr(predictor, "_batch_program", None)
+    if prog is not None and getattr(prog, "warmed", None) is not None:
+        missing = sorted(set(prog.ladder) - set(prog.warmed))
+        if missing and delta is not None:
+            findings.append(Finding(
+                "serving", "JX331", "warning",
+                f"bucket rungs {missing} were never warm-compiled — the "
+                "first live batch assembled at those rungs compiles "
+                "mid-traffic", name))
+    return findings
+
+
+def record_demo_engine(tmpdir: str):
+    """Build, warm and briefly drive the representative serving engine the
+    ``serving`` lint analyzer audits: a tiny exported MLP behind a 3-rung
+    ladder serving two tenants' mixed-size requests. One definition so the
+    CLI and the test gate audit the SAME engine."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from ..base import global_state
+    from ..profiler.pipeline import ServingStats
+
+    gen = global_state.default_generator
+    prev_seed = gen._seed
+    prev_cell = gen._cell
+    prev_key = None if prev_cell is None else prev_cell._value
+    try:
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        net.eval()
+        prefix = tmpdir + "/demo_served"
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.static.InputSpec([None, 8],
+                                                            "float32")])
+    finally:
+        gen._seed = prev_seed
+        if prev_cell is None:
+            gen._cell = None
+        else:
+            gen._cell = prev_cell
+            prev_cell._replace_value(prev_key)
+
+    from ..serving import ServingEngine
+
+    engine = ServingEngine(prefix, buckets=[1, 2, 4],
+                           stats=ServingStats())  # private stats: no global bleed
+    engine.warmup()
+    rs = np.random.RandomState(0)
+    for tenant, n in (("a", 1), ("b", 3), ("a", 2), ("b", 4)):
+        engine.run(tenant, rs.randn(n, 8).astype(np.float32))
+    engine.shutdown(drain=True)
+    return engine
 
 
 def record_demo_step():

@@ -125,6 +125,23 @@ define_flag("metric_sync_every", 0,
             "hapi.Model.fit default for how often (in steps) the "
             "MetricBuffer materializes device metrics to host floats; "
             "0 defers to the loop's log_freq (log-boundary syncs only)")
+define_flag("serving_max_batch", 64,
+            "serving tier: largest batch bucket — the batch ladder is the "
+            "powers-of-two rungs up to this; one warm-compiled "
+            "specialization per rung (paddle_tpu.serving)")
+define_flag("serving_max_queue", 1024,
+            "serving tier: global admission cap on queued samples across "
+            "tenants; a submit beyond it is rejected (AdmissionError)")
+define_flag("serving_tenant_quota", 256,
+            "serving tier: per-tenant cap on in-flight samples "
+            "(queued + executing); <=0 disables the per-tenant gate")
+define_flag("serving_batch_timeout_ms", 2.0,
+            "serving tier: how long the scheduler waits for more requests "
+            "before dispatching a partially filled batch (continuous "
+            "batching window)")
+define_flag("serving_slo_ms", 50.0,
+            "serving tier: the latency SLO the bench/stats report "
+            "requests/sec against (enqueue->complete, per request)")
 define_flag("cost_while_default_trips", 1,
             "cost model: trip-count multiplier assumed for a while-loop "
             "whose counter pattern cannot be statically derived (1 keeps "

@@ -110,11 +110,52 @@ def _freeze(v, depth=0):
     return v
 
 
+# code object -> content token. CPython code equality includes
+# co_firstlineno, so the same kernel text at two call sites (or a factory
+# re-exec'd at different lines) hashes apart and churns the cache with
+# duplicate executables. The token hashes code CONTENT — bytecode, consts
+# (recursing into nested code, so closures holding fresh inner lambdas
+# collapse too), names — and drops filename/lineno. Memoized per code
+# object: the content walk runs once per call site, the hot path pays one
+# dict hit.
+_CODE_TOKENS: dict = {}
+
+
+def _const_token(c):
+    """Type-aware token for one co_consts entry: ``1``, ``1.0`` and
+    ``True`` are ==/hash-equal in Python but stage different programs, so
+    a plain tuple compare would collide ``x * 1`` with ``x * 1.0`` (code
+    objects themselves compare constants type-aware — keep that)."""
+    if hasattr(c, "co_code"):
+        return _code_token(c)
+    if isinstance(c, (bool, int, float, complex)):
+        return (type(c), c)
+    if isinstance(c, tuple):
+        return ("__tuple__", tuple(_const_token(x) for x in c))
+    if isinstance(c, frozenset):
+        return ("__fset__", frozenset(_const_token(x) for x in c))
+    return c  # str/bytes/None/Ellipsis: type-unambiguous
+
+
+def _code_token(code):
+    tok = _CODE_TOKENS.get(code)
+    if tok is None:
+        consts = tuple(_const_token(c) for c in code.co_consts)
+        tok = ("__code__", code.co_code, consts, code.co_names,
+               code.co_argcount, code.co_posonlyargcount,
+               code.co_kwonlyargcount, code.co_flags,
+               code.co_freevars, code.co_cellvars)
+        _CODE_TOKENS[code] = tok
+    return tok
+
+
 def _fn_key(fn, depth=0):
-    """Identity of the kernel computation: code object + frozen closure
-    cells (+ defaults). Per-call-site lambdas closing over the same attr
-    values collapse to one key; cells holding fresh inner lambdas recurse
-    into *their* code so wrapper layers don't churn the cache."""
+    """Identity of the kernel computation: code CONTENT token + frozen
+    closure cell values (+ defaults). Call sites with identical code —
+    even at different lines/files, even when their cells hold fresh inner
+    lambdas — collapse to one key: cells hash by VALUE (``_freeze`` of the
+    contents, recursing through :func:`_code_token` for function values),
+    never by cell identity."""
     import functools
 
     if isinstance(fn, functools.partial):
@@ -129,7 +170,7 @@ def _fn_key(fn, depth=0):
     if code is None:
         return fn  # builtin / C function: stable by identity
     cells = getattr(fn, "__closure__", None) or ()
-    return (code,
+    return (_code_token(code),
             _freeze(getattr(fn, "__defaults__", None), depth),
             _freeze(getattr(fn, "__kwdefaults__", None), depth),
             tuple(_freeze(c.cell_contents, depth) for c in cells))
@@ -334,19 +375,37 @@ def lookup(op: str, fn, values: Sequence[Any], attrs: dict,
         _bypass(op, "denied")
         return None
     try:
-        spec_parts = []
-        diff = set(diff_idx)
+        n = len(values)
+        spec_parts = [None] * n  # pre-sized: no list growth on the hot path
         traced_idx = []
-        for i, v in enumerate(values):
-            kind = _arg_kind(v)
-            if kind == _TRACER:
-                _bypass(op, "tracer")
-                return None
-            if kind == _ARRAY:
-                traced_idx.append(i)
-                spec_parts.append((v.shape, v.dtype, i in diff))
-            else:
-                spec_parts.append(("__static__", _freeze(v)))
+        if diff_idx:
+            diff = set(diff_idx)
+            for i in range(n):
+                v = values[i]
+                kind = _arg_kind(v)
+                if kind == _TRACER:
+                    _bypass(op, "tracer")
+                    return None
+                if kind == _ARRAY:
+                    traced_idx.append(i)
+                    spec_parts[i] = (v.shape, v.dtype, i in diff)
+                else:
+                    spec_parts[i] = ("__static__", _freeze(v))
+        else:
+            # no-grad fast path: on single-primitive ops the key build IS
+            # the dispatch cost — skip the diff-set allocation and the
+            # per-arg membership test entirely
+            for i in range(n):
+                v = values[i]
+                kind = _arg_kind(v)
+                if kind == _TRACER:
+                    _bypass(op, "tracer")
+                    return None
+                if kind == _ARRAY:
+                    traced_idx.append(i)
+                    spec_parts[i] = (v.shape, v.dtype, False)
+                else:
+                    spec_parts[i] = ("__static__", _freeze(v))
         key = (op, _fn_key(fn), tuple(spec_parts),
                _freeze(attrs) if attrs else None)
         hash(key)

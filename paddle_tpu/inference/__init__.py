@@ -12,7 +12,8 @@ arrays (copy_from_cpu = host→HBM transfer, copy_to_cpu = fetch).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import threading
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -146,15 +147,81 @@ class Tensor_:
             self._value = self._value.reshape(shape)
 
 
+class _BatchProgram:
+    """The warm-compiled batched serving program, shared (zero-copy) by
+    every clone of a Predictor: weights live on device once, the jitted
+    runner keeps one compiled specialization per bucket rung, and a
+    trace-counter incremented inside the traced body is the recompile
+    proof — after :meth:`warmup` covers the ladder, steady-state traffic
+    must leave ``traces`` unchanged (``analysis`` JX330 audits exactly
+    this delta)."""
+
+    def __init__(self, layer, dynamic_axes: Sequence, ladder: Sequence[int]):
+        import jax
+
+        self._exported = layer._exported
+        self._params = jax.device_put(layer._params)
+        self.dynamic_axes = {int(i): int(ax) for i, ax in dynamic_axes}
+        self.ladder = sorted(int(b) for b in ladder)
+        self.traces = 0          # += 1 per compiled specialization
+        self.warmed: List[int] = []
+        self._lock = threading.Lock()
+
+        def _fwd(params, *args):
+            # runs under trace only: one tick per (re)compile, zero per replay
+            self.traces += 1
+            return self._exported.call(params, *args)
+
+        # serving-step donation idiom (SNIPPETS [1]/[2]): the padded input
+        # buffers are dead after the call — donate them so XLA reuses the
+        # staging memory across steps. Params are NOT donated (shared state).
+        n_in = len(layer._meta.get("input_shapes") or []) or 1
+        try:
+            backend = jax.devices()[0].platform
+        except Exception:
+            backend = "cpu"
+        donate = tuple(range(1, 1 + n_in)) if backend == "tpu" else ()
+        self._jitted = jax.jit(_fwd, donate_argnums=donate)
+
+    def warmup(self, dtype_shapes: Sequence) -> None:
+        """Compile every ladder rung once (zeros of the recorded specs) so
+        live traffic replays warm executables. Idempotent per rung."""
+        with self._lock:
+            for bucket in self.ladder:
+                if bucket in self.warmed:
+                    continue
+                zeros = [np.zeros(self._bucket_shape(i, s, bucket), np.dtype(d))
+                         for i, (s, d) in enumerate(dtype_shapes)]
+                self(zeros, bucket)
+                self.warmed.append(bucket)
+
+    def _bucket_shape(self, idx, spec_shape, bucket):
+        # dynamic axes were recorded as None in the spec; fixed-shape
+        # exports have all-int specs and a single-rung ladder
+        return tuple(bucket if d is None else d for d in spec_shape)
+
+    def __call__(self, arrays: Sequence, bucket: int):
+        """Run one assembled batch already padded to ``bucket``."""
+        return self._jitted(self._params, *arrays)
+
+
 class Predictor:
     """reference paddle.inference.Predictor (AnalysisPredictor,
     analysis_predictor.h:105) over a jit-exported program: the load-time
     "analysis" is deserializing the compiled StableHLO module; creation
     runs an AOT warmup call on the recorded input specs so the first real
     request serves at steady-state latency (with Config.set_optim_cache_dir
-    the executable deserializes from the persistent cache)."""
+    the executable deserializes from the persistent cache).
 
-    def __init__(self, config: Config, _shared_layer=None):
+    The serving tier's batched surface: models exported with a symbolic
+    batch dim (``InputSpec([None, ...])``) grow :meth:`run_many` — pad a
+    stacked request batch up the bucket ladder, replay the shared
+    warm-compiled specialization for that rung, slice the outputs back.
+    ``clone()`` shares the batch program too, so every tenant serves from
+    ONE set of device weights and ONE compiled ladder."""
+
+    def __init__(self, config: Config, _shared_layer=None,
+                 _shared_batch: Optional[_BatchProgram] = None):
         from ..jit.serialization import load as jit_load
 
         self.config = config
@@ -168,12 +235,15 @@ class Predictor:
         self._inputs: Dict[str, Tensor_] = {name: Tensor_(name) for name in self._input_names}
         self._outputs: List[Tensor_] = []
         self._input_shapes = meta.get("input_shapes")
+        self._dynamic_axes = list(meta.get("dynamic_axes") or [])
+        self._batch_program = _shared_batch
         if _shared_layer is None and self._input_shapes:
             self._warmup()
 
     def _warmup(self):
         try:
-            zeros = [np.zeros(s, np.dtype(d)) for s, d in self._input_shapes]
+            zeros = [np.zeros([1 if d is None else d for d in s], np.dtype(d_))
+                     for s, d_ in self._input_shapes]
             self._layer(*zeros)
         except Exception as e:  # best-effort, but never silent
             _warn(f"predictor warmup failed ({e!r}); the first real request "
@@ -181,9 +251,100 @@ class Predictor:
 
     def clone(self) -> "Predictor":
         """reference AnalysisPredictor::Clone — a predictor for another
-        serving thread SHARING the loaded weights/executable (XLA execution
-        is thread-safe; only the zero-copy IO handles are per-clone)."""
-        return Predictor(self.config, _shared_layer=self._layer)
+        serving thread/tenant SHARING the loaded weights/executable and the
+        warm-compiled batch ladder (XLA execution is thread-safe; only the
+        zero-copy IO handles are per-clone)."""
+        return Predictor(self.config, _shared_layer=self._layer,
+                         _shared_batch=self._batch_program)
+
+    # ------------------------------------------------------------ batched
+    @property
+    def dynamic_batch(self) -> bool:
+        """True when the export carries a symbolic batch dim (an InputSpec
+        dim was None at ``jit.save`` time): ``run_many`` can then serve any
+        bucket of the ladder from one serialized module."""
+        return bool(self._dynamic_axes)
+
+    @property
+    def batch_ladder(self) -> List[int]:
+        return list(self._ensure_batch_program().ladder)
+
+    @property
+    def compile_count(self) -> int:
+        """How many specializations the batched runner has traced — the
+        serving tier's recompile proof: warmup pays one per ladder rung,
+        steady state must add ZERO."""
+        return self._ensure_batch_program().traces
+
+    def _ensure_batch_program(self) -> _BatchProgram:
+        if self._batch_program is None:
+            from ..base.flags import get_flag
+            from ..jit.bucketing import powers_of_two_buckets
+
+            if getattr(self._layer, "_exported", None) is None:
+                raise ValueError(
+                    "run_many needs a program-carrying export (jit.save "
+                    "with input_spec); this model saved params only")
+            if self._dynamic_axes:
+                ladder = powers_of_two_buckets(
+                    1, int(get_flag("serving_max_batch")))
+            else:
+                # fixed-shape export: the ladder is the one exported batch
+                shape0 = (self._input_shapes or [([1], "float32")])[0][0]
+                ladder = [int(shape0[0])]
+            self._batch_program = _BatchProgram(
+                self._layer, self._dynamic_axes, ladder)
+        return self._batch_program
+
+    def set_batch_ladder(self, buckets: Sequence[int]) -> None:
+        """Override the batch-bucket ladder (before :meth:`warmup_ladder`;
+        fixed-shape exports cannot re-ladder)."""
+        prog = self._ensure_batch_program()
+        if not self.dynamic_batch and list(buckets) != prog.ladder:
+            raise ValueError("fixed-shape export: ladder is pinned to "
+                             f"{prog.ladder}")
+        prog.ladder = sorted(int(b) for b in buckets)
+
+    def warmup_ladder(self) -> List[int]:
+        """AOT-compile every rung of the batch ladder; returns the rungs."""
+        prog = self._ensure_batch_program()
+        prog.warmup(self._input_shapes or [])
+        return list(prog.warmed)
+
+    def run_many(self, inputs: Sequence[np.ndarray], n: Optional[int] = None):
+        """Serve a stacked request batch: each array in ``inputs`` carries
+        ``n`` samples on its dynamic (batch) axis; the batch is padded up
+        the bucket ladder, run through the shared warm-compiled
+        specialization for that rung, and the outputs are sliced back to
+        ``n`` on axis 0. Returns a list of np arrays (one per output
+        leaf). Bit-exact with per-request :meth:`run`: padding rows never
+        feed back into real rows (row-independent inference programs)."""
+        import jax
+
+        from ..jit.bucketing import bucket_for
+
+        prog = self._ensure_batch_program()
+        arrays = [np.asarray(a) for a in inputs]
+        if n is None:
+            idx0, ax0 = (self._dynamic_axes or [(0, 0)])[0]
+            n = arrays[idx0].shape[ax0]
+        bucket = bucket_for(n, prog.ladder)
+        if bucket != n:
+            padded = []
+            dyn = (prog.dynamic_axes
+                   or {i: 0 for i in range(len(arrays))})
+            for i, a in enumerate(arrays):
+                if i in dyn:
+                    ax = dyn[i]
+                    widths = [(0, 0)] * a.ndim
+                    widths[ax] = (0, bucket - n)
+                    a = np.pad(a, widths)
+                padded.append(a)
+            arrays = padded
+        out = prog(arrays, bucket)
+        leaves = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda x: hasattr(x, "shape"))
+        return [np.asarray(leaf)[:n] for leaf in leaves]
 
     def get_input_shapes(self):
         return {n: list(s) for n, (s, _) in zip(

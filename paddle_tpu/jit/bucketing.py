@@ -11,6 +11,8 @@ never silently falls back to eager.
 
 Pieces:
 - ``powers_of_two_buckets`` / ``bucket_for`` — the ladder
+- ``assemble_bucket``     — mixed-size serving batch assembly: how many
+  FIFO requests to take and which rung to pad them to (serving tier)
 - ``pad_to_bucket``       — right-pad one array along an axis
 - ``BucketedFunction``    — wraps ``functionalize``; pads declared args
   before dispatch (loss masking stays the caller's contract, as with any
@@ -43,6 +45,51 @@ def bucket_for(n: int, buckets: Sequence[int]) -> int:
         if n <= b:
             return int(b)
     raise ValueError(f"length {n} exceeds largest bucket {buckets[-1]}")
+
+
+def assemble_bucket(counts: Sequence[int], buckets: Sequence[int],
+                    max_total: Optional[int] = None):
+    """Mixed-size batch assembly for the serving tier: given the FIFO
+    sample counts of pending requests, pick how many leading requests to
+    take and the ladder rung to pad them to. Returns ``(k, bucket)`` —
+    take ``counts[:k]`` and pad their ``sum`` up to ``bucket`` — or
+    ``(0, None)`` when nothing fits.
+
+    Policy: greedy FIFO fill, then top up the pad for free — after the
+    rung is fixed by the greedy prefix, any further requests that fit in
+    the rung's padding slots ride along at zero extra compute (the pad
+    rows were going to be multiplied either way). FIFO order is never
+    violated (no reordering ahead of an older request), so per-tenant
+    latency stays predictable under load.
+    """
+    cap = int(max_total) if max_total else int(buckets[-1])
+    cap = min(cap, int(buckets[-1]))
+    total = 0
+    k = 0
+    for n in counts:
+        n = int(n)
+        if n > cap:
+            if k == 0:
+                raise ValueError(
+                    f"request of {n} samples exceeds the largest bucket "
+                    f"({cap}); split it or raise FLAGS_serving_max_batch")
+            break
+        if total + n > cap:
+            break
+        total += n
+        k += 1
+    if k == 0:
+        return 0, None
+    bucket = bucket_for(total, buckets)
+    # free top-up: later requests that fit inside the pad — still bounded
+    # by the caller's cap (the rung may exceed max_total when the greedy
+    # total landed between rungs; padding slots beyond the cap stay pad)
+    for n in counts[k:]:
+        if total + int(n) > bucket or total + int(n) > cap:
+            break
+        total += int(n)
+        k += 1
+    return k, bucket
 
 
 def pad_to_bucket(value, axis: int, bucket: int, pad_value=0):

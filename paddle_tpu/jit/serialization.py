@@ -3,7 +3,10 @@
 Reference (python/paddle/jit/api.py jit.save -> translated_layer.py) exports
 a static Program + params. TPU-native export: the layer's compiled forward is
 serialized as a StableHLO module (jax.export) next to the state_dict; load
-rebuilds a callable TranslatedLayer that runs the module via jax. Where
+rebuilds a callable TranslatedLayer that runs the module via jax. An
+InputSpec dim of None exports as a shared SYMBOLIC batch dim (shape
+polymorphism), the serving tier's one-module-any-batch contract — the
+Predictor's bucket ladder compiles per-rung specializations from it. Where
 jax.export is unavailable for a program, falls back to pickling the
 state_dict + re-tracing on load from the saved Layer class is NOT attempted
 (matching the reference's requirement of InputSpec at save time).
@@ -44,20 +47,33 @@ def save(layer, path, input_spec=None, **configs):
 
         from ..base import dtype as dtype_mod
 
-        def _as_shaped(s):
+        # A None dim in an InputSpec becomes the shared symbolic batch dim
+        # "b" (jax.export shape polymorphism): the exported module then
+        # serves ANY batch size, and the serving tier warm-compiles one
+        # specialization per bucket rung instead of one export per shape.
+        # All None dims share ONE symbol — mixed-rate dims would need a
+        # per-dim ladder the bucket scheduler does not assemble.
+        sym_b = []  # created lazily: symbolic_shape costs an export import
+        dynamic_axes = []
+
+        def _sym():
+            if not sym_b:
+                sym_b.append(jax_export.symbolic_shape("b")[0])
+            return sym_b[0]
+
+        def _as_shaped(s, idx):
             if isinstance(s, Tensor):
                 return unwrap(s)
             if hasattr(s, "shape") and hasattr(s, "dtype"):  # InputSpec
                 shape = list(s.shape)
-                if any(d is None for d in shape):
-                    raise ValueError(
-                        "jit.save requires concrete dims in InputSpec "
-                        f"(got {shape}); XLA export is static-shape"
-                    )
+                for ax, d in enumerate(shape):
+                    if d is None:
+                        dynamic_axes.append((idx, ax))
+                        shape[ax] = _sym()
                 return jax.ShapeDtypeStruct(tuple(shape), dtype_mod.np_dtype(s.dtype))
             return s
 
-        leaves = [_as_shaped(s) for s in input_spec]
+        leaves = [_as_shaped(s, i) for i, s in enumerate(input_spec)]
         params = {k: v._value for k, v in state.items()}
 
         modes = [(l, l.training) for l in layer.sublayers(include_self=True)]
@@ -90,8 +106,12 @@ def save(layer, path, input_spec=None, **configs):
             f.write(exported.serialize())
         meta["has_program"] = True
         meta["n_inputs"] = len(leaves)
-        meta["input_shapes"] = [(list(a.shape), str(a.dtype))
-                                for a in args_shaped]
+        # symbolic dims pickle poorly and mean "any size" anyway: record None
+        meta["input_shapes"] = [
+            ([d if isinstance(d, int) else None for d in a.shape],
+             str(a.dtype))
+            for a in args_shaped]
+        meta["dynamic_axes"] = dynamic_axes
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f)
 

@@ -92,6 +92,129 @@ class PipelineStats:
 pipeline_stats = PipelineStats()
 
 
+class ServingStats:
+    """Request-phase accounting for the serving tier (paddle_tpu/serving):
+    every completed request reports its enqueue→admit→dispatch→complete
+    timestamps, every scheduler pass samples the queue depth, and every
+    dispatched batch reports its fill. The summary is the bench's
+    ``extras.serving`` payload: p50/p99 end-to-end latency, requests/sec,
+    and requests/sec *within the SLO* (FLAGS_serving_slo_ms) — the
+    EQuARX-style accounting discipline: a serving tier is measured in
+    admitted work per second at a latency bound, not raw throughput.
+
+    Latency samples are kept in a bounded ring (last ``max_samples``
+    requests) so percentile math never grows with uptime.
+    """
+
+    def __init__(self, max_samples: int = 8192):
+        self._lock = threading.Lock()
+        self._max_samples = int(max_samples)
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self.requests = 0
+            self.samples = 0
+            self.rejected = 0
+            self.batches = 0
+            self.padded_slots = 0
+            self.batch_slots = 0
+            self.queue_depth_sum = 0
+            self.queue_depth_peak = 0
+            self.depth_samples = 0
+            self._lat = []        # (total, queue_wait, exec) seconds, ring
+            self._t_first = None
+            self._t_last = None
+
+    # ------------------------------------------------------------ recording
+    def record_request(self, t_enqueue: float, t_admit: float,
+                       t_dispatch: float, t_complete: float, n: int = 1):
+        """One completed request's phase timestamps (perf_counter space)."""
+        with self._lock:
+            self.requests += 1
+            self.samples += int(n)
+            lat = (t_complete - t_enqueue, t_dispatch - t_admit,
+                   t_complete - t_dispatch)
+            self._lat.append(lat)
+            if len(self._lat) > self._max_samples:
+                del self._lat[: len(self._lat) - self._max_samples]
+            if self._t_first is None:
+                self._t_first = t_enqueue
+            self._t_last = max(self._t_last or t_complete, t_complete)
+
+    def record_rejected(self, n: int = 1):
+        with self._lock:
+            self.rejected += int(n)
+
+    def record_batch(self, n_samples: int, bucket: int):
+        """One dispatched batch: ``n_samples`` real rows padded to
+        ``bucket`` slots (fill ratio = batching efficiency)."""
+        with self._lock:
+            self.batches += 1
+            self.batch_slots += int(bucket)
+            self.padded_slots += int(bucket) - int(n_samples)
+
+    def record_queue_depth(self, depth: int):
+        with self._lock:
+            self.depth_samples += 1
+            self.queue_depth_sum += int(depth)
+            if depth > self.queue_depth_peak:
+                self.queue_depth_peak = int(depth)
+
+    # ------------------------------------------------------------ reporting
+    @staticmethod
+    def _pct(sorted_vals, q):
+        if not sorted_vals:
+            return None
+        idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+        return sorted_vals[idx]
+
+    def summary(self, slo_ms: float = None) -> dict:
+        if slo_ms is None:
+            from ..base.flags import get_flag
+
+            slo_ms = float(get_flag("serving_slo_ms"))
+        with self._lock:
+            total = sorted(t for t, _, _ in self._lat)
+            queue_w = sorted(q for _, q, _ in self._lat)
+            window = ((self._t_last - self._t_first)
+                      if self._t_first is not None and self._t_last else 0.0)
+            in_slo = sum(1 for t in total if t * 1e3 <= slo_ms)
+            out = {
+                "requests": self.requests,
+                "samples": self.samples,
+                "rejected": self.rejected,
+                "batches": self.batches,
+                "slo_ms": slo_ms,
+                "p50_ms": (round(self._pct(total, 0.50) * 1e3, 3)
+                           if total else None),
+                "p99_ms": (round(self._pct(total, 0.99) * 1e3, 3)
+                           if total else None),
+                "queue_wait_p50_ms": (round(self._pct(queue_w, 0.50) * 1e3, 3)
+                                      if queue_w else None),
+                "requests_per_sec": (round(self.requests / window, 1)
+                                     if window > 0 else None),
+                "samples_per_sec": (round(self.samples / window, 1)
+                                    if window > 0 else None),
+                "in_slo_fraction": (round(in_slo / len(total), 4)
+                                    if total else None),
+                "requests_per_sec_in_slo": (
+                    round(self.requests * (in_slo / len(total)) / window, 1)
+                    if total and window > 0 else None),
+                "batch_fill": (round(1.0 - self.padded_slots
+                                     / max(self.batch_slots, 1), 4)
+                               if self.batches else None),
+                "queue_depth_mean": (round(self.queue_depth_sum
+                                           / self.depth_samples, 2)
+                                     if self.depth_samples else None),
+                "queue_depth_peak": self.queue_depth_peak,
+            }
+        return out
+
+
+serving_stats = ServingStats()
+
+
 class timed:
     """``with timed(stats.add_dispatch): step(batch)`` — records the span."""
 

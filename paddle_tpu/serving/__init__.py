@@ -1,0 +1,40 @@
+"""Multi-tenant serving tier: continuous bucketed batching over
+warm-compiled predictors (the ROADMAP "millions of users" workload).
+
+Layers (each its own module, composable and separately testable):
+
+- :mod:`request_queue` — the front door: :class:`Request` futures,
+  per-tenant :class:`AdmissionController` (sample-denominated queue cap +
+  tenant quota, refusal at submit), the FIFO the scheduler drains;
+- :mod:`scheduler`     — continuous batch assembly: FIFO prefix →
+  ``jit.bucketing`` rung → ONE padded program call → rows scattered back;
+  re-assembly between every pair of steps picks up what arrived mid-step;
+- :mod:`engine`        — :class:`ServingEngine`: warm-compiles the bucket
+  ladder through ``inference.Predictor.run_many``'s shared
+  ``_BatchProgram``, clones the predictor per tenant (zero-copy weight
+  sharing), runs the scheduler thread, and proves zero steady-state
+  retraces (``compiles_after_warmup == 0``, audited by JX330).
+
+Latency accounting (enqueue→admit→dispatch→complete, queue depth,
+p50/p99, requests/sec at FLAGS_serving_slo_ms) flows through
+``profiler.pipeline.serving_stats``; ``bench.py`` publishes it as
+``extras.serving``.
+
+    engine = serving.ServingEngine("ckpt/model", buckets=[1, 2, 4, 8])
+    engine.warmup()
+    out, = engine.run("tenant-a", batch_of_3)       # blocks, 3 rows back
+    req = engine.submit("tenant-b", batch_of_5)     # future
+    ...
+    req.result()
+    engine.shutdown(drain=True)
+"""
+from .engine import ServingEngine
+from .request_queue import (AdmissionController, AdmissionError,
+                            RejectedError, Request, RequestQueue)
+from .scheduler import Scheduler, scatter_outputs, stack_requests
+
+__all__ = [
+    "AdmissionController", "AdmissionError", "RejectedError", "Request",
+    "RequestQueue", "Scheduler", "ServingEngine", "scatter_outputs",
+    "stack_requests",
+]

@@ -1,0 +1,259 @@
+"""Thread-safe request queue with per-tenant admission control.
+
+The front door of the serving tier: client threads :meth:`RequestQueue.submit`
+requests; the scheduler thread pops FIFO prefixes sized by the bucket
+ladder (:func:`jit.bucketing.assemble_bucket`). Admission is decided AT
+submit — a full queue or an over-quota tenant is told *now* (an
+:class:`AdmissionError` carries which gate refused), not after its request
+aged in a queue it could never clear. Quota is measured in SAMPLES, not
+requests: a tenant streaming batch-32 requests spends its budget 32x
+faster than one sending singletons.
+
+Every request carries its phase timestamps (enqueue → admit → dispatch →
+complete, ``time.perf_counter`` space); completion hands them to
+``profiler.pipeline.serving_stats`` so the latency accounting rides the
+same observability channel as the train-loop pipeline stats.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class AdmissionError(RuntimeError):
+    """A submit the admission controller refused: ``reason`` is ``"queue"``
+    (global sample cap) or ``"tenant"`` (per-tenant in-flight quota)."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+class RejectedError(RuntimeError):
+    """Raised by :meth:`Request.result` when the queue shut down before the
+    request was served."""
+
+
+_req_ids = itertools.count()
+
+
+class Request:
+    """One inference request: ``n`` samples stacked on each input's batch
+    axis. The submitting thread blocks in :meth:`result`; the scheduler
+    thread completes it."""
+
+    __slots__ = ("id", "tenant", "inputs", "n", "t_enqueue", "t_admit",
+                 "t_dispatch", "t_complete", "_event", "_outputs", "_error")
+
+    def __init__(self, tenant: str, inputs: Sequence[np.ndarray], n: int):
+        self.id = next(_req_ids)
+        self.tenant = tenant
+        self.inputs = inputs
+        self.n = int(n)
+        self.t_enqueue = time.perf_counter()
+        self.t_admit = None
+        self.t_dispatch = None
+        self.t_complete = None
+        self._event = threading.Event()
+        self._outputs = None
+        self._error = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Block until served; returns the output arrays (``n`` rows each)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request {self.id} not served in {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._outputs
+
+    # scheduler side ------------------------------------------------------
+    def _complete(self, outputs) -> None:
+        self.t_complete = time.perf_counter()
+        self._outputs = outputs
+        self._event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self.t_complete = time.perf_counter()
+        self._error = error
+        self._event.set()
+
+
+class AdmissionController:
+    """Two admission gates, both in samples: a global queued-sample cap
+    (protects the scheduler's latency promise — a deeper queue than the
+    executor can clear inside the SLO is better refused than served late)
+    and a per-tenant in-flight cap (one chatty tenant cannot starve the
+    rest). In-flight = admitted and not yet completed, so quota releases
+    only at completion, covering execution occupancy too."""
+
+    def __init__(self, max_queue: Optional[int] = None,
+                 tenant_quota: Optional[int] = None):
+        from ..base.flags import get_flag
+
+        self.max_queue = int(get_flag("serving_max_queue")
+                             if max_queue is None else max_queue)
+        self.tenant_quota = int(get_flag("serving_tenant_quota")
+                                if tenant_quota is None else tenant_quota)
+        self._queued = 0
+        self._inflight: Dict[str, int] = {}
+        # own lock: try_admit runs on client threads (under the queue's
+        # condition), on_complete on the scheduler thread (no queue lock) —
+        # the read-modify-writes of _inflight must serialize regardless of
+        # which outer lock the caller holds
+        self._lock = threading.Lock()
+
+    def try_admit(self, tenant: str, n: int) -> Optional[str]:
+        """None = admitted (state charged); else the refusing gate."""
+        with self._lock:
+            if self.max_queue > 0 and self._queued + n > self.max_queue:
+                return "queue"
+            if (self.tenant_quota > 0
+                    and self._inflight.get(tenant, 0) + n > self.tenant_quota):
+                return "tenant"
+            self._queued += n
+            self._inflight[tenant] = self._inflight.get(tenant, 0) + n
+            return None
+
+    def on_dispatch(self, tenant: str, n: int) -> None:
+        with self._lock:
+            self._queued -= n
+
+    def on_complete(self, tenant: str, n: int) -> None:
+        with self._lock:
+            left = self._inflight.get(tenant, 0) - n
+            if left > 0:
+                self._inflight[tenant] = left
+            else:
+                self._inflight.pop(tenant, None)
+
+    def inflight(self, tenant: str) -> int:
+        with self._lock:
+            return self._inflight.get(tenant, 0)
+
+
+class RequestQueue:
+    """FIFO of admitted requests + the condition variable the scheduler
+    sleeps on. ``close()`` stops new submits; the scheduler keeps taking
+    until the queue is drained (graceful shutdown serves everything that
+    was admitted)."""
+
+    def __init__(self, admission: Optional[AdmissionController] = None,
+                 stats=None):
+        self._dq: deque = deque()
+        self._cond = threading.Condition()
+        self.admission = admission or AdmissionController()
+        self.closed = False
+        if stats is None:
+            from ..profiler.pipeline import serving_stats as stats
+        self.stats = stats
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._dq)
+
+    def depth_samples(self) -> int:
+        with self._cond:
+            return sum(r.n for r in self._dq)
+
+    def submit(self, request: Request) -> Request:
+        """Admit + enqueue, or raise :class:`AdmissionError` /
+        ``RuntimeError`` (closed). Stamps ``t_admit`` on success."""
+        with self._cond:
+            if self.closed:
+                raise RuntimeError("serving queue is closed")
+            gate = self.admission.try_admit(request.tenant, request.n)
+            if gate is not None:
+                self.stats.record_rejected()
+                raise AdmissionError(gate, (
+                    f"request of {request.n} samples refused by the "
+                    f"'{gate}' gate (tenant={request.tenant!r}: "
+                    f"{self.admission.inflight(request.tenant)} in flight, "
+                    f"queue={self.admission._queued} samples)"))
+            request.t_admit = time.perf_counter()
+            self._dq.append(request)
+            self._cond.notify()
+        return request
+
+    def take_batch(self, buckets, max_total: Optional[int] = None,
+                   timeout: Optional[float] = None,
+                   linger: float = 0.0):
+        """Scheduler side: block until requests are pending (or ``timeout``),
+        then pop the FIFO prefix :func:`assemble_bucket` selects. Returns
+        ``(requests, bucket)`` — or ``([], None)`` on timeout/closed-empty.
+
+        ``buckets`` may be a ladder list or a zero-arg callable returning
+        one; callables are resolved AFTER the wait, at assembly time, so a
+        predictor re-laddered while the scheduler slept applies to the
+        very batch that wakes it. ``max_total`` defaults to the ladder top.
+
+        ``linger`` is the continuous-batching window: once ANY request is
+        pending, wait up to that long for the rung to fill before
+        dispatching a padded batch (latency spent buying fill)."""
+        from ..jit.bucketing import assemble_bucket
+
+        deadline = (time.perf_counter() + timeout) if timeout else None
+        with self._cond:
+            while not self._dq:
+                if self.closed:
+                    return [], None
+                rest = (deadline - time.perf_counter()) if deadline else None
+                if rest is not None and rest <= 0:
+                    return [], None
+                self._cond.wait(rest if rest is not None else 0.1)
+            ladder = list(buckets()) if callable(buckets) else list(buckets)
+            cap = (min(int(max_total), int(ladder[-1])) if max_total
+                   else int(ladder[-1]))
+            if linger > 0 and not self.closed:
+                # a rung already full dispatches immediately; otherwise give
+                # late arrivals one window to ride the same program call
+                linger_until = time.perf_counter() + linger
+                while (sum(r.n for r in self._dq) < cap
+                       and not self.closed):
+                    rest = linger_until - time.perf_counter()
+                    if rest <= 0:
+                        break
+                    self._cond.wait(rest)
+                if callable(buckets):  # re-resolve: the linger also slept
+                    ladder = list(buckets())
+                    cap = (min(int(max_total), int(ladder[-1])) if max_total
+                           else int(ladder[-1]))
+            try:
+                k, bucket = assemble_bucket([r.n for r in self._dq], ladder,
+                                            cap)
+            except ValueError as e:
+                # oversized head (engine.submit gates this; a live ladder
+                # shrink can still race): fail ITS request, keep serving
+                bad = self._dq.popleft()
+                self.admission.on_dispatch(bad.tenant, bad.n)
+                self.admission.on_complete(bad.tenant, bad.n)
+                bad._fail(e)
+                return [], None
+            taken = [self._dq.popleft() for _ in range(k)]
+            for r in taken:
+                self.admission.on_dispatch(r.tenant, r.n)
+            return taken, bucket
+
+    def close(self) -> None:
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+
+    def fail_pending(self, error: BaseException) -> int:
+        """Complete every still-queued request with ``error`` (non-drain
+        shutdown). Returns how many were failed."""
+        with self._cond:
+            pending = list(self._dq)
+            self._dq.clear()
+            for r in pending:
+                self.admission.on_dispatch(r.tenant, r.n)
+                self.admission.on_complete(r.tenant, r.n)
+                r._fail(error)
+            return len(pending)

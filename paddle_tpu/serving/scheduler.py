@@ -1,0 +1,139 @@
+"""Continuous bucketed batch assembly: requests → padded device batch → rows.
+
+The scheduler is the piece between the queue and the warm-compiled
+predictor program: it stacks a FIFO prefix of mixed-size requests along
+the batch axis, pads the stack up to the bucket rung
+(:func:`jit.bucketing.assemble_bucket` picked), runs ONE program call,
+and scatters the output rows back to their requests. Re-batching is
+continuous — assembly happens again between every pair of steps, so
+requests that arrived while the previous batch computed ride the very
+next program call.
+
+Pure functions (:func:`stack_requests`, :func:`scatter_outputs`) do the
+array work so they unit-test without threads; :class:`Scheduler` is the
+one background thread that loops take → stack → execute → scatter.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .request_queue import Request, RequestQueue
+
+
+def stack_requests(requests: Sequence[Request], bucket: int,
+                   dynamic_axes: Dict[int, int],
+                   n_inputs: int) -> List[np.ndarray]:
+    """Concatenate each input across requests along its batch axis and
+    zero-pad up to ``bucket``. Inputs without a dynamic axis (static side
+    inputs of a partially dynamic export) are per-BATCH, not per-sample —
+    every batched request must carry the same value, verified bit-wise
+    (serving request 1's rows with request 0's side input would be a
+    silent cross-tenant data leak; a loud batch failure is the contract)."""
+    stacked = []
+    axes = dynamic_axes or {i: 0 for i in range(n_inputs)}
+    for i in range(n_inputs):
+        if i not in axes:
+            head = np.asarray(requests[0].inputs[i])
+            for r in requests[1:]:
+                if not np.array_equal(head, np.asarray(r.inputs[i])):
+                    raise ValueError(
+                        f"static input {i} differs across the assembled "
+                        "batch (per-batch side inputs must match bit-wise "
+                        "to share one program call)")
+            stacked.append(head)
+            continue
+        ax = axes[i]
+        parts = [np.asarray(r.inputs[i]) for r in requests]
+        cat = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=ax)
+        short = bucket - cat.shape[ax]
+        if short > 0:
+            widths = [(0, 0)] * cat.ndim
+            widths[ax] = (0, short)
+            cat = np.pad(cat, widths)
+        stacked.append(cat)
+    return stacked
+
+
+def scatter_outputs(outputs: Sequence[np.ndarray],
+                    requests: Sequence[Request]) -> List[List[np.ndarray]]:
+    """Split each output's leading axis back into per-request row blocks
+    (the padding tail is dropped). Output batch axis is 0 by the serving
+    export contract."""
+    per_request: List[List[np.ndarray]] = [[] for _ in requests]
+    offsets = []
+    pos = 0
+    for r in requests:
+        offsets.append(pos)
+        pos += r.n
+    for out in outputs:
+        arr = np.asarray(out)
+        for j, r in enumerate(requests):
+            per_request[j].append(arr[offsets[j]: offsets[j] + r.n])
+    return per_request
+
+
+class Scheduler:
+    """The serving tier's one executor thread: continuously drains the
+    queue into bucketed batches and hands them to ``execute`` (the
+    engine's predictor call). Crashes in ``execute`` fail only the batch
+    that triggered them — the loop survives and keeps serving."""
+
+    def __init__(self, queue: RequestQueue, execute: Callable,
+                 buckets, *, max_batch: Optional[int] = None,
+                 linger_s: float = 0.0, on_batch: Optional[Callable] = None):
+        self.queue = queue
+        self.execute = execute           # (requests, bucket) -> None
+        # a list, or a zero-arg callable for a LIVE ladder view (the engine
+        # passes the batch program's, so a re-laddered predictor takes
+        # effect at the very next assembly, no scheduler restart)
+        self.buckets = buckets
+        self.max_batch = max_batch
+        self.linger_s = float(linger_s)
+        self.on_batch = on_batch         # (n_samples, bucket, depth) tap
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def start(self) -> "Scheduler":
+        if self._thread is not None:
+            raise RuntimeError("scheduler already started")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="paddle-serving-scheduler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while True:
+            # buckets/max pass through RAW: take_batch resolves a callable
+            # ladder at assembly time, after its wait — no stale snapshot
+            requests, bucket = self.queue.take_batch(
+                self.buckets, self.max_batch, timeout=0.05,
+                linger=self.linger_s)
+            if not requests:
+                if self.queue.closed and len(self.queue) == 0:
+                    break
+                continue
+            now = time.perf_counter()
+            for r in requests:
+                r.t_dispatch = now
+            if self.on_batch is not None:
+                self.on_batch(sum(r.n for r in requests), bucket,
+                              self.queue.depth_samples())
+            try:
+                self.execute(requests, bucket)
+            except BaseException as e:  # noqa: BLE001 — batch-scoped fault wall
+                for r in requests:
+                    self.queue.admission.on_complete(r.tenant, r.n)
+                    r._fail(e)
+        self._stopped.set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the loop to exit (after ``queue.close()``)."""
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()

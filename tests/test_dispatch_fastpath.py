@@ -87,6 +87,39 @@ def test_scalar_arg_type_distinguishes_entries():
     assert _op_stats("multiply")["misses"] == 3
 
 
+def test_identical_code_call_sites_share_one_entry():
+    """ISSUE 6 satellite: the same kernel text compiled at different lines
+    (distinct code objects — CPython code equality includes firstlineno)
+    keys by code CONTENT and collapses to one cached executable; inner
+    lambdas held in closure cells collapse by value the same way."""
+    def site(pad):
+        src = "\n" * pad + "inner = lambda v: v * 2\nkern = lambda a: inner(a)"
+        ns = {}
+        exec(compile(src, "gen.py", "exec"), ns)  # noqa: S102 — test fixture
+        return ns["kern"]
+
+    k1, k2 = site(0), site(7)
+    assert k1.__code__ is not k2.__code__ and k1.__code__ != k2.__code__
+    a = _t(np.ones((4, 4)))
+    primitive("aux_sites", k1, [a])
+    primitive("aux_sites", k2, [a])
+    s = _op_stats("aux_sites")
+    assert s["misses"] == 1 and s["hits"] == 1
+
+
+def test_code_token_keeps_const_types_distinct():
+    """The content token must stay type-aware on constants: `x * 1` and
+    `x * 1.0` have ==-equal co_consts but stage different programs —
+    colliding them would replay the wrong output dtype."""
+    ki = lambda v: v * 1      # noqa: E731
+    kf = lambda v: v * 1.0    # noqa: E731
+    x = paddle.Tensor(np.array([3, 4], np.int32))
+    oi = primitive("aux_const", ki, [x])
+    of = primitive("aux_const", kf, [x])
+    assert oi.dtype.name == "int32" and of.dtype.name == "float32"
+    assert _op_stats("aux_const")["misses"] == 2
+
+
 def test_passthrough_ops_cache_too():
     # ISSUE 5 satellite: comparisons/argmax (non-differentiable dispatch)
     # ride the same fast path as primitive — slow-path-only before

@@ -1,10 +1,11 @@
 """CI gate: the repo itself passes its own static analysis.
 
-Runs all six ``paddle_tpu.analysis`` analyzers over the live codebase
-and asserts ZERO error-severity findings, so a regression (a new
+Runs all seven ``paddle_tpu.analysis`` analyzer families over the live
+codebase and asserts ZERO error-severity findings, so a regression (a new
 jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug,
 a host callback in a compiled step, a typo'd mesh axis, a cost-model
-budget blowout) fails tier-1 instead of rotting until pod scale. The
+budget blowout, a serving-tier steady-state recompile) fails tier-1
+instead of rotting until pod scale. The
 ``python -m tools.lint`` CLI contract (exit 0, machine-readable JSON
 with per-family wall-time, ``--include-tests``) is gated here too.
 """
@@ -93,6 +94,35 @@ def test_cost_model_clean_on_demo_step():
     assert [str(f) for f in findings] == []
 
 
+def test_trace_safety_covers_serving_tree():
+    """ISSUE 6 satellite: the serving/ subsystem is inside the
+    zero-findings gate — scanned (non-empty module list, guarding against
+    a silently skipped directory) and clean."""
+    import glob
+
+    from paddle_tpu.analysis.trace_safety import lint_paths
+
+    serving_dir = os.path.join(_REPO, "paddle_tpu", "serving")
+    modules = glob.glob(os.path.join(serving_dir, "*.py"))
+    assert len(modules) >= 3, modules  # __init__, request_queue, scheduler, engine
+    assert _errors(lint_paths([serving_dir])) == []
+
+
+def test_serving_audit_green_on_demo_engine(tmp_path):
+    """The representative serving engine holds the retrace-free contract:
+    warmed ladder, zero post-warmup compiles, no JX33x findings — and the
+    report carries real traffic (a dead engine would pass the finding
+    gate while proving nothing)."""
+    from paddle_tpu.analysis.jaxpr_audit import audit_serving, record_demo_engine
+
+    engine = record_demo_engine(str(tmp_path))
+    assert [str(f) for f in audit_serving(engine)] == []
+    assert engine.compiles_after_warmup == 0
+    report = engine.serving_report()
+    assert report["requests"] == 4 and report["batches"] >= 1
+    assert report["compiled_rungs"] == 3  # one per demo ladder rung
+
+
 def test_cli_exits_zero_with_machine_readable_findings(capsys):
     """`tools.lint --json --include-tests` over the repo: exit 0,
     parseable. Run in-process (the tests above already paid the analyzer
@@ -107,7 +137,7 @@ def test_cli_exits_zero_with_machine_readable_findings(capsys):
     assert payload["errors"] == 0
     assert payload["crashed"] == []
     assert set(payload["analyzers"]) == {"trace", "registry", "program",
-                                         "jaxpr", "spmd", "cost"}
+                                         "jaxpr", "spmd", "cost", "serving"}
     assert isinstance(payload["findings"], list)
     # per-family wall-time (CI satellite): one entry per analyzer run
     assert set(payload["timings_s"]) == set(payload["analyzers"])

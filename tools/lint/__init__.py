@@ -19,7 +19,12 @@ Runs the six ``paddle_tpu.analysis`` analyzers and reports findings:
 - **cost**:     the static jaxpr cost model (CM5xx) over the same
                 representative train step: oversized intermediates,
                 arithmetic-intensity cliffs, comm-bound collectives and
-                peak residency vs the FLAGS budgets.
+                peak residency vs the FLAGS budgets,
+- **serving**:  the serving tier's retrace-free contract (JX33x) over a
+                freshly built representative ServingEngine (export a tiny
+                model → warm the bucket ladder → drive mixed-size tenant
+                traffic → assert zero post-warmup compiles and full
+                ladder coverage).
 
 Exit-code contract (stable, CI-gateable):
   0 = no error-severity findings (warnings never gate)
@@ -41,7 +46,8 @@ import os
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd", "cost")
+_ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd", "cost",
+              "serving")
 
 
 def _source_paths(paths, include_tests=False):
@@ -157,14 +163,33 @@ def _run_cost(_paths, include_tests=False):
     return check_cost(_demo_step().cost())
 
 
+def _run_serving(_paths, include_tests=False):
+    """Build the representative serving engine (tiny exported MLP, warmed
+    3-rung ladder, two tenants' mixed-size traffic) and audit its
+    retrace-free contract (JX330/JX331, analysis/jaxpr_audit.py)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.analysis.jaxpr_audit import audit_serving, record_demo_engine
+
+    tmpdir = tempfile.mkdtemp(prefix="paddle_lint_serving_")
+    try:
+        engine = record_demo_engine(tmpdir)
+        return audit_serving(engine)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 _RUNNERS = {"trace": _run_trace, "registry": _run_registry,
             "program": _run_program, "jaxpr": _run_jaxpr,
-            "spmd": _run_spmd, "cost": _run_cost}
+            "spmd": _run_spmd, "cost": _run_cost,
+            "serving": _run_serving}
 
 # analyzer -> its finding-code family prefix, so a crash finding
 # (<PREFIX>999) stays visible under --select filters for that family
 _FAMILY_PREFIX = {"trace": "TS", "registry": "RC", "program": "PV",
-                  "jaxpr": "JX", "spmd": "SP", "cost": "CM"}
+                  "jaxpr": "JX", "spmd": "SP", "cost": "CM",
+                  "serving": "JX"}
 
 
 def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
