@@ -200,3 +200,10 @@ try:
     __all__.append("serving")
 except ImportError:
     pass
+
+try:
+    from . import observability  # noqa: F401
+
+    __all__.append("observability")
+except ImportError:
+    pass

@@ -31,8 +31,11 @@ analyzers that run at commit time:
   walker over the same retraced ClosedJaxprs (CM5xx), feeding
   ``CompiledFunction.cost()``, the planner's jaxpr-backed HBM estimates
   and bench's ``extras.cost_model``.
+- :mod:`telemetry_check` — the observability layer's own contract
+  (OB6xx): no unclosed span at trace export, no duplicate metric
+  registration, no blocking device sync inside a memory sampler.
 
-One CLI drives all six: ``python -m tools.lint`` (exit 1 on any
+One CLI drives them all: ``python -m tools.lint`` (exit 1 on any
 error-severity finding, 2 on an analyzer crash; ``--json`` for
 machine-readable output; ``--select``/``--ignore`` for code filters).
 """
@@ -45,10 +48,13 @@ __all__ = [
     "audit_compiled_function",
     "audit_jaxpr",
     "audit_kernel_cache",
+    "audit_telemetry",
     "check_cost",
     "check_registry",
     "check_spmd_paths",
     "check_spmd_source",
+    "check_telemetry_paths",
+    "check_telemetry_source",
     "cost_compiled_function",
     "cost_jaxpr",
     "lint_paths",
@@ -178,6 +184,24 @@ def check_spmd_paths(paths, **kwargs):
     from .spmd_check import check_paths as _impl
 
     return _impl(paths, **kwargs)
+
+
+def audit_telemetry(tracer=None, registry=None):
+    from .telemetry_check import audit_telemetry as _impl
+
+    return _impl(tracer, registry)
+
+
+def check_telemetry_paths(paths):
+    from .telemetry_check import check_paths as _impl
+
+    return _impl(paths)
+
+
+def check_telemetry_source(source, filename="<string>"):
+    from .telemetry_check import check_source as _impl
+
+    return _impl(source, filename)
 
 
 def check_spmd_source(source, filename="<string>", **kwargs):

@@ -1,0 +1,196 @@
+"""Telemetry contract checker (OB6xx): the observability layer's own gate.
+
+Telemetry that lies is worse than no telemetry: a span that never closed
+silently drops its wall time from the exported timeline, a metric name
+registered twice with two schemas splits one signal into two half-truths,
+and a "sync-free" memory sampler that sneaks in a blocking readback
+reintroduces exactly the per-step host sync the TS107 contract spent a PR
+eliminating. This module gates all three, wired as the ``telemetry``
+family of ``python -m tools.lint``:
+
+OB600  unclosed span at export   the span tracer holds open spans while a
+                                 trace is being exported/audited — an
+                                 instrumented region leaked its ``end()``
+                                 (an early return or exception path
+                                 outside a ``with`` block) and its time is
+                                 missing from the timeline (error)
+OB601  duplicate metric          a metric name was registered as two
+                                 different instrument kinds — the registry
+                                 recorded the schema collision and handed
+                                 back a detached instrument, so two code
+                                 paths now report into what looks like one
+                                 metric (error)
+OB602  device sync in sampler    static AST rule over the observability
+                                 sources: a sampler-scoped function (name
+                                 contains ``sample``) calls a blocking
+                                 device→host primitive (.numpy()/.item()/
+                                 .tolist()/.block_until_ready()/
+                                 np.asarray/jax.device_get) — memory
+                                 telemetry must read metadata and
+                                 allocator counters only, never force a
+                                 sync at a step boundary (error)
+
+Runtime checks (:func:`audit_telemetry`) are pure state reads — safe on
+the live process. The source rule (:func:`check_source` /
+:func:`check_paths`) shares the trace-safety ``# noqa:`` grammar.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from . import Finding
+
+_ANALYZER = "telemetry"
+
+# blocking device→host calls a sampler must never make
+_SYNC_ATTRS = {"numpy", "item", "tolist", "block_until_ready", "device_get",
+               "copy_to_cpu"}
+_SYNC_FN_NAMES = {"asarray", "array", "device_get"}
+
+
+def audit_telemetry(tracer=None, registry=None) -> List[Finding]:
+    """OB600/OB601 over live (or demo) tracer + registry state."""
+    findings: List[Finding] = []
+    if tracer is None or registry is None:
+        from ..observability import registry as _registry
+        from ..observability import tracer as _tracer
+
+        # `is None`, never truthiness: a tracer whose only content is
+        # LEAKED OPEN spans has len() == 0 and would otherwise be
+        # silently swapped for the global one — hiding the exact OB600
+        # condition this audit exists to catch
+        if tracer is None:
+            tracer = _tracer
+        if registry is None:
+            registry = _registry
+
+    open_spans = tracer.open_spans()
+    if open_spans:
+        names = ", ".join(sorted(set(open_spans))[:8])
+        findings.append(Finding(
+            _ANALYZER, "OB600", "error",
+            f"{len(open_spans)} span(s) still open at export/audit time "
+            f"({names}) — an instrumented region leaked its end() (early "
+            "return or exception outside a `with` block); the exported "
+            "timeline is silently missing that wall time", "tracer"))
+
+    for name, requested, existing in getattr(registry, "collisions", []):
+        findings.append(Finding(
+            _ANALYZER, "OB601", "error",
+            f"metric '{name}' registered as a {requested} but already "
+            f"exists as a {existing} — the second registrant got a "
+            "DETACHED instrument, so two code paths now report into what "
+            "looks like one metric; pick one kind or two names",
+            f"registry:{name}"))
+    return findings
+
+
+class _SamplerSyncChecker(ast.NodeVisitor):
+    """Flag blocking-readback calls inside one sampler-scoped function."""
+
+    def __init__(self, findings: List[Finding], filename: str, region: str):
+        self.findings = findings
+        self.filename = filename
+        self.region = region
+
+    def visit_Call(self, node):
+        func = node.func
+        label = None
+        if isinstance(func, ast.Attribute) and func.attr in _SYNC_ATTRS:
+            label = f".{func.attr}()"
+        elif isinstance(func, ast.Name) and func.id in _SYNC_FN_NAMES:
+            label = f"{func.id}(...)"
+        elif (isinstance(func, ast.Attribute)
+                and func.attr in _SYNC_FN_NAMES
+                and isinstance(func.value, ast.Name)):
+            # np.asarray(...) / jax.device_get(...)
+            label = f"{func.value.id}.{func.attr}(...)"
+        if label is not None:
+            self.findings.append(Finding(
+                _ANALYZER, "OB602", "error",
+                f"blocking device→host call {label} inside sampler "
+                f"'{self.region}' — memory telemetry must read array "
+                "metadata (.nbytes) and allocator counters "
+                "(device.memory_stats()) only; a sync here re-serializes "
+                "the step boundary the sampler is supposed to observe",
+                f"{self.filename}:{node.lineno}"))
+        self.generic_visit(node)
+
+
+def check_source(source: str, filename: str = "<string>") -> List[Finding]:
+    """OB602 over one module's source text."""
+    import re
+
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Finding(_ANALYZER, "OB000", "error",
+                        f"syntax error: {e.msg}",
+                        f"{filename}:{e.lineno or 0}")]
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and "sample" in node.name.lower()):
+            checker = _SamplerSyncChecker(findings, filename, node.name)
+            for stmt in node.body:
+                checker.visit(stmt)
+    # shared noqa grammar with the trace-safety linter
+    noqa_re = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.I)
+    lines = source.splitlines()
+    kept = []
+    for f in findings:
+        try:
+            lineno = int(f.location.rsplit(":", 1)[1])
+            m = noqa_re.search(lines[lineno - 1])
+        except (IndexError, ValueError):
+            kept.append(f)
+            continue
+        if m and (m.group("codes") is None or f.code in {
+                c.strip().upper() for c in m.group("codes").split(",")}):
+            continue
+        kept.append(f)
+    return kept
+
+
+def check_paths(paths: Sequence[str]) -> List[Finding]:
+    """OB602 over every ``.py`` file under the given paths (normally the
+    ``paddle_tpu/observability/`` tree)."""
+    from . import iter_py_files
+
+    findings: List[Finding] = []
+    for fname in iter_py_files(paths):
+        with open(fname, "r", encoding="utf-8") as fh:
+            findings.extend(check_source(fh.read(), fname))
+    return findings
+
+
+def record_demo_telemetry():
+    """Build and drive the representative telemetry session the
+    ``telemetry`` lint family audits: a private tracer + registry (no
+    global bleed) exercising every instrument kind and every track the
+    runtime emits on — spans open/close cleanly, metrics register once.
+    One definition so the CLI and the test gate audit the SAME session."""
+    import time
+
+    from ..observability.metrics import MetricsRegistry
+    from ..observability.tracing import SpanTracer
+
+    tracer = SpanTracer(enabled=True, max_events=256)
+    registry = MetricsRegistry()
+
+    registry.counter("demo.requests").inc(3, tenant="a")
+    registry.gauge("demo.depth").set(2)
+    hist = registry.histogram("demo.latency_ms")
+    for v in (1.0, 2.0, 4.0):
+        hist.observe(v)
+
+    t0 = time.perf_counter()
+    with tracer.span("train.step", track="train_loop"):
+        with tracer.span("kernel_cache.compile", track="dispatch",
+                         op="demo", signature="float32[2,2]"):
+            pass
+    tracer.emit("serving.request", t0, time.perf_counter() - t0,
+                track="serving.requests.demo", request_id=0, n=1)
+    tracer.instant("memory.sample", track="memory", live_bytes=0)
+    return tracer, registry

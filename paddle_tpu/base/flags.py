@@ -13,6 +13,10 @@ from typing import Any, Dict, Iterable, Mapping
 
 _lock = threading.Lock()
 _registry: Dict[str, "_Flag"] = {}
+# flag name -> callbacks fired (outside the lock) after set_flags changes
+# it — for subsystems that mirror a flag into a hot-path attribute (the
+# span tracer's `enabled`) instead of re-reading the registry per event
+_on_change: Dict[str, list] = {}
 
 
 class _Flag:
@@ -66,6 +70,17 @@ def set_flags(flags: Mapping[str, Any]) -> None:
             if f is None:
                 raise KeyError(f"flag '{name}' is not defined")
             f.value = _parse(value, f.type) if isinstance(value, str) and f.type is not str else f.type(value)
+    for name in flags:
+        for fn in _on_change.get(name, ()):
+            fn(_registry[name].value)
+
+
+def on_flag_change(name: str, fn) -> None:
+    """Register ``fn(new_value)`` to fire after :func:`set_flags` changes
+    ``name``. The flag must already be defined."""
+    if name not in _registry:
+        raise KeyError(f"flag '{name}' is not defined")
+    _on_change.setdefault(name, []).append(fn)
 
 
 # Core flags (subset of the reference's 183 exported flags that are meaningful on TPU).
@@ -146,6 +161,22 @@ define_flag("cost_while_default_trips", 1,
             "cost model: trip-count multiplier assumed for a while-loop "
             "whose counter pattern cannot be statically derived (1 keeps "
             "the historical single-iteration lower bound)")
+define_flag("telemetry_trace", False,
+            "observability: record structured spans (dispatch compiles, "
+            "train-loop phases, serving requests) into the process span "
+            "tracer for chrome://tracing / Perfetto export "
+            "(paddle_tpu.observability.tracing); off = one bool check per "
+            "instrumented site, zero recording")
+define_flag("telemetry_trace_max_events", 65536,
+            "observability: span-tracer ring capacity — the trace keeps "
+            "the most recent N events so a long-running process never "
+            "grows its timeline without bound")
+define_flag("telemetry_memory_sample_every", 8,
+            "observability: sample device-memory telemetry (jax "
+            "live_arrays bytes + backend memory_stats watermarks) every "
+            "N-th step/batch boundary the train loop or serving scheduler "
+            "crosses; 0 disables sampling entirely. Boundary-only and "
+            "sync-free by contract (OB602 gates the sampler source)")
 
 
 def enable_check_model_nan_inf():

@@ -41,6 +41,7 @@ Flags: ``FLAGS_eager_kernel_cache`` (master switch),
 """
 from __future__ import annotations
 
+import time as _time
 from collections import OrderedDict
 from typing import Any, Optional, Sequence
 
@@ -49,6 +50,7 @@ import numpy as np
 import jax
 
 from ..base.flags import get_flag
+from ..observability.tracing import tracer as _tracer
 
 __all__ = ["CachedVJP", "clear", "cost_stats", "execute", "lookup",
            "poison", "record_bypass", "stats"]
@@ -176,6 +178,19 @@ def _fn_key(fn, depth=0):
             tuple(_freeze(c.cell_contents, depth) for c in cells))
 
 
+def _sig_str(spec_parts) -> str:
+    """Compact human signature for trace events: ``float32[4,8],int64[4]``
+    with static args elided. Cold-path only (compile events)."""
+    parts = []
+    for part in spec_parts:
+        if part is None or part[0] == "__static__":
+            continue
+        shape, dtype = part[0], part[1]
+        name = getattr(dtype, "name", str(dtype))
+        parts.append(f"{name}[{','.join(str(d) for d in shape)}]")
+    return ",".join(parts)
+
+
 _STATIC, _ARRAY, _TRACER = 0, 1, 2
 _KIND_BY_TYPE: dict = {}  # exact type -> kind (jax's abc isinstance is slow)
 
@@ -230,6 +245,9 @@ def record_bypass(op: str, reason: str) -> None:
     s = _op_stats(op)
     s["bypasses"] += 1
     s["bypass_reasons"][reason] = s["bypass_reasons"].get(reason, 0) + 1
+    if _tracer.enabled:
+        _tracer.instant("kernel_cache.bypass", track="dispatch",
+                        op=op, reason=reason)
 
 
 _bypass = record_bypass
@@ -425,15 +443,25 @@ def lookup(op: str, fn, values: Sequence[Any], attrs: dict,
     if entry is not None:
         s["hits"] += 1
         _cache.move_to_end(key)
+        if _tracer.enabled:
+            _tracer.instant("kernel_cache.hit", track="dispatch", op=op)
         return entry
 
     s["misses"] += 1
+    t0 = _time.perf_counter() if _tracer.enabled else 0.0
     try:
         entry = _build(key, op, fn, values, attrs, tuple(diff_idx),
                        tuple(traced_idx))
     except Exception:
         poison(key, op)
         return None
+    if _tracer.enabled:
+        # the dispatch compile event: which op, what signature, why it
+        # missed (a fresh signature — bypasses record their own reason),
+        # and what the build cost on the wall clock
+        _tracer.emit("kernel_cache.compile", t0, _time.perf_counter() - t0,
+                     track="dispatch", op=op, signature=_sig_str(spec_parts),
+                     reason="new_signature", has_vjp=bool(diff_idx))
     _cache[key] = entry
     cap = int(get_flag("eager_kernel_cache_max_entries"))
     while len(_cache) > cap > 0:

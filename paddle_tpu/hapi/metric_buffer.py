@@ -32,7 +32,12 @@ def to_float(value) -> float:
     t0 = time.perf_counter()
     v = getattr(value, "_value", value)
     out = float(np.asarray(v).reshape(-1)[0])
-    pipeline_stats.add_host_sync(time.perf_counter() - t0)
+    dt = time.perf_counter() - t0
+    pipeline_stats.add_host_sync(dt)
+    from ..observability.tracing import tracer
+
+    if tracer.enabled:
+        tracer.emit("host_sync", t0, dt, track="train_loop")
     return out
 
 
@@ -94,13 +99,21 @@ class MetricBuffer:
 
         t0 = time.perf_counter()
         out = {}
+        n_values = 0
         for name, vals in self._pending.items():
             stacked = np.asarray(jnp.stack([jnp.reshape(v, ()) for v in vals]))
             floats = [float(x) for x in stacked]
+            n_values += len(floats)
             self._history.setdefault(name, []).extend(floats)
             out[name] = floats[-1]
         self._pending.clear()
-        pipeline_stats.add_host_sync(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        pipeline_stats.add_host_sync(dt)
+        from ..observability.tracing import tracer
+
+        if tracer.enabled:
+            tracer.emit("metric.flush", t0, dt, track="train_loop",
+                        metrics=len(out), values=n_values)
         return out
 
     def flush(self) -> Dict[str, dict]:

@@ -124,6 +124,7 @@ class Model:
         step) rather than a device handle — only an explicit
         ``sync_every=0`` passes device values through."""
         from ..base.flags import get_flag
+        from ..observability.memory import sampler as mem_sampler
         from ..profiler.pipeline import pipeline_stats, timed
 
         loader = self._make_loader(train_data, batch_size, shuffle,
@@ -158,6 +159,9 @@ class Model:
                     losses = self.train_batch(xs, ys, sync=False)
                 buf.append("loss", losses[0])
                 pipeline_stats.step()
+                # boundary-only device-memory telemetry (sync-free: reads
+                # live-array metadata + allocator counters, never a D2H)
+                mem_sampler.maybe_sample("step")
                 if buf.should_sync(step):
                     # log boundary (aligned with ProgBarLogger's cadence):
                     # one batched readback covering every step since the

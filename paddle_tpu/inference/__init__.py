@@ -24,6 +24,17 @@ def _warn(msg: str) -> None:
     get_logger().warning("[inference.Config] %s", msg)
 
 
+# process-wide total of batched-program trace events (every _BatchProgram
+# across every Predictor) — re-homed into observability.snapshot() under
+# "jit.compile" (observability/adapters.py); per-engine deltas stay on
+# ``Predictor.compile_count`` / ``ServingEngine.compiles_after_warmup``
+_batch_traces = {"total": 0}
+
+
+def batch_trace_total() -> int:
+    return _batch_traces["total"]
+
+
 class PrecisionType:
     Float32 = 0
     Half = 1
@@ -170,6 +181,7 @@ class _BatchProgram:
         def _fwd(params, *args):
             # runs under trace only: one tick per (re)compile, zero per replay
             self.traces += 1
+            _batch_traces["total"] += 1
             return self._exported.call(params, *args)
 
         # serving-step donation idiom (SNIPPETS [1]/[2]): the padded input
@@ -202,7 +214,21 @@ class _BatchProgram:
 
     def __call__(self, arrays: Sequence, bucket: int):
         """Run one assembled batch already padded to ``bucket``."""
-        return self._jitted(self._params, *arrays)
+        from ..observability.tracing import tracer
+
+        if not tracer.enabled:
+            return self._jitted(self._params, *arrays)
+        import time
+
+        before = self.traces
+        t0 = time.perf_counter()
+        out = self._jitted(self._params, *arrays)
+        if self.traces > before:
+            # a (re)compile happened inside this call — the event JX330
+            # errors on post-warmup: make it visible on the timeline
+            tracer.emit("serving.compile", t0, time.perf_counter() - t0,
+                        track="serving.scheduler", bucket=bucket)
+        return out
 
 
 class Predictor:

@@ -90,9 +90,11 @@ class _PrefetchIter:
     """One pass over the inner iterable with a device-staging thread."""
 
     def __init__(self, inner_iter, depth: int, sharding: str = "auto"):
+        from ..observability.tracing import tracer
         from ..profiler.pipeline import pipeline_stats
 
         self._stats = pipeline_stats
+        self._tracer = tracer
         self._inner = inner_iter
         self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
         self._stop = threading.Event()
@@ -118,7 +120,10 @@ class _PrefetchIter:
                     return
                 t0 = time.perf_counter()
                 moved = _device_put_tree(batch, self._mesh, self._dp)
-                self._stats.add_h2d_issue(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                self._stats.add_h2d_issue(dt)
+                if self._tracer.enabled:
+                    self._tracer.emit("h2d.issue", t0, dt, track="io.prefetch")
                 if not self._put(moved):
                     return
         except BaseException as e:  # surface loader errors to the consumer
@@ -131,7 +136,10 @@ class _PrefetchIter:
             raise StopIteration
         t0 = time.perf_counter()
         item = self._q.get()
-        self._stats.add_h2d_wait(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._stats.add_h2d_wait(dt)
+        if self._tracer.enabled:
+            self._tracer.emit("prefetch.wait", t0, dt, track="train_loop")
         if item is _SENTINEL:
             self.close()
             raise StopIteration

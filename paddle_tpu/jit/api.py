@@ -119,6 +119,11 @@ class TrainStep:
 
             self._lr_cell._replace_value(jnp.asarray(lr, jnp.float32))
             self._lr_host = lr
+        from ..observability.tracing import tracer
+
+        if tracer.enabled:
+            with tracer.span("train.step", track="train_loop"):
+                return self._compiled(*batch)
         return self._compiled(*batch)
 
     @property

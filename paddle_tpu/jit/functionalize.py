@@ -43,6 +43,31 @@ from ..base.log import get_logger
 from ..core import hooks
 from ..core.tensor import Tensor, unwrap
 
+# process-wide program-build count across every CompiledFunction — the
+# whole-step analog of kernel_cache's miss counter, re-homed into
+# observability.snapshot() under "jit.compile" (adapters.py). Build-time
+# only: the hot __call__ replay path never touches it.
+_build_totals = {"programs": 0}
+
+
+def build_totals() -> int:
+    """Total compiled-program builds this process (all CompiledFunctions)."""
+    return _build_totals["programs"]
+
+
+def _record_build(name: str, t0: float) -> None:
+    """Count one program build and, when tracing, span it on the dispatch
+    track (signature-level detail lives in the kernel-cache events; here
+    the unit is one whole-step XLA program)."""
+    import time
+
+    _build_totals["programs"] += 1
+    from ..observability.tracing import tracer
+
+    if tracer.enabled:
+        tracer.emit("jit.build", t0, time.perf_counter() - t0,
+                    track="dispatch", program=name)
+
 
 class _BranchRecorder:
     """Eager-run mode of the branch hook: log every tensor-bool outcome."""
@@ -230,6 +255,9 @@ class CompiledFunction:
         return ctx, tuple(recorder.outcomes)
 
     def _build(self, key, args, kwargs):
+        import time
+
+        t0 = time.perf_counter()
         try:
             ctx, outcomes = self._discover(args, kwargs)
         except jax.errors.JaxRuntimeError as e:
@@ -270,6 +298,7 @@ class CompiledFunction:
         entry["abstract_call"] = _abstract_call(args, kwargs)
         self._cache[key] = entry
         self._compile_counts[key] = self._compile_counts.get(key, 0) + 1
+        _record_build(self.name, t0)
         self._maybe_runtime_audit(entry)
         return entry
 
@@ -340,6 +369,9 @@ class CompiledFunction:
                 "compiled_once": False, "guards": guards}
 
     def _specialize(self, family, outcomes, ctx=None, args=None, kwargs=None):
+        import time
+
+        t0 = time.perf_counter()
         if ctx is None:
             ctx, outcomes = self._discover(args, kwargs)  # path actually taken
         if outcomes not in family["entries"]:
@@ -350,6 +382,7 @@ class CompiledFunction:
             family["entries"][outcomes] = entry
             key = family.get("key")
             self._compile_counts[key] = self._compile_counts.get(key, 0) + 1
+            _record_build(self.name, t0)
             self._maybe_runtime_audit(entry)  # guard-miss builds too
         family["last"] = outcomes
         return outcomes

@@ -1,0 +1,81 @@
+"""paddle_tpu.observability — unified runtime telemetry.
+
+The production observability layer over the whole runtime (ISSUE 7): the
+paper's L5–L8 profiler stack (state machine, RecordEvent, chrome-trace
+export, summaries) reproduced as ONE substrate instead of per-subsystem
+fragments. Three pieces:
+
+- :mod:`metrics` — a process-wide registry of Counter/Gauge/Histogram
+  instruments with labels plus pull-time collectors that re-home the
+  pre-existing silos (kernel-cache, pipeline, serving, compile counters)
+  into one namespace. :func:`snapshot` is the JSON surface.
+- :mod:`tracing` — a structured span tracer unifying ``RecordEvent``
+  host spans, dispatch events (cache hit/miss/compile), train-loop
+  phases (prefetch wait, step, metric flush) and per-request serving
+  spans onto one chrome://tracing / Perfetto timeline with correlated
+  track ids. :func:`span` / :func:`export_trace` are the entry points;
+  ``FLAGS_telemetry_trace`` gates recording.
+- :mod:`memory` — a device-memory telemetry sampler (jax ``live_arrays``
+  + backend ``memory_stats`` watermarks) sampled at step/batch
+  boundaries only, never forcing a device sync, feeding gauges
+  comparable against the CM5xx peak-residency estimate.
+
+The OB6xx telemetry lint family (``analysis/telemetry_check.py``, run by
+``python -m tools.lint``) gates the contract: no unclosed span at
+export, no duplicate metric registration, no device sync inside a
+sampler. ``python -m tools.telemetry`` dumps a demo snapshot + trace.
+"""
+from __future__ import annotations
+
+from .adapters import register_default_collectors
+from .memory import DeviceMemorySampler, device_memory_stats, sampler
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, registry
+from .tracing import SpanTracer, tracer
+
+__all__ = [
+    "Counter", "DeviceMemorySampler", "Gauge", "Histogram",
+    "MetricsRegistry", "SpanTracer", "counter", "device_memory_stats",
+    "export_trace", "gauge", "histogram", "registry",
+    "register_default_collectors", "sampler", "snapshot", "span", "tracer",
+]
+
+register_default_collectors(registry)
+
+# FLAGS_telemetry_trace is mirrored into the tracer's hot-path `enabled`
+# attribute (instrumented sites pay one attribute read, never a registry
+# lookup); this hook keeps a runtime paddle.set_flags(...) in sync with it
+try:
+    from ..base.flags import on_flag_change as _on_flag_change
+
+    _on_flag_change("telemetry_trace",
+                    lambda v: setattr(tracer, "enabled", bool(v)))
+except Exception:
+    pass
+
+
+# ----------------------------------------------------------------- sugar
+def counter(name: str, help: str = "") -> Counter:
+    return registry.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return registry.gauge(name, help)
+
+
+def histogram(name: str, help: str = "", max_samples: int = 2048) -> Histogram:
+    return registry.histogram(name, help, max_samples=max_samples)
+
+
+def snapshot() -> dict:
+    """The process-wide metrics snapshot (instruments + collectors)."""
+    return registry.snapshot()
+
+
+def span(name: str, track: str = "host", **args):
+    """``with observability.span("phase", track="train_loop"): ...``"""
+    return tracer.span(name, track, **args)
+
+
+def export_trace(path: str) -> str:
+    """Write the unified timeline as chrome-trace JSON to ``path``."""
+    return tracer.export(path)

@@ -1,0 +1,129 @@
+"""Device-memory telemetry: boundary-only, sync-free watermark sampling.
+
+The static cost model (CM5xx) predicts peak residency from the jaxpr;
+this sampler is the *measured* side of that comparison: how many bytes
+are actually live on the device, and what watermark has the backend
+allocator seen. Two sources, both metadata-only:
+
+- ``jax.live_arrays()`` — every live ``jax.Array`` the client tracks;
+  summing ``.nbytes`` costs an enumeration, never a transfer or a
+  ``block_until_ready``;
+- ``device.memory_stats()`` — the backend allocator's own counters
+  (``bytes_in_use`` / ``peak_bytes_in_use``), available on TPU/GPU
+  runtimes, absent on CPU — absence degrades to the live-array numbers.
+
+Sampling happens ONLY at step/batch boundaries (the train loop after a
+step, the serving scheduler between batches), throttled by
+``FLAGS_telemetry_memory_sample_every``, and must never force a device
+sync — the TS107 zero-host-sync contract stays green with sampling
+enabled, and the OB602 telemetry lint statically gates this module's
+sampler functions against blocking-readback calls.
+
+Gauges land in the process registry (``memory.live_bytes``,
+``memory.live_arrays``, ``memory.bytes_in_use``,
+``memory.peak_bytes_in_use`` labeled per device) so ``snapshot()`` can be
+diffed against the CM5xx estimate; with tracing enabled each sample also
+drops an instant on the ``memory`` track to correlate watermarks with
+timeline phases.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["DeviceMemorySampler", "device_memory_stats", "sampler"]
+
+
+def device_memory_stats() -> dict:
+    """One sync-free reading: live client-side array bytes/count plus
+    per-device allocator stats when the backend publishes them."""
+    import jax
+
+    live_bytes = 0
+    live_count = 0
+    for arr in jax.live_arrays():
+        nbytes = getattr(arr, "nbytes", None)
+        if nbytes is not None:
+            live_bytes += int(nbytes)
+            live_count += 1
+    out = {"live_bytes": live_bytes, "live_arrays": live_count,
+           "devices": {}}
+    for dev in jax.devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        out["devices"][str(dev.id)] = {
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+        }
+    return out
+
+
+class DeviceMemorySampler:
+    """Throttled boundary sampler feeding the registry gauges.
+
+    ``maybe_sample(boundary)`` is the instrumented-loop entry point: it
+    counts calls and takes a real sample every
+    ``FLAGS_telemetry_memory_sample_every``-th one (0 disables). The
+    call-counting fast path is one lock + one int — cheap enough for
+    every step of every loop."""
+
+    def __init__(self, sample_every: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._calls = 0
+        self.samples = 0
+        self._sample_every = sample_every
+        self.last: Optional[dict] = None
+
+    def _every(self) -> int:
+        if self._sample_every is not None:
+            return int(self._sample_every)
+        try:
+            from ..base.flags import get_flag
+
+            return int(get_flag("telemetry_memory_sample_every"))
+        except Exception:
+            return 0
+
+    def maybe_sample(self, boundary: str = "step") -> Optional[dict]:
+        every = self._every()
+        if every <= 0:
+            return None
+        with self._lock:
+            self._calls += 1
+            if self._calls % every:
+                return None
+        return self.sample(boundary)
+
+    def sample(self, boundary: str = "step") -> dict:
+        """Unthrottled sample: read, publish gauges, drop a trace instant."""
+        from .metrics import registry
+        from .tracing import tracer
+
+        stats = device_memory_stats()
+        registry.gauge(
+            "memory.live_bytes",
+            "sum of nbytes over jax.live_arrays()").set(stats["live_bytes"])
+        registry.gauge(
+            "memory.live_arrays",
+            "count of live client-side jax arrays").set(stats["live_arrays"])
+        in_use = registry.gauge(
+            "memory.bytes_in_use", "backend allocator bytes in use")
+        peak = registry.gauge(
+            "memory.peak_bytes_in_use", "backend allocator high watermark")
+        for dev_id, dev_stats in stats["devices"].items():
+            in_use.set(dev_stats["bytes_in_use"], device=dev_id)
+            peak.set(dev_stats["peak_bytes_in_use"], device=dev_id)
+        tracer.instant("memory.sample", track="memory", boundary=boundary,
+                       live_bytes=stats["live_bytes"],
+                       live_arrays=stats["live_arrays"])
+        with self._lock:
+            self.samples += 1
+            self.last = stats
+        return stats
+
+
+sampler = DeviceMemorySampler()

@@ -1,0 +1,229 @@
+"""Structured span tracer: one chrome-trace timeline for the whole runtime.
+
+The paper's L5–L8 profiler stack exports *host op events* only
+(``profiler.RecordEvent`` → chrome JSON). This tracer is the unified
+timeline underneath it: dispatch events (kernel-cache compiles with
+signature + miss reason + wall time), train-loop phases (prefetch wait,
+step, metric flush), per-request serving spans (queue wait → execute,
+batch assembly with bucket/fill) and host ``RecordEvent`` spans all land
+in ONE bounded event ring with correlated track ids, exportable as
+chrome://tracing / Perfetto-loadable JSON (:meth:`SpanTracer.export`).
+
+Tracks are named lanes (``dispatch``, ``train_loop``, ``io.prefetch``,
+``serving.scheduler``, ``serving.requests``, ``host``, ``memory``); each
+gets a stable tid and a ``thread_name`` metadata row so Perfetto shows
+the runtime's layers as parallel swimlanes. All timestamps come from
+``time.perf_counter`` (the same clock every existing stats silo stamps
+with), so retroactively emitted spans — a serving request's queue phases,
+recorded at completion from its ``Request`` timestamps — land correctly
+against live-recorded ones.
+
+Cost discipline: ``FLAGS_telemetry_trace`` gates recording. Disabled
+(default), every instrumented site pays ONE attribute read
+(``tracer.enabled``); there is no allocation, no lock, no clock read.
+Enabled, a span costs two ``perf_counter`` calls + one locked append.
+
+Open-span accounting feeds the OB600 telemetry audit: exporting a trace
+while spans are still open means an instrumented region leaked its
+``end()`` (an exception path without a ``with`` block) and its wall time
+is silently missing from the timeline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["SpanTracer", "tracer"]
+
+
+class _Span:
+    """One open span; ``with tracer.span(...)`` closes it."""
+
+    __slots__ = ("tracer", "name", "track", "args", "t0_us")
+
+    def __init__(self, tracer_, name, track, args):
+        self.tracer = tracer_
+        self.name = name
+        self.track = track
+        self.args = args
+        self.t0_us = time.perf_counter() * 1e6
+
+    def end(self) -> None:
+        self.tracer._close(self)
+
+    def __enter__(self) -> "_Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end()
+
+
+class _NullSpan:
+    """The disabled-tracer span: a shared, stateless no-op."""
+
+    __slots__ = ()
+
+    def end(self) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class SpanTracer:
+    """Bounded, thread-safe event ring with chrome-trace export."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 max_events: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._events: List[tuple] = []   # (ph, name, track, ts_us, dur_us, args)
+        self._open: dict = {}            # id(_Span) -> _Span
+        self._tids: dict = {}            # track name -> tid
+        self._dropped = 0
+        self._max_events = max_events
+        if enabled is None:
+            try:
+                from ..base.flags import get_flag
+
+                enabled = bool(get_flag("telemetry_trace"))
+            except Exception:
+                enabled = False
+        self.enabled = bool(enabled)
+
+    # ------------------------------------------------------------ lifecycle
+    def enable(self) -> "SpanTracer":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "SpanTracer":
+        self.enabled = False
+        return self
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._open.clear()
+            self._dropped = 0
+
+    def _cap(self) -> int:
+        if self._max_events is not None:
+            return int(self._max_events)
+        try:
+            from ..base.flags import get_flag
+
+            return int(get_flag("telemetry_trace_max_events"))
+        except Exception:
+            return 65536
+
+    # ------------------------------------------------------------ recording
+    def span(self, name: str, track: str = "host", **args):
+        """Context manager (or explicit ``.end()``) recording one complete
+        event. The no-op when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        s = _Span(self, name, track, args or None)
+        with self._lock:
+            self._open[id(s)] = s
+        return s
+
+    def _close(self, s: _Span) -> None:
+        t1 = time.perf_counter() * 1e6
+        with self._lock:
+            self._open.pop(id(s), None)
+            self._append(("X", s.name, s.track, s.t0_us, t1 - s.t0_us, s.args))
+
+    def emit(self, name: str, t0_s: float, dur_s: float,
+             track: str = "host", **args) -> None:
+        """Record a complete span from already-measured ``perf_counter``
+        timestamps (seconds) — the retroactive path for events whose
+        phases were stamped elsewhere (serving requests, RecordEvent)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._append(("X", name, track, t0_s * 1e6, dur_s * 1e6,
+                          args or None))
+
+    def instant(self, name: str, track: str = "host", **args) -> None:
+        """Zero-duration marker (cache hit, sample tick, rejection)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._append(("i", name, track, time.perf_counter() * 1e6, 0.0,
+                          args or None))
+
+    def _append(self, event: tuple) -> None:
+        # caller holds self._lock
+        self._events.append(event)
+        cap = self._cap()
+        if cap > 0 and len(self._events) > cap:
+            drop = len(self._events) - cap
+            del self._events[:drop]
+            self._dropped += drop
+
+    # ------------------------------------------------------------ reporting
+    def open_spans(self) -> List[str]:
+        """Names of spans begun but never ended — the OB600 audit input."""
+        with self._lock:
+            return [s.name for s in self._open.values()]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def _tid(self, track: str) -> int:
+        with self._lock:  # two exporters racing a new track must not
+            tid = self._tids.get(track)  # hand two tracks one tid
+            if tid is None:
+                tid = self._tids[track] = len(self._tids) + 1
+            return tid
+
+    def to_chrome_trace(self) -> dict:
+        """The timeline as a chrome://tracing / Perfetto JSON object.
+        Tracks become named tid lanes under one pid; span ``args`` ride
+        through for the Perfetto details pane."""
+        pid = os.getpid()
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        out = []
+        for track in {e[2] for e in events}:
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": self._tid(track),
+                        "args": {"name": track}})
+        for ph, name, track, ts, dur, args in events:
+            ev = {"ph": ph, "name": name, "pid": pid,
+                  "tid": self._tid(track), "ts": ts, "cat": track}
+            if ph == "X":
+                ev["dur"] = dur
+            else:
+                ev["s"] = "t"  # instant scope: thread
+            if args:
+                ev["args"] = dict(args)
+            out.append(ev)
+        trace = {"traceEvents": out, "displayTimeUnit": "ms"}
+        if dropped:
+            trace["otherData"] = {"dropped_events": dropped}
+        return trace
+
+    def export(self, path: str) -> str:
+        """Write the chrome-trace JSON to ``path`` (create parents).
+        Returns the path. Open spans are NOT flushed — they are a
+        telemetry bug the OB600 audit reports; run it before trusting an
+        export."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+tracer = SpanTracer()

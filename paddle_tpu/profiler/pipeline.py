@@ -101,9 +101,14 @@ class ServingStats:
     and requests/sec *within the SLO* (FLAGS_serving_slo_ms) — the
     EQuARX-style accounting discipline: a serving tier is measured in
     admitted work per second at a latency bound, not raw throughput.
+    Requests recorded with a ``tenant`` additionally land in that
+    tenant's own ring, and ``summary()["tenants"]`` breaks the same
+    numbers down per tenant (p50/p99, queue-wait, rps, rejected) — the
+    multi-tenant fairness read.
 
     Latency samples are kept in a bounded ring (last ``max_samples``
-    requests) so percentile math never grows with uptime.
+    requests, globally and per tenant) so percentile math never grows
+    with uptime.
     """
 
     def __init__(self, max_samples: int = 8192):
@@ -123,13 +128,26 @@ class ServingStats:
             self.queue_depth_peak = 0
             self.depth_samples = 0
             self._lat = []        # (total, queue_wait, exec) seconds, ring
+            self._tenants = {}    # tenant -> {"requests","samples","rejected",
+            #                                  "lat": bounded ring like _lat}
             self._t_first = None
             self._t_last = None
 
+    def _tenant_cell(self, tenant) -> dict:
+        # caller holds the lock
+        cell = self._tenants.get(tenant)
+        if cell is None:
+            cell = self._tenants[tenant] = {
+                "requests": 0, "samples": 0, "rejected": 0, "lat": []}
+        return cell
+
     # ------------------------------------------------------------ recording
     def record_request(self, t_enqueue: float, t_admit: float,
-                       t_dispatch: float, t_complete: float, n: int = 1):
-        """One completed request's phase timestamps (perf_counter space)."""
+                       t_dispatch: float, t_complete: float, n: int = 1,
+                       tenant: str = None):
+        """One completed request's phase timestamps (perf_counter space);
+        ``tenant`` additionally lands the sample in that tenant's own
+        bounded ring for the per-tenant summary breakdown."""
         with self._lock:
             self.requests += 1
             self.samples += int(n)
@@ -138,13 +156,23 @@ class ServingStats:
             self._lat.append(lat)
             if len(self._lat) > self._max_samples:
                 del self._lat[: len(self._lat) - self._max_samples]
+            if tenant is not None:
+                cell = self._tenant_cell(tenant)
+                cell["requests"] += 1
+                cell["samples"] += int(n)
+                ring = cell["lat"]
+                ring.append(lat)
+                if len(ring) > self._max_samples:
+                    del ring[: len(ring) - self._max_samples]
             if self._t_first is None:
                 self._t_first = t_enqueue
             self._t_last = max(self._t_last or t_complete, t_complete)
 
-    def record_rejected(self, n: int = 1):
+    def record_rejected(self, n: int = 1, tenant: str = None):
         with self._lock:
             self.rejected += int(n)
+            if tenant is not None:
+                self._tenant_cell(tenant)["rejected"] += int(n)
 
     def record_batch(self, n_samples: int, bucket: int):
         """One dispatched batch: ``n_samples`` real rows padded to
@@ -208,8 +236,32 @@ class ServingStats:
                                            / self.depth_samples, 2)
                                      if self.depth_samples else None),
                 "queue_depth_peak": self.queue_depth_peak,
+                "tenants": {
+                    name: self._tenant_summary(cell, window)
+                    for name, cell in sorted(self._tenants.items())},
             }
         return out
+
+    def _tenant_summary(self, cell: dict, window: float) -> dict:
+        """Per-tenant breakdown (caller holds the lock): latency
+        percentiles, queue wait and request rate over the SAME window as
+        the global summary — the multi-tenant fairness read: is one
+        tenant's p99 paying for another's burst?"""
+        total = sorted(t for t, _, _ in cell["lat"])
+        queue_w = sorted(q for _, q, _ in cell["lat"])
+        return {
+            "requests": cell["requests"],
+            "samples": cell["samples"],
+            "rejected": cell["rejected"],
+            "p50_ms": (round(self._pct(total, 0.50) * 1e3, 3)
+                       if total else None),
+            "p99_ms": (round(self._pct(total, 0.99) * 1e3, 3)
+                       if total else None),
+            "queue_wait_p50_ms": (round(self._pct(queue_w, 0.50) * 1e3, 3)
+                                  if queue_w else None),
+            "requests_per_sec": (round(cell["requests"] / window, 1)
+                                 if window > 0 else None),
+        }
 
 
 serving_stats = ServingStats()

@@ -76,6 +76,14 @@ class RecordEvent:
                  "tid": threading.get_ident() % 100000,
                  "cat": registry.profiler_tag(self.name)}
             )
+        # same span, unified timeline: host op events land on the shared
+        # observability tracer too (same perf_counter clock), so one
+        # export correlates them with dispatch/train-loop/serving tracks
+        from ..observability.tracing import tracer
+
+        if tracer.enabled:
+            tracer.emit(self.name, self._t0 / 1e9, (t1 - self._t0) / 1e9,
+                        track="host", cat=registry.profiler_tag(self.name))
         self._t0 = None
 
     def __enter__(self):

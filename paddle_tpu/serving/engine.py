@@ -25,9 +25,11 @@ import numpy as np
 
 from ..base.flags import get_flag
 from ..inference import Config, Predictor
+from ..observability.tracing import tracer
 from ..profiler.pipeline import serving_stats
 from .request_queue import AdmissionController, Request, RequestQueue
-from .scheduler import Scheduler, scatter_outputs, stack_requests
+from .scheduler import (Scheduler, fetch_outputs, scatter_outputs,
+                        stack_requests)
 
 
 class ServingEngine:
@@ -149,14 +151,28 @@ class ServingEngine:
         import jax
 
         out = prog(stacked, bucket)
-        leaves = [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(
-            out, is_leaf=lambda x: hasattr(x, "shape"))]
+        # one batched D2H round per assembled batch, not one per leaf
+        leaves = fetch_outputs(jax.tree_util.tree_leaves(
+            out, is_leaf=lambda x: hasattr(x, "shape")))
         rows = scatter_outputs(leaves, requests)
         for r, outs in zip(requests, rows):
             self.queue.admission.on_complete(r.tenant, r.n)
             r._complete(outs)
             self.stats.record_request(r.t_enqueue, r.t_admit, r.t_dispatch,
-                                      r.t_complete, r.n)
+                                      r.t_complete, r.n, tenant=r.tenant)
+            if tracer.enabled:
+                # the per-request lifecycle, emitted retroactively from the
+                # Request's own perf_counter stamps onto a per-tenant lane
+                # (track count = tenant count, bounded by admission): the
+                # enqueue→complete span with its phase breakdown in args,
+                # time-correlated with the serving.batch span that served it
+                tracer.emit(
+                    "serving.request", r.t_enqueue,
+                    r.t_complete - r.t_enqueue,
+                    track=f"serving.requests.{r.tenant}",
+                    request_id=r.id, n=r.n, bucket=bucket,
+                    queue_wait_ms=round((r.t_dispatch - r.t_admit) * 1e3, 3),
+                    execute_ms=round((r.t_complete - r.t_dispatch) * 1e3, 3))
 
     def _on_batch(self, n_samples: int, bucket: int, depth: int) -> None:
         self.stats.record_batch(n_samples, bucket)
@@ -181,7 +197,9 @@ class ServingEngine:
         report = self.stats.summary()
         report.update(
             buckets=list(self.predictor.batch_ladder),
-            tenants=len(self._tenants),
+            # count under its own key: summary()["tenants"] is the
+            # per-tenant latency breakdown and must survive the merge
+            n_tenants=len(self._tenants),
             compiled_rungs=self.predictor.compile_count,
             compiles_after_warmup=self.compiles_after_warmup,
         )

@@ -171,7 +171,7 @@ class RequestQueue:
                 raise RuntimeError("serving queue is closed")
             gate = self.admission.try_admit(request.tenant, request.n)
             if gate is not None:
-                self.stats.record_rejected()
+                self.stats.record_rejected(tenant=request.tenant)
                 raise AdmissionError(gate, (
                     f"request of {request.n} samples refused by the "
                     f"'{gate}' gate (tenant={request.tenant!r}: "

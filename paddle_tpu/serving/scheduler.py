@@ -58,6 +58,28 @@ def stack_requests(requests: Sequence[Request], bucket: int,
     return stacked
 
 
+def fetch_outputs(outputs: Sequence) -> List[np.ndarray]:
+    """ONE device fetch per assembled batch (ROADMAP serving leftover):
+    start every output leaf's D2H copy asynchronously first, then gather —
+    the transfers overlap on the wire instead of serializing one blocking
+    ``np.asarray`` round-trip per leaf. Counted once per call in the
+    ``serving.d2h_fetches`` observability counter (vs once per LEAF under
+    the old path), which is the proof the batch readback stays batched."""
+    from ..observability.metrics import registry
+
+    leaves = list(outputs)
+    for leaf in leaves:
+        start = getattr(leaf, "copy_to_host_async", None)
+        if start is not None:
+            start()
+    arrays = [np.asarray(leaf) for leaf in leaves]
+    registry.counter(
+        "serving.d2h_fetches",
+        "device→host readback rounds issued by the serving scheduler "
+        "(one per assembled batch, NOT one per output leaf)").inc()
+    return arrays
+
+
 def scatter_outputs(outputs: Sequence[np.ndarray],
                     requests: Sequence[Request]) -> List[List[np.ndarray]]:
     """Split each output's leading axis back into per-request row blocks
@@ -107,6 +129,9 @@ class Scheduler:
         return self
 
     def _loop(self) -> None:
+        from ..observability.memory import sampler
+        from ..observability.tracing import tracer
+
         while True:
             # buckets/max pass through RAW: take_batch resolves a callable
             # ladder at assembly time, after its wait — no stale snapshot
@@ -120,15 +145,20 @@ class Scheduler:
             now = time.perf_counter()
             for r in requests:
                 r.t_dispatch = now
+            n_samples = sum(r.n for r in requests)
             if self.on_batch is not None:
-                self.on_batch(sum(r.n for r in requests), bucket,
-                              self.queue.depth_samples())
+                self.on_batch(n_samples, bucket, self.queue.depth_samples())
             try:
-                self.execute(requests, bucket)
+                with tracer.span("serving.batch", track="serving.scheduler",
+                                 bucket=bucket, n_samples=n_samples,
+                                 n_requests=len(requests)):
+                    self.execute(requests, bucket)
             except BaseException as e:  # noqa: BLE001 — batch-scoped fault wall
                 for r in requests:
                     self.queue.admission.on_complete(r.tenant, r.n)
                     r._fail(e)
+            # batch-boundary memory telemetry (sync-free by contract)
+            sampler.maybe_sample("batch")
         self._stopped.set()
 
     def join(self, timeout: Optional[float] = None) -> bool:

@@ -440,28 +440,18 @@ class TestPlannerIntegration:
 def test_runtime_audit_flag_logs_at_build_time():
     """FLAGS_jaxpr_audit_runtime (ROADMAP satellite): audit + cost run at
     build time and land in base.log — no on-demand call needed."""
-    import io
-    import logging
-
+    from helpers import capture_logs
     from paddle_tpu.base import flags
-    from paddle_tpu.base.log import get_logger
     from paddle_tpu.jit.functionalize import functionalize
 
-    logger = get_logger()
-    buf = io.StringIO()
-    handler = logging.StreamHandler(buf)  # propagate=False: attach directly
-    prev_level = logger.level
-    logger.addHandler(handler)
-    logger.setLevel(logging.INFO)
     flags.set_flags({"jaxpr_audit_runtime": True})
     try:
-        # float static key: a seeded JX311 the runtime audit must log
-        cf = functionalize(lambda x: x * 2, static_key_fn=lambda: 0.5)
-        cf(paddle.ones([3]))
+        with capture_logs() as buf:
+            # float static key: a seeded JX311 the runtime audit must log
+            cf = functionalize(lambda x: x * 2, static_key_fn=lambda: 0.5)
+            cf(paddle.ones([3]))
     finally:
         flags.set_flags({"jaxpr_audit_runtime": False})
-        logger.removeHandler(handler)
-        logger.setLevel(prev_level)
     text = buf.getvalue()
     assert "JX311" in text, text
     assert "cost[" in text, text

@@ -114,25 +114,20 @@ def test_collectives_still_correct_with_watchdog():
 def test_inference_config_no_silent_noops():
     """Every Config setter with no real backend effect must WARN
     (VERDICT r4 #10: zero silent no-ops in the inference surface)."""
-    import io
     import logging
 
+    from helpers import capture_logs
     from paddle_tpu.base.log import get_logger
     from paddle_tpu.inference import Config
 
     cfg = Config("dummy")
     logger = get_logger()
-    buf = io.StringIO()
-    handler = logging.StreamHandler(buf)  # propagate=False: attach directly
-    logger.addHandler(handler)
-    try:
+    with capture_logs(level=logging.WARNING) as buf:
         cfg.enable_memory_optim(False)
         cfg.switch_ir_optim(False)
         cfg.enable_use_gpu()
         cfg.set_cpu_math_library_num_threads(4)
         cfg.enable_tpu()  # cpu backend here -> warns
-    finally:
-        logger.removeHandler(handler)
     text = buf.getvalue()
     for frag in ("enable_memory_optim", "switch_ir_optim", "enable_use_gpu",
                  "set_cpu_math_library_num_threads", "enable_tpu"):

@@ -1,11 +1,12 @@
 """CI gate: the repo itself passes its own static analysis.
 
-Runs all seven ``paddle_tpu.analysis`` analyzer families over the live
+Runs all eight ``paddle_tpu.analysis`` analyzer families over the live
 codebase and asserts ZERO error-severity findings, so a regression (a new
 jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug,
 a host callback in a compiled step, a typo'd mesh axis, a cost-model
-budget blowout, a serving-tier steady-state recompile) fails tier-1
-instead of rotting until pod scale. The
+budget blowout, a serving-tier steady-state recompile, a leaked telemetry
+span or a sync inside a memory sampler) fails tier-1 instead of rotting
+until pod scale. The
 ``python -m tools.lint`` CLI contract (exit 0, machine-readable JSON
 with per-family wall-time, ``--include-tests``) is gated here too.
 """
@@ -123,6 +124,21 @@ def test_serving_audit_green_on_demo_engine(tmp_path):
     assert report["compiled_rungs"] == 3  # one per demo ladder rung
 
 
+def test_telemetry_contract_green_on_live_process():
+    """ISSUE 7: the observability layer's own contract holds — the
+    observability/ tree has no device sync inside a sampler (OB602), the
+    demo telemetry session and the LIVE process tracer/registry audit
+    clean (OB600/OB601)."""
+    from paddle_tpu.analysis.telemetry_check import (
+        audit_telemetry, check_paths, record_demo_telemetry)
+
+    obs_dir = os.path.join(_REPO, "paddle_tpu", "observability")
+    assert _errors(check_paths([obs_dir])) == []
+    tracer, registry = record_demo_telemetry()
+    assert [str(f) for f in audit_telemetry(tracer, registry)] == []
+    assert [str(f) for f in audit_telemetry()] == []  # live process state
+
+
 def test_cli_exits_zero_with_machine_readable_findings(capsys):
     """`tools.lint --json --include-tests` over the repo: exit 0,
     parseable. Run in-process (the tests above already paid the analyzer
@@ -137,7 +153,8 @@ def test_cli_exits_zero_with_machine_readable_findings(capsys):
     assert payload["errors"] == 0
     assert payload["crashed"] == []
     assert set(payload["analyzers"]) == {"trace", "registry", "program",
-                                         "jaxpr", "spmd", "cost", "serving"}
+                                         "jaxpr", "spmd", "cost", "serving",
+                                         "telemetry"}
     assert isinstance(payload["findings"], list)
     # per-family wall-time (CI satellite): one entry per analyzer run
     assert set(payload["timings_s"]) == set(payload["analyzers"])

@@ -1,6 +1,6 @@
 """``python -m tools.lint`` — the repo's static-analysis driver.
 
-Runs the six ``paddle_tpu.analysis`` analyzers and reports findings:
+Runs the eight ``paddle_tpu.analysis`` analyzers and reports findings:
 
 - **trace**:    the trace-safety AST linter over ``paddle_tpu/`` (or the
                 paths given on the command line),
@@ -24,7 +24,12 @@ Runs the six ``paddle_tpu.analysis`` analyzers and reports findings:
                 freshly built representative ServingEngine (export a tiny
                 model → warm the bucket ladder → drive mixed-size tenant
                 traffic → assert zero post-warmup compiles and full
-                ladder coverage).
+                ladder coverage),
+- **telemetry**: the observability layer's contract (OB6xx): static scan
+                of ``paddle_tpu/observability/`` for device syncs inside
+                memory samplers, plus unclosed-span / duplicate-metric
+                audits over a demo telemetry session AND the live
+                process tracer + registry.
 
 Exit-code contract (stable, CI-gateable):
   0 = no error-severity findings (warnings never gate)
@@ -47,7 +52,7 @@ import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd", "cost",
-              "serving")
+              "serving", "telemetry")
 
 
 def _source_paths(paths, include_tests=False):
@@ -180,16 +185,34 @@ def _run_serving(_paths, include_tests=False):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _run_telemetry(_paths, include_tests=False):
+    """The observability layer's own contract (OB6xx): static OB602 scan
+    of the ``paddle_tpu/observability/`` sources, then OB600/OB601 over a
+    representative demo telemetry session (spans on every runtime track,
+    every instrument kind) AND over the live process tracer/registry —
+    an unclosed span or schema collision anywhere this process fails the
+    commit, not just in the demo."""
+    from paddle_tpu.analysis.telemetry_check import (
+        audit_telemetry, check_paths, record_demo_telemetry)
+
+    findings = check_paths(
+        [os.path.join(_REPO_ROOT, "paddle_tpu", "observability")])
+    demo_tracer, demo_registry = record_demo_telemetry()
+    findings += audit_telemetry(demo_tracer, demo_registry)
+    findings += audit_telemetry()  # the live global tracer + registry
+    return findings
+
+
 _RUNNERS = {"trace": _run_trace, "registry": _run_registry,
             "program": _run_program, "jaxpr": _run_jaxpr,
             "spmd": _run_spmd, "cost": _run_cost,
-            "serving": _run_serving}
+            "serving": _run_serving, "telemetry": _run_telemetry}
 
 # analyzer -> its finding-code family prefix, so a crash finding
 # (<PREFIX>999) stays visible under --select filters for that family
 _FAMILY_PREFIX = {"trace": "TS", "registry": "RC", "program": "PV",
                   "jaxpr": "JX", "spmd": "SP", "cost": "CM",
-                  "serving": "JX"}
+                  "serving": "JX", "telemetry": "OB"}
 
 
 def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
@@ -216,6 +239,19 @@ def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
                 f"analyzer '{name}' crashed: {type(e).__name__}: "
                 f"{str(e).splitlines()[0] if str(e) else ''}", "analyzer"))
         timings[name] = round(time.perf_counter() - t0, 3)
+    try:
+        # re-home the per-family wall-times into the process metrics
+        # registry (ISSUE 7): `timings_s` stays the CLI surface, the
+        # labeled gauge is the snapshot()-visible copy
+        from paddle_tpu.observability import registry as _obs_registry
+
+        gauge = _obs_registry.gauge(
+            "lint.family_seconds",
+            "wall-time of each tools.lint analyzer family's last run")
+        for family, seconds in timings.items():
+            gauge.set(seconds, family=family)
+    except Exception:
+        pass
     return findings, crashed, timings
 
 

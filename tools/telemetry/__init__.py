@@ -1,0 +1,103 @@
+"""``python -m tools.telemetry`` — one-shot telemetry demo dump.
+
+Drives the two representative workloads the analysis tier already
+maintains — the demo whole-step ``TrainStep``
+(``jaxpr_audit.record_demo_step``) and the demo multi-tenant
+``ServingEngine`` (``jaxpr_audit.record_demo_engine``) — with span
+tracing enabled, then writes:
+
+- ``<out>/telemetry_snapshot.json`` — the full
+  ``observability.snapshot()`` (instruments + the re-homed kernel-cache /
+  pipeline / serving / compile silos), and
+- ``<out>/telemetry.trace.json`` — the unified chrome-trace timeline
+  (open it at https://ui.perfetto.dev or chrome://tracing): dispatch
+  compiles, train-loop steps, scheduler batches and per-tenant request
+  lanes on correlated tracks.
+
+The acceptance demo for ISSUE 7: ONE process, ONE trace file, dispatch +
+train-loop + serving spans together. ``--json`` prints a machine-readable
+summary (paths, event/track counts, key counters) instead of prose.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def run_demo(out_dir: str) -> dict:
+    """Run the demo step + demo engine with tracing on; dump both files.
+    Returns the summary payload. Restores the tracer's enabled state."""
+    import shutil
+
+    from paddle_tpu.analysis.jaxpr_audit import (record_demo_engine,
+                                                 record_demo_step)
+    from paddle_tpu.analysis.telemetry_check import audit_telemetry
+    from paddle_tpu.observability import registry, snapshot, tracer
+
+    os.makedirs(out_dir, exist_ok=True)
+    was_enabled = tracer.enabled
+    tracer.enable()
+    tmpdir = tempfile.mkdtemp(prefix="paddle_telemetry_demo_")
+    try:
+        step = record_demo_step()
+        engine = record_demo_engine(tmpdir)
+    finally:
+        tracer.enabled = was_enabled  # restore even if a demo raised
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    snap = snapshot()
+    snap_path = os.path.join(out_dir, "telemetry_snapshot.json")
+    with open(snap_path, "w") as f:
+        json.dump(snap, f, indent=2, default=str)
+    trace_path = tracer.export(os.path.join(out_dir, "telemetry.trace.json"))
+
+    trace = tracer.to_chrome_trace()
+    tracks = sorted({e["args"]["name"] for e in trace["traceEvents"]
+                     if e["ph"] == "M"})
+    contract = [str(f) for f in audit_telemetry(tracer, registry)]
+    return {
+        "snapshot_path": snap_path,
+        "trace_path": trace_path,
+        "trace_events": sum(1 for e in trace["traceEvents"]
+                            if e["ph"] != "M"),
+        "tracks": tracks,
+        "snapshot_metrics": sorted(snap["metrics"]),
+        "compiles_after_warmup": engine.compiles_after_warmup,
+        "serving_requests": engine.stats.summary()["requests"],
+        "train_step_builds": step._compiled.stats["compiled_steps"] > 0,
+        "telemetry_findings": contract,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.telemetry",
+        description="run the demo train step + serving engine with span "
+                    "tracing enabled and dump snapshot + chrome-trace JSON")
+    parser.add_argument("--out", default="telemetry_out",
+                        help="output directory (default: ./telemetry_out)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable summary on stdout")
+    args = parser.parse_args(argv)
+
+    summary = run_demo(args.out)
+    if args.as_json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(f"snapshot: {summary['snapshot_path']}")
+        print(f"trace:    {summary['trace_path']} "
+              f"({summary['trace_events']} events on "
+              f"{len(summary['tracks'])} tracks — open in "
+              "https://ui.perfetto.dev)")
+        print(f"tracks:   {', '.join(summary['tracks'])}")
+        print(f"compiles_after_warmup: {summary['compiles_after_warmup']}")
+        for finding in summary["telemetry_findings"]:
+            print(f"TELEMETRY FINDING: {finding}")
+    return 1 if summary["telemetry_findings"] else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())
