@@ -186,10 +186,10 @@ def check_spmd_paths(paths, **kwargs):
     return _impl(paths, **kwargs)
 
 
-def audit_telemetry(tracer=None, registry=None):
+def audit_telemetry(tracer=None, registry=None, **kwargs):
     from .telemetry_check import audit_telemetry as _impl
 
-    return _impl(tracer, registry)
+    return _impl(tracer, registry, **kwargs)
 
 
 def check_telemetry_paths(paths):

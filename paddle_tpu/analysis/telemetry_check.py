@@ -29,6 +29,20 @@ OB602  device sync in sampler    static AST rule over the observability
                                  telemetry must read metadata and
                                  allocator counters only, never force a
                                  sync at a step boundary (error)
+OB603  dead anomaly monitor      the flight recorder is enabled and has
+                                 detectors registered, but NOTHING has
+                                 ever fed any of them — the operator
+                                 believes anomalies are being watched
+                                 while every boundary feed is missing
+                                 (monitor lit before wiring, or the
+                                 instrumented loop never ran) (error)
+OB604  unbounded egress surface  a telemetry exporter is serving
+                                 ``/trace.json`` from a span ring with no
+                                 bound (host or device cap <= 0), or the
+                                 anomaly monitor dumps bundles into a
+                                 directory with ``max_bundles <= 0`` —
+                                 exactly the surfaces that grow without
+                                 limit when nobody is watching (error)
 
 Runtime checks (:func:`audit_telemetry`) are pure state reads — safe on
 the live process. The source rule (:func:`check_source` /
@@ -49,8 +63,26 @@ _SYNC_ATTRS = {"numpy", "item", "tolist", "block_until_ready", "device_get",
 _SYNC_FN_NAMES = {"asarray", "array", "device_get"}
 
 
-def audit_telemetry(tracer=None, registry=None) -> List[Finding]:
-    """OB600/OB601 over live (or demo) tracer + registry state."""
+def _process_did_boundary_work() -> bool:
+    """Whether THIS process ever crossed an instrumented feed boundary
+    (built a compiled program, pushed pipeline steps, or moved serving
+    traffic). The live-process OB603 audit gates on this: a monitor that
+    is enabled purely by environment ``FLAGS_telemetry_anomaly`` in a
+    process that never trains or serves (e.g. a bare lint run) is idle,
+    not dead — only "work happened and nothing fed the monitor" is the
+    missing-wiring defect OB603 exists to catch."""
+    from ..jit.functionalize import build_totals
+    from ..profiler.pipeline import pipeline_stats, serving_stats
+
+    return (build_totals() > 0 or pipeline_stats.steps > 0
+            or serving_stats.requests > 0 or serving_stats.rejected > 0)
+
+
+def audit_telemetry(tracer=None, registry=None, monitor=None,
+                    servers=None) -> List[Finding]:
+    """OB600/OB601 over live (or demo) tracer + registry state, plus
+    OB603/OB604 over the anomaly monitor and any running exporters
+    (both default to the live process singletons)."""
     findings: List[Finding] = []
     if tracer is None or registry is None:
         from ..observability import registry as _registry
@@ -64,6 +96,15 @@ def audit_telemetry(tracer=None, registry=None) -> List[Finding]:
             tracer = _tracer
         if registry is None:
             registry = _registry
+    live_monitor = monitor is None
+    if monitor is None:
+        from ..observability.anomaly import monitor as _monitor
+
+        monitor = _monitor
+    if servers is None:
+        from ..observability.export import active_servers
+
+        servers = active_servers()
 
     open_spans = tracer.open_spans()
     if open_spans:
@@ -83,6 +124,50 @@ def audit_telemetry(tracer=None, registry=None) -> List[Finding]:
             "DETACHED instrument, so two code paths now report into what "
             "looks like one metric; pick one kind or two names",
             f"registry:{name}"))
+
+    detectors = getattr(monitor, "detectors", {})
+    if (getattr(monitor, "enabled", False) and detectors
+            and sum(d.observed for d in detectors.values()) == 0
+            and (not live_monitor or _process_did_boundary_work())):
+        names = ", ".join(sorted(detectors))
+        findings.append(Finding(
+            _ANALYZER, "OB603", "error",
+            f"anomaly monitor is enabled with {len(detectors)} detector(s) "
+            f"registered ({names}) but NOTHING has ever fed any of them — "
+            "a dead monitor: the operator believes anomalies are watched "
+            "while every boundary feed (train-step close, serving "
+            "batch close, metric flush) is missing", "anomaly_monitor"))
+
+    for srv in servers:
+        srv_tracer = getattr(srv, "tracer", None)
+        host_cap = (srv_tracer.capacity()
+                    if hasattr(srv_tracer, "capacity") else 1)
+        dev_cap = (srv_tracer._device_cap()
+                   if hasattr(srv_tracer, "_device_cap") else 1)
+        unbounded = []
+        if host_cap <= 0:
+            unbounded.append(("host span ring",
+                              "FLAGS_telemetry_trace_max_events"))
+        if dev_cap <= 0:
+            unbounded.append(("device event buffer",
+                              "FLAGS_telemetry_device_trace_max_events"))
+        for which, flag in unbounded:  # one finding PER surface: fixing
+            # the span ring must not hide the device buffer for a cycle
+            findings.append(Finding(
+                _ANALYZER, "OB604", "error",
+                f"telemetry exporter on {getattr(srv, 'url', '?')} serves "
+                f"/trace.json from an UNBOUNDED {which} (cap <= 0) — the "
+                "trace grows without limit exactly when nobody is "
+                f"scraping; set {flag} > 0",
+                f"exporter:{getattr(srv, 'port', '?')}"))
+    if (getattr(monitor, "enabled", False) and monitor.dump_dir
+            and getattr(monitor, "max_bundles", 1) <= 0):
+        findings.append(Finding(
+            _ANALYZER, "OB604", "error",
+            f"anomaly monitor dumps into '{monitor.dump_dir}' with "
+            "max_bundles <= 0 — unbounded forensic-bundle growth; every "
+            "dump directory must prune to a bounded newest-N set",
+            "anomaly_monitor:dump_dir"))
     return findings
 
 
@@ -194,3 +279,24 @@ def record_demo_telemetry():
                 track="serving.requests.demo", request_id=0, n=1)
     tracer.instant("memory.sample", track="memory", live_bytes=0)
     return tracer, registry
+
+
+def record_demo_monitor(tracer=None, registry=None):
+    """The representative anomaly-monitor session the ``telemetry`` lint
+    family audits alongside :func:`record_demo_telemetry`: a private
+    enabled monitor (no global bleed, no dump dir — verdicts count, never
+    write) with every boundary feed exercised so the OB603 dead-monitor
+    rule sees a LIVE wiring, and a bounded dump configuration so OB604
+    stays quiet."""
+    from ..observability.anomaly import AnomalyMonitor
+
+    # dump_dir="" (not None): None defers to FLAGS_telemetry_dump_dir,
+    # and a demo verdict must never write into a production dump dir
+    monitor = AnomalyMonitor(enabled=True, dump_dir="", cooldown_s=3600,
+                             tracer=tracer, registry=registry)
+    for step_s in (0.010, 0.011, 0.010, 0.012):   # steady steps, no verdict
+        monitor.on_step(step_s)
+    monitor.on_serving_request(0.004, 0.001, tenant="demo")
+    monitor.on_rejected(tenant="demo")
+    monitor.on_flush()
+    return monitor

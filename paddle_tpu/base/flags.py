@@ -177,6 +177,42 @@ define_flag("telemetry_memory_sample_every", 8,
             "N-th step/batch boundary the train loop or serving scheduler "
             "crosses; 0 disables sampling entirely. Boundary-only and "
             "sync-free by contract (OB602 gates the sampler source)")
+define_flag("telemetry_port", 0,
+            "observability egress: default port for the telemetry HTTP "
+            "exporter (/metrics Prometheus text, /healthz, /snapshot.json, "
+            "/trace.json). >0: ServingEngine.warmup() (and `python -m "
+            "tools.telemetry --serve`) binds it on 127.0.0.1; 0 disables "
+            "the engine-owned exporter (an explicit "
+            "serve_telemetry_port=0 still means 'pick an ephemeral port')")
+define_flag("telemetry_device_trace_max_events", 20000,
+            "observability: cap on XLA device-trace events merged into "
+            "the unified timeline per process (the most recent window is "
+            "kept — same bounded-ring discipline as the host span ring); "
+            "<=0 means unbounded, which the OB604 audit flags when an "
+            "exporter serves the trace")
+define_flag("telemetry_anomaly", False,
+            "observability: feed the anomaly flight recorder "
+            "(observability/anomaly.py AnomalyMonitor) at train-step "
+            "close, serving batch close and metric-flush boundaries; off "
+            "= one attribute read per boundary, zero recording")
+define_flag("telemetry_dump_dir", "",
+            "anomaly flight recorder: directory for forensic bundles "
+            "(last-N spans + metrics snapshot + detector verdict + "
+            "step-time window) dumped on a detector trigger or an "
+            "uncaught train/serving-worker exception; empty disables "
+            "dumping (triggers still tick the anomaly.* counters)")
+define_flag("anomaly_step_mad", 8.0,
+            "anomaly flight recorder: a step slower than "
+            "median + N*MAD of the rolling step-time window trips the "
+            "step-time regression detector; <=0 disables it")
+define_flag("anomaly_dump_cooldown_s", 60.0,
+            "anomaly flight recorder: per-anomaly-kind dedup window — "
+            "repeat triggers of the same kind inside it tick "
+            "anomaly.suppressed instead of writing another bundle")
+define_flag("anomaly_reject_burst", 16,
+            "anomaly flight recorder: admission rejections within one "
+            "second that count as a rejection burst; <=0 disables the "
+            "burst watcher")
 
 
 def enable_check_model_nan_inf():

@@ -124,8 +124,7 @@ class Model:
         step) rather than a device handle — only an explicit
         ``sync_every=0`` passes device values through."""
         from ..base.flags import get_flag
-        from ..observability.memory import sampler as mem_sampler
-        from ..profiler.pipeline import pipeline_stats, timed
+        from ..observability.anomaly import monitor
 
         loader = self._make_loader(train_data, batch_size, shuffle,
                                    num_workers, device_prefetch)
@@ -150,6 +149,25 @@ class Model:
         cbks.on_train_begin()
         logs = {}
         buf = MetricBuffer(sync_every=sync_every)
+        try:
+            logs = self._fit_loop(loader, epochs, eval_data, eval_freq,
+                                  batch_size, num_workers, cbks, buf)
+        except BaseException as e:
+            if monitor.enabled:
+                # uncaught train-loop exception: capture the forensic
+                # window (spans + metrics + step-time history) before the
+                # stack unwinds and the evidence is gone
+                monitor.on_exception("train.fit", e)
+            raise
+        cbks.on_train_end(logs)
+
+    def _fit_loop(self, loader, epochs, eval_data, eval_freq, batch_size,
+                  num_workers, cbks, buf):
+        from ..observability.anomaly import monitor
+        from ..observability.memory import sampler as mem_sampler
+        from ..profiler.pipeline import pipeline_stats, timed
+
+        logs = {}
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             for step, batch in enumerate(loader):
@@ -167,6 +185,11 @@ class Model:
                     # one batched readback covering every step since the
                     # previous boundary
                     logs = dict(buf.materialize())
+                    if monitor.enabled:
+                        # metric-flush boundary: the flight recorder's
+                        # memory-watermark detector reads the boundary
+                        # sampler's last (sync-free) measurement here
+                        monitor.on_flush()
                 else:
                     # keep the logs contract float-valued without syncing:
                     # callbacks see the last boundary's float (step 0 is
@@ -177,6 +200,8 @@ class Model:
                             else buf.latest("loss")}
                 cbks.on_train_batch_end(step, logs)
             report = buf.flush()
+            if monitor.enabled:
+                monitor.on_flush()
             if "loss" in report:
                 logs = {"loss": report["loss"]["last"]}
             cbks.on_epoch_end(epoch, logs)
@@ -186,7 +211,7 @@ class Model:
                 cbks.on_eval_end(eval_logs)
             if self.stop_training:
                 break
-        cbks.on_train_end(logs)
+        return logs
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):

@@ -11,6 +11,7 @@ in one donated XLA program. hapi.Model and bench.py train through it.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Optional
 
 from ..core.tensor import Tensor
@@ -119,12 +120,28 @@ class TrainStep:
 
             self._lr_cell._replace_value(jnp.asarray(lr, jnp.float32))
             self._lr_host = lr
+        from ..observability.anomaly import monitor
         from ..observability.tracing import tracer
 
+        if not (tracer.enabled or monitor.enabled):
+            # both telemetry surfaces dark: two attribute reads, no clock
+            return self._compiled(*batch)
+        # snapshot once: the clock is only read for the monitor (tracer-only
+        # mode stays clock-free here — the span stamps its own), and a flag
+        # flip mid-step must not leave t0 unset at the close
+        timed = monitor.enabled
+        t0 = time.perf_counter() if timed else 0.0
         if tracer.enabled:
             with tracer.span("train.step", track="train_loop"):
-                return self._compiled(*batch)
-        return self._compiled(*batch)
+                out = self._compiled(*batch)
+        else:
+            out = self._compiled(*batch)
+        if timed:
+            # train-step close: the flight recorder's step-time regression
+            # detector sees the host-side dispatch wall (a retrace or a
+            # blocking sync shows up here orders of magnitude over median)
+            monitor.on_step(time.perf_counter() - t0)
+        return out
 
     @property
     def fallback_reason(self):

@@ -20,35 +20,71 @@ fragments. Three pieces:
   boundaries only, never forcing a device sync, feeding gauges
   comparable against the CM5xx peak-residency estimate.
 
+Egress + forensics (ISSUE 8) sit on top:
+
+- :mod:`export` — Prometheus-text / JSON exposition of ``snapshot()``
+  and the :class:`TelemetryServer` HTTP thread (``/metrics``,
+  ``/healthz``, ``/snapshot.json``, ``/trace.json``), owned by
+  ``ServingEngine(serve_telemetry_port=...)`` / ``FLAGS_telemetry_port``
+  or started standalone via ``python -m tools.telemetry --serve``.
+- :mod:`anomaly` — the :class:`AnomalyMonitor` flight recorder: rolling
+  median+MAD step-time regression, serving SLO-breach and
+  rejection-burst watchers, device-memory watermark-vs-budget, each
+  dumping a bounded, rate-limited forensic bundle (last-N spans + full
+  snapshot + verdict + step window) to ``FLAGS_telemetry_dump_dir``.
+- ``SpanTracer.capture_device`` — ``jax.profiler`` windows fused into
+  the SAME chrome-trace export as the host spans (``device.*`` tracks,
+  clock-aligned at capture boundaries).
+
 The OB6xx telemetry lint family (``analysis/telemetry_check.py``, run by
 ``python -m tools.lint``) gates the contract: no unclosed span at
 export, no duplicate metric registration, no device sync inside a
-sampler. ``python -m tools.telemetry`` dumps a demo snapshot + trace.
+sampler, no dead (never-fed) anomaly detector, no unbounded
+exporter/dump surface. ``python -m tools.telemetry`` dumps a demo
+snapshot + trace.
 """
 from __future__ import annotations
 
 from .adapters import register_default_collectors
+from .anomaly import AnomalyMonitor, monitor
 from .memory import DeviceMemorySampler, device_memory_stats, sampler
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, registry
 from .tracing import SpanTracer, tracer
 
 __all__ = [
-    "Counter", "DeviceMemorySampler", "Gauge", "Histogram",
-    "MetricsRegistry", "SpanTracer", "counter", "device_memory_stats",
-    "export_trace", "gauge", "histogram", "registry",
+    "AnomalyMonitor", "Counter", "DeviceMemorySampler", "Gauge",
+    "Histogram", "MetricsRegistry", "SpanTracer", "TelemetryServer",
+    "counter", "device_memory_stats", "export_trace", "gauge", "histogram",
+    "monitor", "prometheus_text", "registry",
     "register_default_collectors", "sampler", "snapshot", "span", "tracer",
 ]
 
 register_default_collectors(registry)
 
-# FLAGS_telemetry_trace is mirrored into the tracer's hot-path `enabled`
-# attribute (instrumented sites pay one attribute read, never a registry
-# lookup); this hook keeps a runtime paddle.set_flags(...) in sync with it
+
+def __getattr__(name: str):
+    # lazy egress re-exports: every `import paddle_tpu` reaches this
+    # package via tracing's consumers, and the stdlib http.server chain
+    # behind export.py is too heavy to pay at cold start for a surface
+    # that is off by default (FLAGS_telemetry_port=0)
+    if name in ("TelemetryServer", "prometheus_text"):
+        from . import export
+
+        return getattr(export, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+# FLAGS_telemetry_trace / FLAGS_telemetry_anomaly are mirrored into the
+# tracer's / monitor's hot-path `enabled` attributes (instrumented sites
+# pay one attribute read, never a registry lookup); these hooks keep a
+# runtime paddle.set_flags(...) in sync with them
 try:
     from ..base.flags import on_flag_change as _on_flag_change
 
     _on_flag_change("telemetry_trace",
                     lambda v: setattr(tracer, "enabled", bool(v)))
+    _on_flag_change("telemetry_anomaly",
+                    lambda v: setattr(monitor, "enabled", bool(v)))
 except Exception:
     pass
 

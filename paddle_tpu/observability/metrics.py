@@ -132,15 +132,23 @@ class Histogram(_Instrument):
 
     @staticmethod
     def _pct(sorted_vals: list, q: float):
+        """Percentile over the ring. THE empty-ring contract (shared with
+        ``ServingStats._pct`` and honored by the Prometheus exposition):
+        no samples → ``None`` — the quantile line is OMITTED from the
+        scrape output, never emitted as NaN."""
         if not sorted_vals:
             return None
         idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
         return sorted_vals[idx]
 
     def summary(self, **labels) -> Optional[dict]:
+        """``None`` when the label set has never observed anything (the
+        same contract as the empty-ring percentile: absent, not NaN);
+        otherwise count/sum/min/max/mean plus p50/p99 over the bounded
+        ring (which are themselves ``None`` if the ring is empty)."""
         with self._lock:
             cell = self._cells.get(_label_key(labels))
-            if cell is None:
+            if cell is None or cell["count"] == 0:
                 return None
             ring = sorted(cell["ring"])
             return {"count": cell["count"], "sum": cell["sum"],

@@ -27,6 +27,19 @@ Open-span accounting feeds the OB600 telemetry audit: exporting a trace
 while spans are still open means an instrumented region leaked its
 ``end()`` (an exception path without a ``with`` block) and its wall time
 is silently missing from the timeline.
+
+**Device-trace fusion** (ISSUE 8, the ROADMAP telemetry leftover): XLA's
+own profiler exports on a separate timeline. ``SpanTracer.capture_device``
+wraps ``jax.profiler.start_trace``/``stop_trace`` around a window, parses
+the chrome-trace JSON the profile run wrote, clock-aligns it at the
+capture boundary (the earliest device event is pinned to the host
+``perf_counter`` stamp taken right before ``start_trace``) and ingests
+the events under ``device.<thread>`` tracks — so ONE ``to_chrome_trace``
+export shows host spans and XLA's device lanes side by side. The merged
+set is bounded by ``FLAGS_telemetry_device_trace_max_events`` (most
+recent kept) and the whole path degrades to a logged no-op when the
+profiler is unavailable (already active, unsupported backend, CPU CI
+without the plugin).
 """
 from __future__ import annotations
 
@@ -79,6 +92,115 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+def _load_xla_chrome_trace(log_dir: str) -> Optional[dict]:
+    """The chrome-trace JSON an ``xla``/``jax.profiler`` run wrote under
+    ``log_dir`` (newest ``plugins/profile/<run>/``), or None. Prefers the
+    per-host ``*.trace.json.gz`` (named thread lanes); falls back to
+    ``perfetto_trace.json.gz``."""
+    import glob
+    import gzip
+
+    runs = sorted(glob.glob(os.path.join(log_dir, "plugins", "profile", "*")))
+    if not runs:
+        return None
+    run = runs[-1]
+    paths = (sorted(glob.glob(os.path.join(run, "*.trace.json.gz")))
+             or glob.glob(os.path.join(run, "perfetto_trace.json.gz")))
+    if not paths:
+        return None
+    with gzip.open(paths[0], "rt") as f:
+        return json.load(f)
+
+
+def _normalize_device_events(trace: dict, t0_us: float,
+                             include_python: bool = False) -> List[tuple]:
+    """XLA chrome-trace events → this tracer's event tuples on
+    ``device.<thread>`` tracks, clock-aligned so the earliest device
+    event lands at ``t0_us`` (the host ``perf_counter`` stamp taken at
+    the capture boundary). The profiler's python-callstack lane
+    duplicates what the host tracks already carry; it is dropped unless
+    ``include_python``."""
+    events = trace.get("traceEvents", []) if trace else []
+    threads = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            threads[(e.get("pid"), e.get("tid"))] = (
+                e.get("args") or {}).get("name", "")
+    xs = [e for e in events if e.get("ph") == "X" and "ts" in e]
+    if not xs:
+        return []
+    ts_min = min(float(e["ts"]) for e in xs)
+    out = []
+    for e in xs:
+        tname = threads.get((e.get("pid"), e.get("tid")),
+                            f"tid{e.get('tid')}")
+        if not include_python and tname == "python":
+            continue
+        args = e.get("args") or None
+        out.append(("X", e.get("name", "?"), f"device.{tname}",
+                    t0_us + (float(e["ts"]) - ts_min),
+                    float(e.get("dur", 0.0)), args))
+    out.sort(key=lambda ev: ev[3])
+    return out
+
+
+class _DeviceCapture:
+    """One ``jax.profiler`` window fused into the owning tracer's export.
+    Degrades to a logged no-op when the profiler cannot start (already
+    active, missing plugin) — CPU CI must never fail on it."""
+
+    def __init__(self, tracer_: "SpanTracer", log_dir: Optional[str],
+                 include_python: bool):
+        self.tracer = tracer_
+        self._log_dir = log_dir
+        self._own_dir = log_dir is None
+        self._include_python = include_python
+        self._active = False
+        self._t0_us = 0.0
+
+    def __enter__(self) -> "_DeviceCapture":
+        import tempfile
+
+        from ..base.log import get_logger
+
+        if self._log_dir is None:
+            self._log_dir = tempfile.mkdtemp(prefix="paddle_device_trace_")
+        self._t0_us = time.perf_counter() * 1e6
+        try:
+            import jax
+
+            jax.profiler.start_trace(self._log_dir)
+            self._active = True
+        except Exception as e:
+            get_logger().info("device trace capture unavailable "
+                              "(degrading to host-only): %s", e)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import shutil
+
+        from ..base.log import get_logger
+
+        try:
+            if self._active:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    get_logger().info("device trace stop failed: %s", e)
+                    return
+                n = self.tracer.ingest_device_trace_dir(
+                    self._log_dir, self._t0_us,
+                    include_python=self._include_python)
+                get_logger().info("device trace fused: %d event(s) from %s",
+                                  n, self._log_dir)
+        finally:
+            self._active = False
+            if self._own_dir:
+                shutil.rmtree(self._log_dir, ignore_errors=True)
+
+
 class SpanTracer:
     """Bounded, thread-safe event ring with chrome-trace export."""
 
@@ -86,6 +208,7 @@ class SpanTracer:
                  max_events: Optional[int] = None):
         self._lock = threading.Lock()
         self._events: List[tuple] = []   # (ph, name, track, ts_us, dur_us, args)
+        self._device_events: List[tuple] = []  # same tuples, device.* tracks
         self._open: dict = {}            # id(_Span) -> _Span
         self._tids: dict = {}            # track name -> tid
         self._dropped = 0
@@ -111,6 +234,7 @@ class SpanTracer:
     def reset(self) -> None:
         with self._lock:
             self._events.clear()
+            self._device_events.clear()
             self._open.clear()
             self._dropped = 0
 
@@ -123,6 +247,20 @@ class SpanTracer:
             return int(get_flag("telemetry_trace_max_events"))
         except Exception:
             return 65536
+
+    def capacity(self) -> int:
+        """The ring bound currently in force (<=0 = unbounded — the
+        OB604 audit flags that when an exporter is serving this trace)."""
+        return self._cap()
+
+    @staticmethod
+    def _device_cap() -> int:
+        try:
+            from ..base.flags import get_flag
+
+            return int(get_flag("telemetry_device_trace_max_events"))
+        except Exception:
+            return 20000
 
     # ------------------------------------------------------------ recording
     def span(self, name: str, track: str = "host", **args):
@@ -169,6 +307,60 @@ class SpanTracer:
             del self._events[:drop]
             self._dropped += drop
 
+    # ----------------------------------------------------- device fusion
+    def capture_device(self, log_dir: Optional[str] = None,
+                       include_python: bool = False) -> _DeviceCapture:
+        """``with tracer.capture_device(): ...device work...`` — profile
+        the window with ``jax.profiler`` and merge XLA's trace events
+        into THIS tracer's export under ``device.*`` tracks, clock-aligned
+        at the capture boundary. Explicit opt-in: it records regardless
+        of ``enabled`` (profiling a window is a deliberate act, not a
+        steady-state instrumentation site). ``log_dir=None`` uses a
+        temporary directory, deleted after ingestion; pass a real one to
+        additionally keep the TensorBoard/XProf artifacts."""
+        return _DeviceCapture(self, log_dir, include_python)
+
+    def ingest_device_trace_dir(self, log_dir: str, t0_us: float,
+                                include_python: bool = False) -> int:
+        """Parse an XLA profile run under ``log_dir`` and merge its
+        events (see module docstring). Returns how many landed; 0 —
+        never an exception — when the run wrote nothing parseable."""
+        try:
+            trace = _load_xla_chrome_trace(log_dir)
+            events = _normalize_device_events(trace, t0_us,
+                                              include_python=include_python)
+        except Exception as e:
+            from ..base.log import get_logger
+
+            get_logger().info("device trace parse failed (%s): %s",
+                              log_dir, e)
+            return 0
+        if not events:
+            return 0
+        cap = self._device_cap()
+        with self._lock:
+            self._device_events.extend(events)
+            if cap > 0 and len(self._device_events) > cap:
+                drop = len(self._device_events) - cap
+                del self._device_events[:drop]
+                self._dropped += drop
+        # count and return only what the cap let into the timeline:
+        # parsing 5000 events into a 100-slot ring must not read as
+        # 5000 fused ("how many landed", per the contract above)
+        kept = min(len(events), cap) if cap > 0 else len(events)
+        from .metrics import registry
+
+        if kept:
+            registry.counter(
+                "telemetry.device_trace_events",
+                "XLA device-trace events fused into the unified timeline"
+            ).inc(kept)
+        return kept
+
+    def device_event_count(self) -> int:
+        with self._lock:
+            return len(self._device_events)
+
     # ------------------------------------------------------------ reporting
     def open_spans(self) -> List[str]:
         """Names of spans begun but never ended — the OB600 audit input."""
@@ -186,29 +378,43 @@ class SpanTracer:
                 tid = self._tids[track] = len(self._tids) + 1
             return tid
 
-    def to_chrome_trace(self) -> dict:
-        """The timeline as a chrome://tracing / Perfetto JSON object.
-        Tracks become named tid lanes under one pid; span ``args`` ride
-        through for the Perfetto details pane."""
+    def _event_dict(self, event: tuple, pid: int) -> dict:
+        ph, name, track, ts, dur, args = event
+        ev = {"ph": ph, "name": name, "pid": pid,
+              "tid": self._tid(track), "ts": ts, "cat": track}
+        if ph == "X":
+            ev["dur"] = dur
+        else:
+            ev["s"] = "t"  # instant scope: thread
+        if args:
+            ev["args"] = dict(args)
+        return ev
+
+    def tail_chrome_events(self, n: int = 512) -> List[dict]:
+        """The most recent ``n`` host events as chrome-trace dicts — the
+        anomaly flight recorder's bounded span window."""
+        if (n := int(n)) <= 0:
+            return []
         pid = os.getpid()
         with self._lock:
-            events = list(self._events)
+            events = list(self._events[-n:])
+        return [self._event_dict(e, pid) for e in events]
+
+    def to_chrome_trace(self) -> dict:
+        """The timeline as a chrome://tracing / Perfetto JSON object.
+        Tracks — host AND any fused ``device.*`` lanes — become named tid
+        lanes under one pid; span ``args`` ride through for the Perfetto
+        details pane."""
+        pid = os.getpid()
+        with self._lock:
+            events = list(self._events) + list(self._device_events)
             dropped = self._dropped
         out = []
         for track in {e[2] for e in events}:
             out.append({"ph": "M", "name": "thread_name", "pid": pid,
                         "tid": self._tid(track),
                         "args": {"name": track}})
-        for ph, name, track, ts, dur, args in events:
-            ev = {"ph": ph, "name": name, "pid": pid,
-                  "tid": self._tid(track), "ts": ts, "cat": track}
-            if ph == "X":
-                ev["dur"] = dur
-            else:
-                ev["s"] = "t"  # instant scope: thread
-            if args:
-                ev["args"] = dict(args)
-            out.append(ev)
+        out.extend(self._event_dict(e, pid) for e in events)
         trace = {"traceEvents": out, "displayTimeUnit": "ms"}
         if dropped:
             trace["otherData"] = {"dropped_events": dropped}

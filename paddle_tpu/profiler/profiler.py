@@ -174,6 +174,8 @@ class Profiler:
 
             self._device_trace_dir = self._device_trace_dir or os.path.join(
                 os.getcwd(), "profiler_log", f"xla_{int(time.time())}")
+            # capture-boundary stamp for the unified-timeline fusion below
+            self._device_t0_us = time.perf_counter() * 1e6
             try:
                 jax.profiler.start_trace(self._device_trace_dir)
                 self._device_active = True
@@ -188,6 +190,16 @@ class Profiler:
                 jax.profiler.stop_trace()
             finally:
                 self._device_active = False
+            # device-trace fusion (ISSUE 8 / ROADMAP telemetry leftover):
+            # with the unified tracer recording, XLA's window lands in the
+            # SAME chrome-trace export as the host spans instead of only
+            # a separate TensorBoard dir (which is still kept on disk)
+            from ..observability.tracing import tracer
+
+            if tracer.enabled:
+                tracer.ingest_device_trace_dir(
+                    self._device_trace_dir,
+                    getattr(self, "_device_t0_us", 0.0))
 
     # -------------------------------------------------------------- state
     def _sync_op_hook(self):

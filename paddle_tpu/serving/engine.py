@@ -45,6 +45,7 @@ class ServingEngine:
                  max_queue: Optional[int] = None,
                  tenant_quota: Optional[int] = None,
                  linger_ms: Optional[float] = None,
+                 serve_telemetry_port: Optional[int] = None,
                  stats=serving_stats):
         self.predictor = (model if isinstance(model, Predictor)
                           else Predictor(Config(model)))
@@ -65,6 +66,17 @@ class ServingEngine:
             linger_s=linger, on_batch=self._on_batch)
         self._compiles_at_warmup: Optional[int] = None
         self._started = False
+        # telemetry egress (ISSUE 8): the engine owns one exporter thread.
+        # None defers to FLAGS_telemetry_port (0 there = disabled); an
+        # EXPLICIT integer always serves (0 = pick an ephemeral port, the
+        # test/bench path). Started at warmup, stopped at shutdown.
+        if serve_telemetry_port is None:
+            flag_port = int(get_flag("telemetry_port"))
+            self._telemetry_port = flag_port if flag_port > 0 else None
+        else:
+            self._telemetry_port = int(serve_telemetry_port)
+        self._telemetry_port_explicit = serve_telemetry_port is not None
+        self._telemetry_server = None
 
     # ------------------------------------------------------------ lifecycle
     def warmup(self) -> "ServingEngine":
@@ -73,6 +85,27 @@ class ServingEngine:
         scheduler thread."""
         self.predictor.warmup_ladder()
         self._compiles_at_warmup = self.predictor.compile_count
+        # bind the exporter port BEFORE the scheduler thread: an explicit
+        # serve_telemetry_port that fails to bind raises with no stray
+        # worker running, instead of leaving a half-started engine nobody
+        # will shut down. A FLAGS_telemetry_port bind failure only degrades
+        # (telemetry must never take down serving): every engine in the
+        # process resolves the same flag port, so the second one would
+        # always lose the race.
+        if self._telemetry_port is not None and self._telemetry_server is None:
+            from ..observability.export import TelemetryServer
+
+            try:
+                self._telemetry_server = TelemetryServer(
+                    port=self._telemetry_port,
+                    health_fn=self.telemetry_health).start()
+            except OSError as e:
+                if self._telemetry_port_explicit:
+                    raise
+                from ..base.log import get_logger
+                get_logger().warning(
+                    "telemetry exporter port %d unavailable (%s); "
+                    "serving continues without egress", self._telemetry_port, e)
         if not self._started:
             self._scheduler.start()
             self._started = True
@@ -87,11 +120,16 @@ class ServingEngine:
         self.queue.close()
         if not drain:
             self.queue.fail_pending(RejectedError("serving engine shut down"))
-        if self._started:
-            if not self._scheduler.join(timeout):
-                raise TimeoutError("serving scheduler did not drain in "
-                                   f"{timeout}s")
-            self._started = False
+        try:
+            if self._started:
+                if not self._scheduler.join(timeout):
+                    raise TimeoutError("serving scheduler did not drain in "
+                                       f"{timeout}s")
+                self._started = False
+        finally:
+            if self._telemetry_server is not None:
+                self._telemetry_server.stop()
+                self._telemetry_server = None
 
     def __enter__(self) -> "ServingEngine":
         return self.warmup()
@@ -155,6 +193,8 @@ class ServingEngine:
         leaves = fetch_outputs(jax.tree_util.tree_leaves(
             out, is_leaf=lambda x: hasattr(x, "shape")))
         rows = scatter_outputs(leaves, requests)
+        from ..observability.anomaly import monitor
+
         for r, outs in zip(requests, rows):
             self.queue.admission.on_complete(r.tenant, r.n)
             r._complete(outs)
@@ -173,10 +213,43 @@ class ServingEngine:
                     request_id=r.id, n=r.n, bucket=bucket,
                     queue_wait_ms=round((r.t_dispatch - r.t_admit) * 1e3, 3),
                     execute_ms=round((r.t_complete - r.t_dispatch) * 1e3, 3))
+        if monitor.enabled:
+            # serving batch close: the SLO-breach watcher sees every
+            # completed request's latency + queue-wait share. Fed AFTER the
+            # completion loop — a triggered forensic dump is disk I/O on
+            # the scheduler thread and must not delay co-batched requests'
+            # futures (the cooldown bounds it to one dump per kind window)
+            for r in requests:
+                monitor.on_serving_request(
+                    r.t_complete - r.t_enqueue, r.t_dispatch - r.t_admit,
+                    tenant=r.tenant)
 
     def _on_batch(self, n_samples: int, bucket: int, depth: int) -> None:
         self.stats.record_batch(n_samples, bucket)
         self.stats.record_queue_depth(depth)
+
+    # ------------------------------------------------------------ telemetry
+    def telemetry_health(self) -> dict:
+        """The ``/healthz`` payload: scheduler-worker liveness (the one
+        thread whose death silently strands every queued request), queue
+        depth and the zero-retrace proof. ``ok`` follows worker liveness
+        while the engine is supposed to be serving."""
+        alive = self._scheduler.alive()
+        return {
+            "ok": bool(alive) if self._started else True,
+            "worker_alive": bool(alive),
+            "started": self._started,
+            "queue_depth_requests": len(self.queue),
+            "queue_depth_samples": self.queue.depth_samples(),
+            "compiles_after_warmup": self.compiles_after_warmup,
+            "tenants": len(self._tenants),
+        }
+
+    @property
+    def telemetry_url(self) -> Optional[str]:
+        """The engine-owned exporter's base URL (None when not serving)."""
+        srv = self._telemetry_server
+        return srv.url if srv is not None else None
 
     # ------------------------------------------------------------ accounting
     @property

@@ -172,14 +172,24 @@ class RequestQueue:
             gate = self.admission.try_admit(request.tenant, request.n)
             if gate is not None:
                 self.stats.record_rejected(tenant=request.tenant)
-                raise AdmissionError(gate, (
+                refusal = (
                     f"request of {request.n} samples refused by the "
                     f"'{gate}' gate (tenant={request.tenant!r}: "
                     f"{self.admission.inflight(request.tenant)} in flight, "
-                    f"queue={self.admission._queued} samples)"))
-            request.t_admit = time.perf_counter()
-            self._dq.append(request)
-            self._cond.notify()
+                    f"queue={self.admission._queued} samples)")
+            else:
+                request.t_admit = time.perf_counter()
+                self._dq.append(request)
+                self._cond.notify()
+        if gate is not None:
+            from ..observability.anomaly import monitor
+
+            # rejection-burst watcher, fed OUTSIDE the condition lock: a
+            # triggered verdict writes a forensic bundle, and that disk
+            # I/O must never stall other tenants' submits or take_batch
+            if monitor.enabled:
+                monitor.on_rejected(request.tenant)
+            raise AdmissionError(gate, refusal)
         return request
 
     def take_batch(self, buckets, max_total: Optional[int] = None,

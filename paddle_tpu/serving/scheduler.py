@@ -129,6 +129,7 @@ class Scheduler:
         return self
 
     def _loop(self) -> None:
+        from ..observability.anomaly import monitor
         from ..observability.memory import sampler
         from ..observability.tracing import tracer
 
@@ -154,12 +155,21 @@ class Scheduler:
                                  n_requests=len(requests)):
                     self.execute(requests, bucket)
             except BaseException as e:  # noqa: BLE001 — batch-scoped fault wall
+                if monitor.enabled:
+                    # serving-worker exception hook: capture the forensic
+                    # window BEFORE the batch is failed away (the flight
+                    # recorder is the only record once result() re-raises)
+                    monitor.on_exception("serving.worker", e)
                 for r in requests:
                     self.queue.admission.on_complete(r.tenant, r.n)
                     r._fail(e)
             # batch-boundary memory telemetry (sync-free by contract)
             sampler.maybe_sample("batch")
         self._stopped.set()
+
+    def alive(self) -> bool:
+        """Is the executor thread running? (the /healthz liveness probe)"""
+        return self._thread is not None and self._thread.is_alive()
 
     def join(self, timeout: Optional[float] = None) -> bool:
         """Wait for the loop to exit (after ``queue.close()``)."""

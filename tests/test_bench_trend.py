@@ -1,0 +1,121 @@
+"""tools/bench_trend — the BENCH_r*.json trajectory gate (ISSUE 8).
+
+Tier-1 smoke: the gate must read the repo's real bench history without
+crashing (missing/cpu_fallback rounds included) and judge it OK — the
+driver appends a new run every PR, so this is the regression tripwire
+staying exercised. Synthetic trajectories pin the judgment itself:
+>20% below best prior fails, recovery/missing/single-run cases pass.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from tools.bench_trend import (DEFAULT_METRIC, judge, load_trajectory,
+                               main)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_run(dirpath, n, value=None, rc=0, note="cpu_fallback",
+               metric=DEFAULT_METRIC, parsed_override="unset"):
+    payload = {"n": n, "cmd": "bench", "rc": rc, "tail": ""}
+    if parsed_override != "unset":
+        payload["parsed"] = parsed_override
+    elif value is not None:
+        payload["parsed"] = {"metric": metric, "value": value,
+                             "unit": "tokens/sec", "note": note}
+    else:
+        payload["parsed"] = None
+    path = os.path.join(dirpath, f"BENCH_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+class TestLiveRepoSmoke:
+    def test_repo_trajectory_loads_and_passes_gate(self, capsys):
+        """The real bench history (crashed rounds, cpu_fallback notes and
+        all) loads cleanly and the latest run is within the gate."""
+        rc = main(["--dir", REPO_ROOT])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert DEFAULT_METRIC in out
+        assert "OK:" in out
+
+    def test_repo_trajectory_json_shape(self, capsys):
+        rc = main(["--dir", REPO_ROOT, "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert payload["metric"] == DEFAULT_METRIC
+        assert payload["verdict"]["ok"] is True
+        # every BENCH_r*.json contributed a row, parsed or not
+        import glob
+
+        assert len(payload["runs"]) == len(
+            glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
+
+
+class TestJudgment:
+    def test_regression_past_threshold_fails(self, tmp_path, capsys):
+        _write_run(str(tmp_path), 1, 25000.0)
+        _write_run(str(tmp_path), 2, 19000.0)  # -24% vs best prior
+        rc = main(["--dir", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REGRESSION" in out
+
+    def test_gate_is_vs_best_prior_not_vs_previous(self, tmp_path):
+        # a slow middle run must not reset the bar: r3 is fine vs r2 but
+        # -28% vs the best run r1 — that is the regression
+        _write_run(str(tmp_path), 1, 25000.0)
+        _write_run(str(tmp_path), 2, 17000.0)
+        _write_run(str(tmp_path), 3, 18000.0)
+        rows = load_trajectory(str(tmp_path))
+        verdict = judge(rows, 0.20)
+        assert verdict["ok"] is False
+        assert verdict["best_prior"]["run"] == 1
+
+    def test_within_threshold_passes(self, tmp_path):
+        _write_run(str(tmp_path), 1, 25000.0)
+        _write_run(str(tmp_path), 2, 21000.0)  # -16%
+        verdict = judge(load_trajectory(str(tmp_path)), 0.20)
+        assert verdict["ok"] is True
+        assert verdict["delta_vs_best"] == -0.16
+
+    def test_missing_and_crashed_runs_tolerated(self, tmp_path):
+        _write_run(str(tmp_path), 1, value=None, rc=1)     # crashed round
+        _write_run(str(tmp_path), 3, 20000.0)              # r2 never wrote
+        _write_run(str(tmp_path), 4, value=None, rc=124)   # timeout round
+        _write_run(str(tmp_path), 5, 19000.0)
+        rows = load_trajectory(str(tmp_path))
+        assert [r["run"] for r in rows] == [1, 3, 4, 5]
+        assert [r["run"] for r in rows if r["value"] is not None] == [3, 5]
+        verdict = judge(rows, 0.20)
+        assert verdict["ok"] is True  # -5% vs best prior (r3)
+
+    def test_single_and_zero_parsed_runs_pass(self, tmp_path):
+        verdict = judge(load_trajectory(str(tmp_path)), 0.20)
+        assert verdict["ok"] is True and "no parsed runs" in verdict["reason"]
+        _write_run(str(tmp_path), 1, 20000.0)
+        verdict = judge(load_trajectory(str(tmp_path)), 0.20)
+        assert verdict["ok"] is True and "single parsed" in verdict["reason"]
+
+    def test_other_metric_and_corrupt_json_are_skipped(self, tmp_path):
+        _write_run(str(tmp_path), 1, 123.0, metric="some_other_metric")
+        with open(os.path.join(str(tmp_path), "BENCH_r02.json"), "w") as f:
+            f.write("{not json")
+        _write_run(str(tmp_path), 3, 20000.0)
+        rows = load_trajectory(str(tmp_path))
+        assert rows[0]["value"] is None
+        assert "other metric" in rows[0]["note"]
+        assert rows[1]["value"] is None
+        assert "unreadable" in rows[1]["note"]
+        assert rows[2]["value"] == 20000.0
+
+    def test_threshold_flag_tightens_gate(self, tmp_path, capsys):
+        _write_run(str(tmp_path), 1, 25000.0)
+        _write_run(str(tmp_path), 2, 22000.0)  # -12%
+        assert main(["--dir", str(tmp_path)]) == 0
+        capsys.readouterr()
+        assert main(["--dir", str(tmp_path), "--threshold", "0.10"]) == 1

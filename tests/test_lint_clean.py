@@ -125,17 +125,21 @@ def test_serving_audit_green_on_demo_engine(tmp_path):
 
 
 def test_telemetry_contract_green_on_live_process():
-    """ISSUE 7: the observability layer's own contract holds — the
+    """ISSUE 7 + 8: the observability layer's own contract holds — the
     observability/ tree has no device sync inside a sampler (OB602), the
-    demo telemetry session and the LIVE process tracer/registry audit
-    clean (OB600/OB601)."""
+    demo telemetry session (with its fed demo anomaly monitor) and the
+    LIVE process tracer/registry/monitor/exporters audit clean
+    (OB600/OB601/OB603/OB604)."""
     from paddle_tpu.analysis.telemetry_check import (
-        audit_telemetry, check_paths, record_demo_telemetry)
+        audit_telemetry, check_paths, record_demo_monitor,
+        record_demo_telemetry)
 
     obs_dir = os.path.join(_REPO, "paddle_tpu", "observability")
     assert _errors(check_paths([obs_dir])) == []
     tracer, registry = record_demo_telemetry()
-    assert [str(f) for f in audit_telemetry(tracer, registry)] == []
+    monitor = record_demo_monitor(tracer, registry)
+    assert [str(f) for f in audit_telemetry(tracer, registry, monitor=monitor,
+                                            servers=[])] == []  # hermetic demo
     assert [str(f) for f in audit_telemetry()] == []  # live process state
 
 

@@ -1,0 +1,149 @@
+"""``python -m tools.bench_trend`` — the bench trajectory as a gate.
+
+The driver captures one ``BENCH_r*.json`` per PR round; each carries the
+headline metric (``gpt_tiny_train_tokens_per_sec_cpu`` — TPU probes still
+hang on this host, so the CPU number is the only trend we have) plus a
+``note`` (``cpu_fallback``) and the raw runner exit code. Early rounds
+may have no parsed payload at all (rc != 0); the tool tolerates both —
+a trend gate that crashes on the history it is supposed to read is
+worse than none.
+
+Prints per-run values and deltas (vs the previous parsed run and vs the
+best prior run), then judges the LATEST parsed run: a drop of more than
+``--threshold`` (default 20%) against the best prior run exits non-zero.
+The threshold is deliberately wider than the observed driver-box load
+swing (19.5k–25.1k tokens/sec across identical code) — this catches a
+framework regression, not scheduler noise. Wired as a tier-1 smoke test
+(``tests/test_bench_trend.py``) so the gate itself stays exercised.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+DEFAULT_METRIC = "gpt_tiny_train_tokens_per_sec_cpu"
+
+_RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_trajectory(bench_dir: str, metric: str = DEFAULT_METRIC) -> List[dict]:
+    """Every ``BENCH_r*.json`` under ``bench_dir`` in run order, reduced
+    to ``{run, path, value, note, rc}``. Runs without a parsed payload
+    (crashed/timed-out rounds) or reporting a different metric keep their
+    row with ``value=None`` — visible in the trend print, ignored by the
+    regression math."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+        m = _RUN_RE.search(path)
+        if not m:
+            continue
+        row = {"run": int(m.group(1)), "path": os.path.basename(path),
+               "value": None, "note": None, "rc": None}
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            row["note"] = f"unreadable: {e}"
+            rows.append(row)
+            continue
+        row["rc"] = payload.get("rc")
+        parsed = payload.get("parsed")
+        if isinstance(parsed, dict) and parsed.get("metric") == metric:
+            try:
+                row["value"] = float(parsed["value"])
+            except (KeyError, TypeError, ValueError):
+                row["value"] = None
+            row["note"] = parsed.get("note")
+        elif isinstance(parsed, dict):
+            row["note"] = f"other metric: {parsed.get('metric')}"
+        else:
+            row["note"] = "no parsed payload"
+        rows.append(row)
+    rows.sort(key=lambda r: r["run"])
+    return rows
+
+
+def judge(rows: List[dict], threshold: float) -> dict:
+    """The regression verdict over a loaded trajectory: latest parsed
+    value vs the best PRIOR parsed value. Fewer than two parsed runs →
+    nothing to judge (ok=True, reason says why)."""
+    parsed = [r for r in rows if r["value"] is not None]
+    verdict = {"ok": True, "threshold": threshold, "latest": None,
+               "best_prior": None, "delta_vs_best": None, "reason": None}
+    if not parsed:
+        verdict["reason"] = "no parsed runs"
+        return verdict
+    latest = parsed[-1]
+    verdict["latest"] = {"run": latest["run"], "value": latest["value"]}
+    prior = parsed[:-1]
+    if not prior:
+        verdict["reason"] = "single parsed run — no prior to compare"
+        return verdict
+    best = max(prior, key=lambda r: r["value"])
+    delta = latest["value"] / best["value"] - 1.0
+    verdict["best_prior"] = {"run": best["run"], "value": best["value"]}
+    verdict["delta_vs_best"] = round(delta, 4)
+    if delta < -threshold:
+        verdict["ok"] = False
+        verdict["reason"] = (
+            f"run {latest['run']} is {-delta:.1%} below the best prior run "
+            f"{best['run']} ({latest['value']:.1f} vs {best['value']:.1f}) "
+            f"— past the {threshold:.0%} regression gate")
+    else:
+        verdict["reason"] = (
+            f"run {latest['run']} within {threshold:.0%} of best prior "
+            f"(delta {delta:+.1%})")
+    return verdict
+
+
+def format_trend(rows: List[dict], metric: str) -> str:
+    lines = [f"{metric}:"]
+    prev: Optional[float] = None
+    for r in rows:
+        if r["value"] is None:
+            lines.append(f"  r{r['run']:02d}  —            "
+                         f"[{r['note']}" + (f", rc={r['rc']}" if r["rc"]
+                                            else "") + "]")
+            continue
+        step = ("" if prev is None
+                else f"  ({r['value'] / prev - 1.0:+.1%} vs prev)")
+        note = f"  [{r['note']}]" if r["note"] else ""
+        lines.append(f"  r{r['run']:02d}  {r['value']:>10.1f}{step}{note}")
+        prev = r["value"]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.bench_trend",
+        description="print the BENCH_r*.json metric trajectory and gate "
+                    "on a regression of the latest run vs the best prior")
+    parser.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    parser.add_argument("--metric", default=DEFAULT_METRIC)
+    parser.add_argument("--threshold", type=float, default=0.20,
+                        help="fractional regression that fails the gate "
+                             "(default 0.20 = 20%%)")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+
+    rows = load_trajectory(args.dir, args.metric)
+    verdict = judge(rows, args.threshold)
+    if args.as_json:
+        print(json.dumps({"metric": args.metric, "runs": rows,
+                          "verdict": verdict}, indent=2))
+    else:
+        print(format_trend(rows, args.metric))
+        print(("OK: " if verdict["ok"] else "REGRESSION: ")
+              + str(verdict["reason"]))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -27,9 +27,10 @@ Runs the eight ``paddle_tpu.analysis`` analyzers and reports findings:
                 ladder coverage),
 - **telemetry**: the observability layer's contract (OB6xx): static scan
                 of ``paddle_tpu/observability/`` for device syncs inside
-                memory samplers, plus unclosed-span / duplicate-metric
-                audits over a demo telemetry session AND the live
-                process tracer + registry.
+                memory samplers, plus unclosed-span / duplicate-metric /
+                dead-anomaly-monitor / unbounded-egress audits over a
+                demo telemetry session (with a fed demo monitor) AND the
+                live process tracer + registry + monitor + exporters.
 
 Exit-code contract (stable, CI-gateable):
   0 = no error-severity findings (warnings never gate)
@@ -193,13 +194,19 @@ def _run_telemetry(_paths, include_tests=False):
     an unclosed span or schema collision anywhere this process fails the
     commit, not just in the demo."""
     from paddle_tpu.analysis.telemetry_check import (
-        audit_telemetry, check_paths, record_demo_telemetry)
+        audit_telemetry, check_paths, record_demo_monitor,
+        record_demo_telemetry)
 
     findings = check_paths(
         [os.path.join(_REPO_ROOT, "paddle_tpu", "observability")])
     demo_tracer, demo_registry = record_demo_telemetry()
-    findings += audit_telemetry(demo_tracer, demo_registry)
-    findings += audit_telemetry()  # the live global tracer + registry
+    demo_monitor = record_demo_monitor(demo_tracer, demo_registry)
+    # hermetic demo pass: servers=[] — any live exporter belongs to the
+    # live audit below, not to the demo session (and would double-count)
+    findings += audit_telemetry(demo_tracer, demo_registry,
+                                monitor=demo_monitor, servers=[])
+    # the live global tracer/registry/monitor + any running exporters
+    findings += audit_telemetry()
     return findings
 
 

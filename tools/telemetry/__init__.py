@@ -17,6 +17,20 @@ tracing enabled, then writes:
 The acceptance demo for ISSUE 7: ONE process, ONE trace file, dispatch +
 train-loop + serving spans together. ``--json`` prints a machine-readable
 summary (paths, event/track counts, key counters) instead of prose.
+
+``--serve`` (ISSUE 8) additionally lights the egress path: the demo step
+runs, a demo multi-tenant engine stays WARM, and a
+:class:`~paddle_tpu.observability.export.TelemetryServer` serves
+``/metrics`` (Prometheus text), ``/healthz`` (the live engine's
+queue-depth / worker-liveness / compiles_after_warmup report),
+``/snapshot.json`` and ``/trace.json`` on ``--port`` (default
+``FLAGS_telemetry_port``; 0 picks an ephemeral one). ``--once`` scrapes
+its own endpoints, prints the results and exits — the CI-able
+acceptance path; without it the process serves until Ctrl-C.
+``--dump-on-anomaly DIR`` arms the flight recorder
+(``FLAGS_telemetry_anomaly`` + ``FLAGS_telemetry_dump_dir``) so a
+detector trigger or worker exception writes a forensic bundle under
+``DIR`` while the exporter shows the ``anomaly.*`` counters.
 """
 from __future__ import annotations
 
@@ -25,6 +39,7 @@ import json
 import os
 import sys
 import tempfile
+import time
 
 
 def run_demo(out_dir: str) -> dict:
@@ -72,16 +87,179 @@ def run_demo(out_dir: str) -> dict:
     }
 
 
+def _build_live_engine(tmpdir: str, port: int):
+    """A warm demo multi-tenant engine on the GLOBAL serving stats (so
+    the scrape carries real serving series), left RUNNING — the caller
+    owns shutdown. Mirrors ``jaxpr_audit.record_demo_engine`` except for
+    stats ownership and lifetime. ``port`` is passed through as the
+    engine-owned exporter's port so an explicit ``--port`` always wins
+    over ``FLAGS_telemetry_port`` (the engine would otherwise bind the
+    flag port at warmup and the CLI's choice would be silently lost)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    net.eval()
+    prefix = os.path.join(tmpdir, "demo_served")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.static.InputSpec([None, 8],
+                                                        "float32")])
+    engine = ServingEngine(prefix, buckets=[1, 2, 4],
+                           serve_telemetry_port=port).warmup()
+    try:
+        rs = np.random.RandomState(0)
+        for tenant, n in (("a", 1), ("b", 3), ("a", 2), ("b", 4)):
+            engine.run(tenant, rs.randn(n, 8).astype(np.float32))
+    except BaseException:
+        # the caller only owns shutdown once it HOLDS the engine: a
+        # failed warm-traffic call must not strand the scheduler thread
+        # and exporter (which would haunt active_servers() process-wide)
+        engine.shutdown(drain=False)
+        raise
+    return engine
+
+
+def run_serve(port: int, once: bool, dump_dir: str = None) -> dict:
+    """The ``--serve`` path: demo step + live warm engine behind a
+    TelemetryServer. ``once`` scrapes and returns; otherwise blocks until
+    interrupted. Returns the summary payload (scrape bodies included so
+    the acceptance test can assert on them in-process)."""
+    from paddle_tpu.analysis.jaxpr_audit import record_demo_step
+    from paddle_tpu.analysis.telemetry_check import audit_telemetry
+    from paddle_tpu.base.flags import set_flags
+    from paddle_tpu.observability import tracer
+    from paddle_tpu.observability.anomaly import monitor
+
+    from paddle_tpu.base.flags import get_flag
+
+    flags_before = None
+    if dump_dir:
+        os.makedirs(dump_dir, exist_ok=True)
+        flags_before = {"telemetry_anomaly": get_flag("telemetry_anomaly"),
+                        "telemetry_dump_dir": get_flag("telemetry_dump_dir")}
+        # the flag hooks mirror these into monitor.enabled / dump_dir
+        set_flags({"telemetry_anomaly": True,
+                   "telemetry_dump_dir": dump_dir})
+    was_enabled = tracer.enabled
+    tracer.enable()
+    tmpdir = tempfile.mkdtemp(prefix="paddle_telemetry_serve_")
+    engine = None
+    server = None
+    try:
+        record_demo_step()
+        engine = _build_live_engine(tmpdir, port)
+        server = engine._telemetry_server
+        summary = {"url": server.url, "port": server.port,
+                   "dump_dir": dump_dir or None,
+                   "anomaly_armed": monitor.enabled}
+        if once:
+            status, metrics_body = server.scrape("/metrics")
+            h_status, health_body = server.scrape("/healthz")
+            t_status, trace_body = server.scrape("/trace.json")
+            summary.update({
+                "metrics_status": status,
+                "metrics_body": metrics_body,
+                "healthz_status": h_status,
+                "healthz": json.loads(health_body),
+                "trace_status": t_status,
+                # a 500 body is {"error": ...}: report it via the checked
+                # status rather than KeyError-ing on traceEvents
+                "trace_events": (sum(
+                    1 for e in json.loads(trace_body)["traceEvents"]
+                    if e["ph"] != "M") if t_status == 200 else None),
+                "telemetry_findings": [str(f) for f in audit_telemetry()],
+            })
+            return summary
+        print(f"telemetry exporter serving on {server.url} "
+              "(/metrics /healthz /snapshot.json /trace.json) — Ctrl-C "
+              "to stop")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        summary["telemetry_findings"] = [str(f) for f in audit_telemetry()]
+        return summary
+    finally:
+        # each cleanup step runs even if an earlier one raises (e.g.
+        # shutdown(drain=True) timing out must not leave the tracer,
+        # anomaly flags or tempdir armed); the first failure propagates
+        cleanup_error = None
+        try:
+            if engine is not None:
+                engine.shutdown(drain=True)
+        except BaseException as exc:
+            cleanup_error = exc
+        try:
+            if server is not None:
+                server.stop()
+        except BaseException as exc:
+            cleanup_error = cleanup_error or exc
+        tracer.enabled = was_enabled
+        if flags_before is not None:
+            # disarm the flight recorder we armed: in-process callers
+            # (tests, notebooks) must not keep dumping into a stale dir
+            set_flags(flags_before)
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        # surface a cleanup failure only when the body succeeded: raising
+        # here while the try is already unwinding would displace the real
+        # error (it would survive only as __context__)
+        if cleanup_error is not None and sys.exc_info()[0] is None:
+            raise cleanup_error
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.telemetry",
         description="run the demo train step + serving engine with span "
                     "tracing enabled and dump snapshot + chrome-trace JSON")
     parser.add_argument("--out", default="telemetry_out",
-                        help="output directory (default: ./telemetry_out)")
+                        help="demo-mode output directory for snapshot + "
+                             "trace JSON (default: ./telemetry_out; "
+                             "--serve exposes them over HTTP instead)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable summary on stdout")
+    parser.add_argument("--serve", action="store_true",
+                        help="start the telemetry HTTP exporter over the "
+                             "demo workloads (see module docstring)")
+    parser.add_argument("--port", type=int, default=None,
+                        help="exporter port (default FLAGS_telemetry_port; "
+                             "0 = ephemeral)")
+    parser.add_argument("--once", action="store_true",
+                        help="with --serve: scrape /metrics + /healthz "
+                             "once, print, exit (the CI acceptance path)")
+    parser.add_argument("--dump-on-anomaly", metavar="DIR", default=None,
+                        help="arm the anomaly flight recorder: enable "
+                             "FLAGS_telemetry_anomaly and dump forensic "
+                             "bundles under DIR")
     args = parser.parse_args(argv)
+
+    if args.serve:
+        from paddle_tpu.base.flags import get_flag
+
+        port = args.port if args.port is not None else int(
+            get_flag("telemetry_port"))
+        summary = run_serve(port, args.once, dump_dir=args.dump_on_anomaly)
+        if args.as_json:
+            print(json.dumps(summary, indent=2, default=str))
+        elif args.once:
+            print(summary["metrics_body"], end="")
+            print(f"# healthz ({summary['healthz_status']}): "
+                  + json.dumps(summary["healthz"]))
+        if not args.as_json:
+            # both serve modes exit 1 on findings, so both must SHOW them
+            for finding in summary.get("telemetry_findings", []):
+                print(f"TELEMETRY FINDING: {finding}")
+        bad_scrape = args.once and (summary.get("metrics_status") != 200
+                                    or summary.get("healthz_status") != 200
+                                    or summary.get("trace_status") != 200)
+        return 1 if summary.get("telemetry_findings") or bad_scrape else 0
 
     summary = run_demo(args.out)
     if args.as_json:
