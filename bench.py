@@ -134,6 +134,10 @@ def bench_gpt(on_tpu):
         extras["telemetry"] = _telemetry_bench(step, ids)
     except Exception as e:
         extras["telemetry"] = {"error": str(e).split("\n")[0][:200]}
+    try:
+        extras["coldstart"] = _coldstart_bench()
+    except Exception as e:
+        extras["coldstart"] = {"error": str(e).split("\n")[0][:200]}
     return f"{name}_train_tokens_per_sec", tok_s, "tokens/sec", extras
 
 
@@ -542,6 +546,152 @@ def _telemetry_bench(step, ids, n=20):
             sum(step._compiled._compile_counts.values()) - builds_before),
         "anomaly_bundles_clean_run": bundles_written,
     }
+
+
+def _coldstart_bench():
+    """Persistent compile cache (ISSUE 9 tentpole): first-useful-step /
+    first-served-request wall time, cold vs warm-disk.
+
+    Two arms over one fresh store directory, each built from scratch
+    (fresh model objects, cleared eager kernel cache — the in-process
+    restart proxy: every jit closure is new, so jax's in-memory caches
+    cannot serve either arm; jax's own persistent compilation cache is
+    disabled for the window so only THIS subsystem separates the arms):
+
+    - **train**: gpt_tiny ``TrainStep`` — wall time of the first step
+      (trace + XLA compile + execute cold; trace + disk deserialize +
+      execute warm) with the loss asserted bit-identical;
+    - **serving**: a small exported MLP behind a 4-rung bucket ladder —
+      cold ``warmup_ladder`` (one trace+compile per rung, published) vs
+      warm (every rung restored from disk: ``traces_on_warm_start == 0``),
+      then a ``ServingEngine`` on the warm store serving live traffic
+      with ``compiles_after_warmup == 0`` and first-request wall time.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import compile_cache as cc
+    from paddle_tpu import serving
+    from paddle_tpu.base.flags import get_flag, set_flags
+    from paddle_tpu.core import kernel_cache
+    from paddle_tpu.inference import Config, Predictor
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import (GPTForCausalLM, GPTPretrainingCriterion,
+                                   gpt_tiny)
+    from paddle_tpu.profiler.pipeline import ServingStats
+    from paddle_tpu.static import InputSpec
+
+    # jax's own persistent cache must sit out: it would pre-warm the
+    # "cold" arm and the comparison would measure nothing
+    prev_jax_cache = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    tmp = tempfile.mkdtemp(prefix="paddle_bench_coldstart_")
+    flags_was = {"compile_cache": get_flag("compile_cache"),
+                 "compile_cache_dir": get_flag("compile_cache_dir")}
+    set_flags({"compile_cache": True, "compile_cache_dir": tmp})
+    cc.reset_stats()
+    try:
+        out = {}
+
+        # ---- train: gpt_tiny first useful step ------------------------
+        def first_step():
+            paddle.seed(0)
+            cfg = gpt_tiny()
+            model = GPTForCausalLM(cfg)
+            crit = GPTPretrainingCriterion(cfg)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                         parameters=model.parameters())
+            step = TrainStep(model=model, optimizer=opt,
+                             loss_fn=lambda ids: crit(model(ids), ids))
+            rs = np.random.RandomState(0)
+            ids = paddle.Tensor(
+                rs.randint(0, cfg.vocab_size, (4, 64)).astype(np.int64),
+                stop_gradient=True)
+            t0 = time.perf_counter()
+            loss = step(ids)
+            val = float(loss.numpy())
+            return time.perf_counter() - t0, val
+
+        kernel_cache.clear()
+        cold_s, cold_loss = first_step()
+        stores_after_cold = cc.stats()["store"]
+        kernel_cache.clear()
+        warm_s, warm_loss = first_step()
+        out.update(
+            train_cold_first_step_s=round(cold_s, 3),
+            train_warm_first_step_s=round(warm_s, 3),
+            train_warm_speedup_x=round(cold_s / warm_s, 3),
+            train_loss_bit_identical=bool(cold_loss == warm_loss),
+            train_entries_published=stores_after_cold,
+        )
+
+        # ---- serving: the bucket ladder -------------------------------
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                            nn.Linear(128, 32), nn.Tanh(), nn.Linear(32, 16))
+        net.eval()
+        prefix = tmp + "/served"
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([None, 64], "float32")])
+        ladder = [1, 2, 4, 8]
+        x = np.random.RandomState(7).randn(3, 64).astype(np.float32)
+
+        def warm_ladder():
+            pred = Predictor(Config(prefix))
+            pred.set_batch_ladder(ladder)
+            t0 = time.perf_counter()
+            pred.warmup_ladder()
+            warm_dt = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            first = pred.run_many([x])
+            return pred, warm_dt, time.perf_counter() - t0, first
+
+        p_cold, cold_warmup_s, cold_req_s, out_cold = warm_ladder()
+        p_warm, warm_warmup_s, warm_req_s, out_warm = warm_ladder()
+        out.update(
+            serving_cold_warmup_s=round(cold_warmup_s, 3),
+            serving_warm_warmup_s=round(warm_warmup_s, 3),
+            serving_warm_speedup_x=round(cold_warmup_s / warm_warmup_s, 3),
+            serving_first_request_cold_s=round(cold_req_s, 4),
+            serving_first_request_warm_s=round(warm_req_s, 4),
+            # THE warm-start proof: the whole ladder restored, zero traces
+            serving_traces_on_warm_start=p_warm.compile_count,
+            serving_restored_rungs=len(p_warm.restored_rungs),
+            serving_ladder_rungs=len(ladder),
+            serving_bit_exact_cold_vs_warm=bool(all(
+                np.array_equal(a, b) for a, b in zip(out_cold, out_warm))),
+        )
+
+        # live traffic on a warm-disk engine: still zero retraces
+        engine = serving.ServingEngine(prefix, buckets=ladder,
+                                       stats=ServingStats())
+        engine.warmup()
+        rs = np.random.RandomState(1)
+        for tenant, n in (("a", 1), ("b", 3), ("a", 6)):
+            engine.run(tenant, rs.randn(n, 64).astype(np.float32))
+        engine.shutdown(drain=True)
+        out.update(
+            serving_engine_traces_on_warm_start=engine.compile_count,
+            serving_compiles_after_warmup=engine.compiles_after_warmup,
+        )
+
+        stats = cc.stats()
+        out.update(cache_hits=stats["hit"], cache_misses=stats["miss"],
+                   cache_stores=stats["store"],
+                   cache_bytes=stats.get("disk_bytes"),
+                   cache_load_s=round(stats["load_seconds"], 3),
+                   cache_store_s=round(stats["store_seconds"], 3))
+        return out
+    finally:
+        set_flags(flags_was)
+        jax.config.update("jax_compilation_cache_dir", prev_jax_cache)
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _pure_jax_gpt_control(cfg, batch, seq, steps):

@@ -1,0 +1,148 @@
+"""Persistent-compile-cache auditor (CC7xx): the ``cache`` lint family.
+
+The persistent store (``paddle_tpu.compile_cache``) is only safe while
+its hermeticity invariants hold — an entry served into the wrong
+environment is a wrong-program bug, and a store that outgrows its budget
+silently eats the disk a trainer shares with checkpoints. This pass
+audits one store directory (by default the freshly recorded
+:func:`record_demo_cache` fixture, so the gate runs hermetically per
+commit):
+
+CC700  non-hermetic key      an entry whose header carries no environment
+                             fingerprint (or no fingerprint digest): it
+                             would be served into ANY environment,
+                             including one with a different jaxlib/backend
+                             — wrong-executable hazard (error)
+CC701  store over budget     the directory's entry bytes exceed
+                             ``FLAGS_compile_cache_max_bytes`` — pruning
+                             is broken or disabled while a cap is
+                             configured (warning)
+CC702  mixed fingerprints    one directory holds entries from multiple
+                             environment fingerprints (e.g. shared across
+                             a jax upgrade or between backends): the
+                             stale share is dead weight inside the byte
+                             budget and a mis-serve hazard for
+                             hand-renamed files — prune or split the dir
+                             (warning)
+CC703  corrupt/orphan entry  an unparseable/checksum-failing entry or a
+                             stale writer tmp file: readers degrade to a
+                             miss, but the bytes rot inside the budget
+                             until pruned (warning; ``tools.cache
+                             verify`` exits non-zero on the same
+                             condition)
+
+Driven by the ``cache`` analyzer of ``python -m tools.lint`` and the
+tier-1 zero-findings gate (``tests/test_lint_clean.py``).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from . import Finding
+
+_ANALYZER = "cache"
+
+
+def audit_cache_dir(cache_dir: str,
+                    max_bytes: Optional[int] = None) -> List[Finding]:
+    """CC70x findings over one store directory. Pure filesystem reads —
+    never deserializes an executable, safe on a live store."""
+    from ..compile_cache import store as st
+
+    if max_bytes is None:
+        try:
+            from ..base.flags import get_flag
+
+            max_bytes = int(get_flag("compile_cache_max_bytes"))
+        except Exception:
+            max_bytes = 0
+
+    findings: List[Finding] = []
+    rows = st.list_entries(cache_dir)
+    entry_bytes = 0
+    fingerprints = {}
+    for row in rows:
+        name = os.path.basename(row["path"])
+        if row.get("orphan"):
+            findings.append(Finding(
+                _ANALYZER, "CC703", "warning",
+                f"orphan writer tmp file '{name}' — a crashed writer's "
+                "dropping; it rots inside the byte budget until "
+                "`tools.cache prune` sweeps it", cache_dir))
+            continue
+        header = row["header"]
+        if header is None:
+            findings.append(Finding(
+                _ANALYZER, "CC703", "warning",
+                f"entry '{name}' is corrupt (bad magic/header/format) — "
+                "readers degrade to a miss, but the bytes are dead weight; "
+                "`tools.cache verify` fails on it", cache_dir))
+            continue
+        entry_bytes += row["bytes"]
+        fp = header.get("fingerprint")
+        fp_digest = header.get("fingerprint_digest")
+        if not fp or not fp_digest:
+            findings.append(Finding(
+                _ANALYZER, "CC700", "error",
+                f"entry '{name}' is keyed WITHOUT an environment "
+                "fingerprint — it would be served into any jaxlib/backend/"
+                "device environment; a non-hermetic key is a "
+                "wrong-executable hazard", cache_dir))
+            continue
+        fingerprints.setdefault(fp_digest, (name, fp))
+
+    if max_bytes and max_bytes > 0 and entry_bytes > max_bytes:
+        findings.append(Finding(
+            _ANALYZER, "CC701", "warning",
+            f"store holds {entry_bytes / 2**20:.1f} MiB of entries — over "
+            f"the {max_bytes / 2**20:.1f} MiB budget "
+            "(FLAGS_compile_cache_max_bytes); LRU pruning is broken or "
+            "was bypassed (run `tools.cache prune`)", cache_dir))
+
+    if len(fingerprints) > 1:
+        kinds = sorted(
+            "{}(jaxlib={}, backend={})".format(
+                digest[:8], fp.get("jaxlib"), fp.get("backend"))
+            for digest, (_n, fp) in fingerprints.items())
+        findings.append(Finding(
+            _ANALYZER, "CC702", "warning",
+            f"one cache dir holds {len(fingerprints)} incompatible "
+            f"environment fingerprints ({', '.join(kinds)}) — the stale "
+            "share is dead weight inside the byte budget; prune it or "
+            "give each environment its own FLAGS_compile_cache_dir",
+            cache_dir))
+    return findings
+
+
+def record_demo_cache(tmpdir: str) -> str:
+    """Build the representative healthy store the ``cache`` lint analyzer
+    audits: two tiny AOT executables published through the public
+    store/load path into ``tmpdir`` (flags saved/restored — recording a
+    health fixture must not flip the live process into disk caching).
+    Returns the store directory. One definition so the CLI and the test
+    gate audit the SAME store."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..base.flags import get_flag, set_flags
+    from .. import compile_cache as cc
+
+    prev = {"compile_cache": get_flag("compile_cache"),
+            "compile_cache_dir": get_flag("compile_cache_dir")}
+    set_flags({"compile_cache": True, "compile_cache_dir": tmpdir})
+    try:
+        for label, fn, arg in (
+                ("demo_scale", lambda x: x * 2 + 1, jnp.ones((8, 8))),
+                ("demo_matmul", lambda x: x @ x, jnp.ones((4, 4)))):
+            digest = cc.derive_digest("demo", label)
+            compiled = jax.jit(fn).lower(arg).compile()
+            cc.store_executable(digest, compiled,
+                                key_meta={"site": "demo", "op": label})
+            if cc.load_executable(digest, site="demo") is None:
+                raise RuntimeError(
+                    f"demo store round-trip failed for '{label}' — the "
+                    "persistent tier cannot serve what it just published")
+    finally:
+        set_flags(prev)
+    return tmpdir

@@ -15,9 +15,12 @@ aggregate:
 - **Bytes** — operand bytes read / result bytes written per equation
   (aval numel × itemsize), the denominators of arithmetic intensity.
 - **Collective volume per mesh axis** — bytes moved by
-  psum/all_gather/ppermute/... attributed to each named axis (ring
-  all-reduce ≈ 2× one pass for psum/pmean, 1× for the rest; static
-  lower bound — axis sizes are a runtime property).
+  psum/all_gather/ppermute/... attributed to each named axis, at the
+  axis-size-aware ring cost: 2(n−1)/n for the all-reduce family,
+  (n−1)/n for the single-pass family, with n resolved from the
+  enclosing shard_map ``mesh`` / pmap ``axis_size`` (or an explicit
+  ``cost_jaxpr(axis_sizes=...)`` seed); an unresolvable axis keeps the
+  historical 2×/1× static upper bound.
 - **Peak residency** — a liveness walk: every SSA value is live from its
   defining equation to its last use, program arguments from entry to
   their last use (donation semantics), constants and outputs to the end.
@@ -45,6 +48,12 @@ breakdown under ``.per_entry``) and per cached executable via
    CM504  peak over HBM budget   liveness peak per device (under the
                                  active Plan's degrees) exceeds
                                  ``FLAGS_cost_hbm_budget_bytes``
+   CM505  guard-predicate cost   a speculative branch family verifying
+                                 more guard predicates per call than
+                                 ``FLAGS_cost_max_guard_preds`` — each
+                                 predicate is a device→host fetch every
+                                 step (the overhead the max-branch
+                                 accounting used to ignore)
 
 2. the parallelism planner (``distributed/auto_parallel/planner.py``):
    jaxpr-backed ``estimate_per_device_bytes``/``estimate_step_cost``
@@ -68,14 +77,36 @@ from . import Finding
 
 _ANALYZER = "cost"
 
-# collectives: primitive name -> volume multiplier per pass over the data
-# (ring all-reduce moves ~2x the buffer; gather/scatter/permute ~1x)
-_COLLECTIVE_FACTOR = {
-    "psum": 2.0, "psum2": 2.0, "pmean": 2.0, "pmax": 1.0, "pmin": 1.0,
-    "all_gather": 1.0, "all_gather_invariant": 1.0, "all_to_all": 1.0,
-    "ppermute": 1.0, "pshuffle": 1.0, "psum_scatter": 1.0,
-    "reduce_scatter": 1.0,
-}
+# collectives, by ring-algorithm family. With the mesh axis size n
+# resolved (shard_map's `mesh` param, pmap's `axis_size`, or an explicit
+# cost_jaxpr(axis_sizes=...) override) the volume multiplier is the exact
+# ring cost: all-reduce moves 2(n-1)/n of the buffer per device
+# (reduce-scatter pass + all-gather pass), the single-pass family moves
+# (n-1)/n, point-to-point permutes move the whole buffer once. When the
+# axis size is unresolvable (a bare axis name with no enclosing mesh —
+# sizes are a runtime property there) the historical static constants
+# (2x all-reduce / 1x rest) remain the documented upper bound.
+_ALLREDUCE_PRIMS = {"psum", "psum2", "pmean", "pmax", "pmin"}
+_ONEPASS_PRIMS = {"all_gather", "all_gather_invariant", "all_to_all",
+                  "psum_scatter", "reduce_scatter"}
+_P2P_PRIMS = {"ppermute", "pshuffle"}
+_COLLECTIVE_PRIMS = _ALLREDUCE_PRIMS | _ONEPASS_PRIMS | _P2P_PRIMS
+
+
+def _ring_factor(name: str, axis_size) -> float:
+    """Volume multiplier for one collective on one axis of ``axis_size``
+    devices (None = unknown size → the static fallback constants)."""
+    if name in _ALLREDUCE_PRIMS:
+        if axis_size is None:
+            return 2.0
+        n = max(int(axis_size), 1)
+        return 2.0 * (n - 1) / n
+    if name in _ONEPASS_PRIMS:
+        if axis_size is None:
+            return 1.0
+        n = max(int(axis_size), 1)
+        return (n - 1) / n
+    return 1.0  # point-to-point: the whole buffer crosses one link
 
 # result-moving primitives XLA reliably fuses into their consumer when the
 # operand is a scalar/empty: counting their full output as resident would
@@ -149,6 +180,12 @@ class CostReport:
     out_bytes: int = 0
     largest_intermediate_bytes: int = 0
     largest_intermediate_prim: str = ""
+    # speculative branch families (jit/functionalize guarded entries):
+    # every call returns `guard_preds` predicate values that the caller
+    # fetches device→host to verify its speculation — a per-call sync the
+    # max-branch accounting used to ignore. Set by cost_compiled_function.
+    guard_preds: int = 0
+    guard_sync_bytes: int = 0
     n_eqns: int = 0
     by_primitive: Dict[str, Dict[str, float]] = dataclasses.field(
         default_factory=dict)
@@ -176,6 +213,9 @@ class CostReport:
             "location": self.location,
             "analysis_seconds": round(self.analysis_seconds, 4),
         }
+        if self.guard_preds:
+            d["guard_preds"] = self.guard_preds
+            d["guard_sync_bytes"] = self.guard_sync_bytes
         if self.retrace_errors:
             d["retrace_errors"] = list(self.retrace_errors)
         if self.per_entry is not None:
@@ -282,20 +322,48 @@ def _eqn_flops(eqn) -> tuple:
                      if getattr(v, "aval", None) is not None)), 0.0
 
 
-def _eqn_comm(eqn) -> Dict[str, float]:
-    """Collective volume per mesh axis for one equation (static single-pass
-    estimate × the ring factor; axis sizes are runtime properties)."""
+def _eqn_comm(eqn, axis_sizes: Optional[Dict[str, int]] = None
+              ) -> Dict[str, float]:
+    """Collective volume per mesh axis for one equation: operand bytes ×
+    the axis-size-aware ring factor (``axis_sizes`` is the environment
+    threaded down from enclosing shard_map/pmap equations; an unknown
+    axis falls back to the static constants)."""
     name = eqn.primitive.name
-    factor = _COLLECTIVE_FACTOR.get(name)
-    if factor is None:
+    if name not in _COLLECTIVE_PRIMS:
         return {}
     axes = eqn.params.get("axis_name", eqn.params.get("axes"))
     if axes is None:
         return {}
     if not isinstance(axes, (list, tuple)):
         axes = (axes,)
-    vol = factor * sum(_var_bytes(v) for v in eqn.invars)
-    return {str(ax): vol for ax in axes}
+    bytes_in = sum(_var_bytes(v) for v in eqn.invars)
+    sizes = axis_sizes or {}
+    return {str(ax): _ring_factor(name, sizes.get(str(ax))) * bytes_in
+            for ax in axes}
+
+
+def _eqn_axis_sizes(eqn) -> Dict[str, int]:
+    """Axis sizes an equation's body executes under: shard_map carries
+    its ``mesh`` (name → size mapping), pmap carries ``axis_name`` +
+    ``axis_size``. Merged over the enclosing environment when recursing
+    into sub-jaxprs."""
+    sizes: Dict[str, int] = {}
+    mesh = eqn.params.get("mesh")
+    shape = getattr(mesh, "shape", None)
+    if shape is not None:
+        try:
+            sizes.update({str(k): int(v) for k, v in dict(shape).items()})
+        except (TypeError, ValueError):
+            pass
+    axis_name = eqn.params.get("axis_name")
+    axis_size = eqn.params.get("global_axis_size",
+                               eqn.params.get("axis_size"))
+    if axis_name is not None and isinstance(axis_size, int):
+        names = axis_name if isinstance(axis_name, (list, tuple)) \
+            else (axis_name,)
+        for n in names:
+            sizes[str(n)] = axis_size
+    return sizes
 
 
 def _is_fused_expansion(eqn) -> bool:
@@ -416,12 +484,15 @@ def _while_trip_count(eqn) -> int:
     return max(int(math.ceil(span / step)), 0)
 
 
-def _walk_jaxpr(jaxpr) -> CostReport:
+def _walk_jaxpr(jaxpr, axis_sizes: Optional[Dict[str, int]] = None
+                ) -> CostReport:
     """Cost one (open) Jaxpr: totals + liveness peak. Recurses into
     pjit/scan/while/cond bodies; scan multiplies by trip count, cond takes
     the max across branches, while multiplies by the statically derived
     counter trip count when the loop has one (else the
-    FLAGS_cost_while_default_trips lower bound)."""
+    FLAGS_cost_while_default_trips lower bound). ``axis_sizes`` is the
+    mesh-axis environment for collective ring factors, extended by every
+    shard_map/pmap equation recursed through."""
     import jax
 
     rep = CostReport(n_eqns=len(jaxpr.eqns))
@@ -466,7 +537,10 @@ def _walk_jaxpr(jaxpr) -> CostReport:
         sub_peak_extra = 0
         if subs:
             flops = mm = 0.0
-            sub_reports = [_walk_jaxpr(s) for s in subs]
+            inner_sizes = _eqn_axis_sizes(eqn)
+            sub_env = ({**(axis_sizes or {}), **inner_sizes}
+                       if inner_sizes else axis_sizes)
+            sub_reports = [_walk_jaxpr(s, sub_env) for s in subs]
             if pname == "scan":
                 mult = _scan_length(eqn)
             elif pname == "while":
@@ -504,7 +578,7 @@ def _walk_jaxpr(jaxpr) -> CostReport:
             flops, mm = _eqn_flops(eqn)
             rep.bytes_read += in_b
             rep.bytes_written += out_b
-            for ax, vol in _eqn_comm(eqn).items():
+            for ax, vol in _eqn_comm(eqn, axis_sizes).items():
                 rep.comm_bytes[ax] = rep.comm_bytes.get(ax, 0.0) + vol
             row = rep.by_primitive.setdefault(
                 pname, {"count": 0, "flops": 0.0, "bytes": 0.0})
@@ -537,9 +611,13 @@ def _walk_jaxpr(jaxpr) -> CostReport:
     return rep
 
 
-def cost_jaxpr(closed_jaxpr, *, location: str = "") -> CostReport:
-    """Cost one ClosedJaxpr. Static — never compiles, never executes."""
-    rep = _walk_jaxpr(closed_jaxpr.jaxpr)
+def cost_jaxpr(closed_jaxpr, *, location: str = "",
+               axis_sizes: Optional[Dict[str, int]] = None) -> CostReport:
+    """Cost one ClosedJaxpr. Static — never compiles, never executes.
+    ``axis_sizes`` seeds the mesh-axis environment for collective ring
+    factors (e.g. ``{"dp": 8}`` from a planner Plan) — axes declared by
+    shard_map/pmap equations inside the program resolve themselves."""
+    rep = _walk_jaxpr(closed_jaxpr.jaxpr, dict(axis_sizes or {}) or None)
     rep.location = location
     return rep
 
@@ -568,7 +646,18 @@ def cost_compiled_function(cf) -> CostReport:
         except Exception as e:
             errors.append(f"{loc}: {str(e).splitlines()[0]}")
             return
-        per_entry[loc] = cost_jaxpr(closed, location=loc)
+        rep = cost_jaxpr(closed, location=loc)
+        guards = entry.get("guards")
+        if guards:
+            # the guard-predicate overhead of a speculative branch family
+            # (jit/functionalize): the program's outvars are laid out
+            # [user outs..., new cells..., predicates...] — the trailing
+            # len(guards) values are fetched to the host EVERY call to
+            # verify the speculation (CM505's feed)
+            pred_vars = list(closed.jaxpr.outvars)[-len(guards):]
+            rep.guard_preds = len(guards)
+            rep.guard_sync_bytes = sum(_var_bytes(v) for v in pred_vars)
+        per_entry[loc] = rep
 
     for idx, (_key, entry) in enumerate(list(cf._cache.items())):
         loc = f"{name}[{idx}]"
@@ -614,7 +703,8 @@ def _flag(name, override, fallback):
 def check_cost(report: CostReport, *, plan=None,
                max_intermediate_bytes=None, hbm_budget_bytes=None,
                min_arith_intensity=None, intensity_min_bytes=None,
-               bandwidth_gbps=None, device_tflops=None) -> List[Finding]:
+               bandwidth_gbps=None, device_tflops=None,
+               max_guard_preds=None) -> List[Finding]:
     """CM5xx findings over one :class:`CostReport` (and its per-entry
     breakdown). ``plan`` is an optional ``auto_parallel.planner.Plan``:
     when given, the CM504 peak check divides the traced single-program
@@ -628,6 +718,7 @@ def check_cost(report: CostReport, *, plan=None,
                          32 << 20))
     bw = float(_flag("cost_mesh_bandwidth_gbps", bandwidth_gbps, 100.0))
     tflops = float(_flag("cost_device_tflops", device_tflops, 197.0))
+    guard_cap = int(_flag("cost_max_guard_preds", max_guard_preds, 8))
 
     findings: List[Finding] = []
 
@@ -673,6 +764,17 @@ def check_cost(report: CostReport, *, plan=None,
                         f"({compute_s * 1e3:.2f} ms at {tflops:.0f} TFLOP/s) "
                         "— the step is communication-bound under the "
                         "declared bandwidth model", loc))
+
+        if rep.guard_preds > guard_cap > 0:
+            findings.append(Finding(
+                _ANALYZER, "CM505", "warning",
+                f"speculative branch family verifies {rep.guard_preds} "
+                f"guard predicates per call ({rep.guard_sync_bytes} bytes "
+                f"fetched device→host each step, > {guard_cap} predicate "
+                "budget, FLAGS_cost_max_guard_preds) — every tensor-bool "
+                "branch is a per-call host sync AND a potential "
+                "specialization fork; hoist the conditions or fold them "
+                "into lax.cond/where", loc))
 
         shards = 1
         if plan is not None:

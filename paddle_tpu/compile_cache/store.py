@@ -1,0 +1,261 @@
+"""The on-disk executable store: atomic publish, checksums, LRU pruning.
+
+One entry = one file ``<digest>.ptcc``:
+
+    PTCC1\\n <8-byte big-endian header length> <header json> <payload>
+
+The header carries the format version, the full environment fingerprint
+(+ its digest), caller-supplied key metadata (site, op/program name,
+shapes — what ``tools.cache ls`` prints), and the payload's sha256 +
+length. The payload is the pickled ``jax.experimental.
+serialize_executable.serialize`` triple (executable bytes + in/out
+treedefs).
+
+Durability contract:
+
+- **Atomic publish**: writers write ``<digest>.ptcc.tmp.<pid>.<nonce>``
+  then ``os.replace`` onto the final name. Concurrent writers racing on
+  one digest both publish identical content (the key IS the content
+  address); whichever rename lands last simply overwrites byte-identical
+  data — the loser's work is discarded, never a torn file.
+- **Corruption is a miss, never a crash**: a truncated file, a garbage
+  header, a checksum mismatch or an undeserializable payload makes
+  ``read_entry`` return ``None`` (counted ``corrupt`` by the caller) and
+  best-effort unlinks the bad entry so it cannot re-corrupt every later
+  start.
+- **Read-only degrade**: a store failure (read-only dir, disk full)
+  logs one warning per process and reports ``False``; loads keep
+  working — a read-only warm cache is still a warm cache.
+- **LRU byte cap**: every successful read refreshes the entry's mtime;
+  ``prune`` (run after each store) deletes oldest-mtime entries until
+  the directory fits ``FLAGS_compile_cache_max_bytes``, and sweeps
+  stale ``.tmp.`` droppings from crashed writers.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+import uuid
+from typing import List, Optional, Tuple
+
+MAGIC = b"PTCC1\n"
+FORMAT_VERSION = 1
+ENTRY_SUFFIX = ".ptcc"
+_TMP_MARK = ".tmp."
+# tmp files older than this are crashed-writer droppings, sweepable
+_TMP_STALE_S = 3600.0
+
+_warned_store_failure = [False]
+
+
+def _log():
+    from ..base.log import get_logger
+
+    return get_logger()
+
+
+def entry_path(cache_dir: str, digest: str) -> str:
+    return os.path.join(cache_dir, digest + ENTRY_SUFFIX)
+
+
+def _checksum(payload: bytes) -> str:
+    import hashlib
+
+    return hashlib.sha256(payload).hexdigest()
+
+
+def write_entry(cache_dir: str, digest: str, payload: bytes,
+                key_meta: Optional[dict] = None) -> bool:
+    """Publish one entry atomically; False (with one warning per
+    process) when the store cannot be written."""
+    from .keys import fingerprint, fingerprint_digest
+
+    header = {
+        "version": FORMAT_VERSION,
+        "digest": digest,
+        "fingerprint": fingerprint(),
+        "fingerprint_digest": fingerprint_digest(),
+        "key_meta": key_meta or {},
+        "payload_sha256": _checksum(payload),
+        "payload_bytes": len(payload),
+        "created": time.time(),
+    }
+    head = json.dumps(header, sort_keys=True).encode()
+    final = entry_path(cache_dir, digest)
+    tmp = final + _TMP_MARK + f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(MAGIC)
+            f.write(struct.pack(">Q", len(head)))
+            f.write(head)
+            f.write(payload)
+        os.replace(tmp, final)  # the atomic publish: rename wins or loses whole
+        return True
+    except OSError as e:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        if not _warned_store_failure[0]:
+            _warned_store_failure[0] = True
+            _log().warning(
+                "compile_cache: store to %s failed (%s) — degrading to "
+                "read-only; executables keep compiling in-process",
+                cache_dir, e)
+        return False
+
+
+def _parse(path: str) -> Optional[Tuple[dict, bytes]]:
+    """One-pass ``(header, payload)`` parse of an entry file; None on any
+    structural corruption (bad magic, short read, garbage json)."""
+    try:
+        with open(path, "rb") as f:
+            if f.read(len(MAGIC)) != MAGIC:
+                return None
+            raw = f.read(8)
+            if len(raw) != 8:
+                return None
+            (hlen,) = struct.unpack(">Q", raw)
+            if hlen > 1 << 24:  # a sane header is KBs; garbage lengths bail
+                return None
+            head = f.read(hlen)
+            if len(head) != hlen:
+                return None
+            header = json.loads(head)
+            payload = f.read()
+    except (OSError, ValueError):
+        return None
+    if not isinstance(header, dict) or header.get("version") != FORMAT_VERSION:
+        return None
+    return header, payload
+
+
+def read_header(path: str) -> Optional[dict]:
+    """Parse one entry's header; None on any corruption."""
+    parsed = _parse(path)
+    return parsed[0] if parsed else None
+
+
+def read_entry(cache_dir: str, digest: str,
+               expected_fp_digest: Optional[str] = None,
+               ) -> Tuple[Optional[bytes], Optional[str]]:
+    """``(payload, why_not)`` for one digest. ``payload is None`` with
+    ``why_not`` in {"miss", "corrupt", "fingerprint_mismatch"}; a corrupt
+    entry is unlinked best-effort so it cannot poison every later start."""
+    path = entry_path(cache_dir, digest)
+    if not os.path.exists(path):
+        return None, "miss"
+    parsed = _parse(path)
+    if parsed is None:
+        _discard(path)
+        return None, "corrupt"
+    header, payload = parsed
+    if expected_fp_digest is not None and \
+            header.get("fingerprint_digest") != expected_fp_digest:
+        # digest collisions across fingerprints can't happen (the digest
+        # folds the fingerprint in) — this catches hand-copied/renamed
+        # entries and stale formats; not corruption, but not servable
+        return None, "fingerprint_mismatch"
+    if len(payload) != header.get("payload_bytes") or \
+            _checksum(payload) != header.get("payload_sha256"):
+        _discard(path)
+        return None, "corrupt"
+    try:
+        os.utime(path, None)  # LRU touch: loads refresh recency
+    except OSError:
+        pass
+    return payload, None
+
+
+def _discard(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def list_entries(cache_dir: str) -> List[dict]:
+    """Every ``*.ptcc`` entry as ``{path, digest, bytes, mtime, header}``
+    (``header`` None for corrupt entries) plus stray tmp files as
+    ``{path, orphan: True}`` rows — the ``tools.cache`` surface."""
+    rows: List[dict] = []
+    try:
+        names = sorted(os.listdir(cache_dir))
+    except OSError:
+        return rows
+    for name in names:
+        path = os.path.join(cache_dir, name)
+        if not os.path.isfile(path):
+            continue
+        if _TMP_MARK in name:
+            rows.append({"path": path, "orphan": True,
+                         "bytes": _size(path), "mtime": _mtime(path)})
+            continue
+        if not name.endswith(ENTRY_SUFFIX):
+            continue
+        rows.append({
+            "path": path,
+            "digest": name[: -len(ENTRY_SUFFIX)],
+            "bytes": _size(path),
+            "mtime": _mtime(path),
+            "header": read_header(path),
+        })
+    return rows
+
+
+def _size(path: str) -> int:
+    try:
+        return os.stat(path).st_size
+    except OSError:
+        return 0
+
+
+def _mtime(path: str) -> float:
+    try:
+        return os.stat(path).st_mtime
+    except OSError:
+        return 0.0
+
+
+def total_bytes(cache_dir: str) -> int:
+    return sum(r["bytes"] for r in list_entries(cache_dir))
+
+
+def prune(cache_dir: str, max_bytes: Optional[int] = None) -> dict:
+    """LRU-prune the store to ``max_bytes`` (default: the flag) and sweep
+    stale writer tmp files. Returns ``{removed, removed_bytes, kept,
+    kept_bytes}``. ``max_bytes <= 0`` disables the size cap (tmp sweep
+    still runs)."""
+    if max_bytes is None:
+        try:
+            from ..base.flags import get_flag
+
+            max_bytes = int(get_flag("compile_cache_max_bytes"))
+        except Exception:
+            max_bytes = 0
+    removed = removed_bytes = 0
+    entries = []
+    now = time.time()
+    for row in list_entries(cache_dir):
+        if row.get("orphan"):
+            if now - row["mtime"] > _TMP_STALE_S:
+                _discard(row["path"])
+                removed += 1
+                removed_bytes += row["bytes"]
+            continue
+        entries.append(row)
+    if max_bytes and max_bytes > 0:
+        total = sum(r["bytes"] for r in entries)
+        entries.sort(key=lambda r: r["mtime"])  # oldest-used first
+        while total > max_bytes and entries:
+            victim = entries.pop(0)
+            _discard(victim["path"])
+            total -= victim["bytes"]
+            removed += 1
+            removed_bytes += victim["bytes"]
+    kept = len(entries)
+    return {"removed": removed, "removed_bytes": removed_bytes,
+            "kept": kept, "kept_bytes": sum(r["bytes"] for r in entries)}

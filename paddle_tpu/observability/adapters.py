@@ -19,6 +19,9 @@ serving                ``profiler.pipeline.serving_stats.summary()``
 jit.compile            process-wide program-build counters: whole-step
                        ``CompiledFunction`` builds (jit/functionalize) and
                        serving ``_BatchProgram`` trace count (inference)
+compile_cache          ``compile_cache.stats()`` (persistent AOT store:
+                       hit/miss/store/corrupt/vjp_skip/key_skip counters,
+                       load/store wall seconds, disk bytes when enabled)
 ====================== ====================================================
 
 Registered once at ``paddle_tpu.observability`` import; every import in
@@ -63,8 +66,17 @@ def _collect_compile() -> dict:
     return out
 
 
+def _collect_compile_cache() -> dict:
+    from ..compile_cache import stats
+
+    # disk=False: a telemetry scrape must not stat every store entry —
+    # the running byte estimate stands in for the exact directory walk
+    return stats(disk=False)
+
+
 def register_default_collectors(reg: MetricsRegistry = registry) -> None:
     reg.register_collector("dispatch.kernel_cache", _collect_kernel_cache)
     reg.register_collector("pipeline", _collect_pipeline)
     reg.register_collector("serving", _collect_serving)
     reg.register_collector("jit.compile", _collect_compile)
+    reg.register_collector("compile_cache", _collect_compile_cache)

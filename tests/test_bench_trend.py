@@ -11,20 +11,23 @@ from __future__ import annotations
 import json
 import os
 
-from tools.bench_trend import (DEFAULT_METRIC, judge, load_trajectory,
-                               main)
+from tools.bench_trend import (DEFAULT_EXTRAS, DEFAULT_METRIC, judge,
+                               load_trajectory, main)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _write_run(dirpath, n, value=None, rc=0, note="cpu_fallback",
-               metric=DEFAULT_METRIC, parsed_override="unset"):
+               metric=DEFAULT_METRIC, parsed_override="unset",
+               coldstart=None):
     payload = {"n": n, "cmd": "bench", "rc": rc, "tail": ""}
     if parsed_override != "unset":
         payload["parsed"] = parsed_override
     elif value is not None:
         payload["parsed"] = {"metric": metric, "value": value,
                              "unit": "tokens/sec", "note": note}
+        if coldstart is not None:
+            payload["parsed"]["coldstart"] = coldstart
     else:
         payload["parsed"] = None
     path = os.path.join(dirpath, f"BENCH_r{n:02d}.json")
@@ -119,3 +122,55 @@ class TestJudgment:
         assert main(["--dir", str(tmp_path)]) == 0
         capsys.readouterr()
         assert main(["--dir", str(tmp_path), "--threshold", "0.10"]) == 1
+
+
+class TestColdstartTrack:
+    """ISSUE 9 satellite: the cold-vs-warm start metric rides the same
+    trajectory machinery as the tokens/sec headline — deltas reported,
+    judged only once two rounds carry it."""
+
+    PATH = DEFAULT_EXTRAS[0]  # coldstart.train_warm_speedup_x
+
+    def test_extracts_dotted_path_and_reports_deltas(self, tmp_path, capsys):
+        _write_run(str(tmp_path), 1, 20000.0,
+                   coldstart={"train_warm_speedup_x": 10.0})
+        _write_run(str(tmp_path), 2, 21000.0,
+                   coldstart={"train_warm_speedup_x": 12.0})
+        rows = load_trajectory(str(tmp_path), extract=self.PATH)
+        assert [r["value"] for r in rows] == [10.0, 12.0]
+        rc = main(["--dir", str(tmp_path), "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        extra = payload["extras"][self.PATH]
+        assert extra["verdict"]["ok"] is True
+        assert extra["verdict"]["delta_vs_best"] == 0.2
+
+    def test_no_gate_until_two_rounds_carry_the_metric(self, tmp_path):
+        """Pre-existing rounds without extras.coldstart are value=None
+        rows: one carrying round = 'single parsed run', no gate — a
+        freshly introduced metric cannot fail its first round."""
+        _write_run(str(tmp_path), 1, 20000.0)  # no coldstart payload
+        _write_run(str(tmp_path), 2, 21000.0,
+                   coldstart={"train_warm_speedup_x": 12.0})
+        rows = load_trajectory(str(tmp_path), extract=self.PATH)
+        assert rows[0]["value"] is None and rows[0]["note"] == "metric absent"
+        verdict = judge(rows, 0.20)
+        assert verdict["ok"] is True and "single parsed" in verdict["reason"]
+
+    def test_coldstart_regression_gates_once_history_exists(self, tmp_path):
+        _write_run(str(tmp_path), 1, 20000.0,
+                   coldstart={"train_warm_speedup_x": 12.0})
+        _write_run(str(tmp_path), 2, 20000.0,
+                   coldstart={"train_warm_speedup_x": 1.0})  # warm ≈ cold
+        assert main(["--dir", str(tmp_path)]) == 1
+        # headline alone still passes: the extras gate caught it
+        assert main(["--dir", str(tmp_path), "--no-extras"]) == 0
+
+    def test_repo_history_tolerates_absent_coldstart(self, capsys):
+        """Every existing BENCH_r*.json predates extras.coldstart — the
+        extras track must load them as absent rows and stay OK."""
+        rc = main(["--dir", REPO_ROOT, "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0, payload
+        extra = payload["extras"][self.PATH]
+        assert extra["verdict"]["ok"] is True

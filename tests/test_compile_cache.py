@@ -1,0 +1,628 @@
+"""Persistent compile cache (ISSUE 9): disk-backed AOT executables.
+
+The contract under test:
+
+- the store round-trips executables with atomic publishing, integrity
+  checksums and LRU byte-cap pruning;
+- EVERY failure mode degrades to a normal compile — truncated/corrupt
+  entries, a jaxlib-version (fingerprint) mismatch, concurrent writers
+  racing on one key, a read-only cache dir — a bad cache entry must
+  never take down a trainer or a replica;
+- all three compile sites warm-start from disk with bit-identical
+  outputs: the eager kernel cache (no-VJP entries; VJP entries counted
+  as skipped), ``CompiledFunction``/``TrainStep`` (XLA compile skipped,
+  keyed on lowered StableHLO), and the serving ``_BatchProgram`` bucket
+  ladder (the whole ladder restored with ZERO traces and
+  ``compiles_after_warmup == 0``);
+- the operational surface holds: ``tools.cache`` ls/verify/prune/stats
+  (verify non-zero on corrupt/orphan entries — the CI hook), the CC70x
+  lint family fires on seeded negatives, counters land in
+  ``observability.snapshot()``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import compile_cache as cc
+from paddle_tpu.base.flags import get_flag, set_flags
+from paddle_tpu.compile_cache import store as st
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    """Arm the persistent tier at a fresh store for one test; counters
+    zeroed; flags restored afterwards whatever happens."""
+    prev = {"compile_cache": get_flag("compile_cache"),
+            "compile_cache_dir": get_flag("compile_cache_dir"),
+            "compile_cache_max_bytes": get_flag("compile_cache_max_bytes")}
+    d = str(tmp_path / "store")
+    set_flags({"compile_cache": True, "compile_cache_dir": d})
+    cc.reset_stats()
+    try:
+        yield d
+    finally:
+        set_flags(prev)
+        cc.reset_stats()
+
+
+def _small_compiled(mul=2.0):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda x: x * mul).lower(jnp.ones((4, 4))).compile()
+
+
+# ------------------------------------------------------------------ store
+class TestStore:
+    def test_roundtrip_and_counters(self, cache_dir):
+        import jax.numpy as jnp
+
+        digest = cc.derive_digest("demo", "roundtrip")
+        assert cc.store_executable(digest, _small_compiled(),
+                                   key_meta={"site": "demo", "op": "x2"})
+        restored = cc.load_executable(digest, site="demo")
+        assert restored is not None
+        out = restored(jnp.ones((4, 4)))
+        assert float(np.asarray(out)[0, 0]) == 2.0
+        s = cc.stats()
+        assert s["hit"] == 1 and s["store"] == 1 and s["miss"] == 0
+        assert s["disk_bytes"] > 0
+
+    def test_miss_and_digest_fold_fingerprint(self, cache_dir):
+        assert cc.load_executable(cc.derive_digest("demo", "absent")) is None
+        assert cc.stats()["miss"] == 1
+        # same material, different fingerprint digest → different address
+        a = cc.derive_digest("demo", "m", fp_digest="aaaa")
+        b = cc.derive_digest("demo", "m", fp_digest="bbbb")
+        assert a != b
+
+    def test_fingerprint_invalidates_on_staging_flag_change(self, cache_dir):
+        """Flipping a staging-relevant flag mid-process re-derives the
+        fingerprint — executables staged under the new flag value must
+        not be stored under the old environment's identity."""
+        from paddle_tpu.compile_cache import keys
+
+        prev = get_flag("use_pallas_kernels")
+        fp_before = keys.fingerprint_digest()
+        try:
+            set_flags({"use_pallas_kernels": not prev})
+            assert keys.fingerprint_digest() != fp_before
+            assert keys.fingerprint()["flags"]["use_pallas_kernels"] is not prev
+        finally:
+            set_flags({"use_pallas_kernels": prev})
+        assert keys.fingerprint_digest() == fp_before
+
+    def test_unpicklable_key_material_degrades(self, cache_dir):
+        assert cc.derive_digest("demo", lambda: 0) is None  # local closure
+        assert cc.load_executable(None) is None  # and load tolerates it
+
+    def test_truncated_entry_is_a_counted_miss_and_discarded(self, cache_dir):
+        digest = cc.derive_digest("demo", "trunc")
+        cc.store_executable(digest, _small_compiled())
+        path = st.entry_path(cache_dir, digest)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+        assert cc.load_executable(digest) is None
+        assert cc.stats()["corrupt"] == 1 and cc.stats()["miss"] == 1
+        assert not os.path.exists(path)  # cannot re-corrupt the next start
+
+    def test_garbage_header_is_corrupt_not_crash(self, cache_dir):
+        digest = cc.derive_digest("demo", "garbage")
+        os.makedirs(cache_dir, exist_ok=True)
+        with open(st.entry_path(cache_dir, digest), "wb") as f:
+            f.write(b"PTCC1\n\xff\xff\xff\xff\xff\xff\xff\xffnot json")
+        assert cc.load_executable(digest) is None
+        assert cc.stats()["corrupt"] == 1
+
+    def test_checksum_mismatch_detected(self, cache_dir):
+        digest = cc.derive_digest("demo", "bitrot")
+        cc.store_executable(digest, _small_compiled())
+        path = st.entry_path(cache_dir, digest)
+        data = bytearray(open(path, "rb").read())
+        data[-1] ^= 0xFF  # flip one payload bit
+        with open(path, "wb") as f:
+            f.write(bytes(data))
+        assert cc.load_executable(digest) is None
+        assert cc.stats()["corrupt"] == 1
+
+    def test_jaxlib_version_mismatch_misses(self, cache_dir, monkeypatch):
+        """An entry published by a different toolchain: its digest folds
+        the OLD fingerprint, so the new process addresses a different
+        file — a natural miss; and a hand-renamed file still bounces off
+        the header's fingerprint check."""
+        from paddle_tpu.compile_cache import keys
+
+        old_fp = dict(keys.fingerprint())
+        old_fp["jaxlib"] = "0.0.1"
+        monkeypatch.setattr(keys, "_fingerprint_memo", [old_fp])
+        cc.reset_stats()
+        digest_old = cc.derive_digest("demo", "versioned")
+        cc.store_executable(digest_old, _small_compiled())
+        monkeypatch.setattr(keys, "_fingerprint_memo", [])
+        # the real environment derives a DIFFERENT address for the key
+        assert cc.derive_digest("demo", "versioned") != digest_old
+        assert cc.load_executable(
+            cc.derive_digest("demo", "versioned")) is None
+        # an operator hand-renames the stale entry onto the new address:
+        # the header fingerprint check refuses to serve it
+        os.rename(st.entry_path(cache_dir, digest_old),
+                  st.entry_path(cache_dir,
+                                cc.derive_digest("demo", "versioned")))
+        assert cc.load_executable(
+            cc.derive_digest("demo", "versioned")) is None
+        assert cc.stats()["fingerprint_mismatch"] == 1
+
+    def test_concurrent_writers_one_key_atomic_rename(self, cache_dir):
+        """N threads race one digest: the rename is atomic, so whatever
+        lands last wins whole — one valid entry, never a torn file."""
+        digest = cc.derive_digest("demo", "raced")
+        compiled = _small_compiled()
+        errs = []
+
+        def writer():
+            try:
+                cc.store_executable(digest, compiled,
+                                    key_meta={"site": "demo"})
+            except Exception as e:  # pragma: no cover - the failure mode
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errs == []
+        entries = [r for r in st.list_entries(cache_dir)
+                   if not r.get("orphan")]
+        assert len(entries) == 1  # losers discarded, no .tmp residue
+        assert cc.load_executable(digest) is not None
+
+    def test_read_only_dir_degrades_to_warning(self, cache_dir,
+                                               monkeypatch):
+        """An unwritable store (read-only mount, disk full) refuses the
+        publish rename: one warning, a counted store_error, and loads
+        keep serving. (Simulated by failing the atomic rename — chmod is
+        no barrier to a root CI user.)"""
+        from helpers import capture_logs
+
+        digest = cc.derive_digest("demo", "ro_pre")
+        cc.store_executable(digest, _small_compiled())
+
+        def denied(src, dst):
+            raise PermissionError(13, "read-only file system", dst)
+
+        monkeypatch.setattr(os, "replace", denied)
+        st._warned_store_failure[0] = False
+        with capture_logs() as buf:
+            ok = cc.store_executable(cc.derive_digest("demo", "ro_new"),
+                                     _small_compiled())
+        monkeypatch.undo()
+        assert ok is False
+        assert "degrading to read-only" in buf.getvalue()
+        assert cc.stats()["store_error"] == 1
+        # no tmp dropping left behind by the failed writer
+        assert all(not r.get("orphan") for r in st.list_entries(cache_dir))
+        # loads still serve: a read-only warm cache is a warm cache
+        assert cc.load_executable(digest) is not None
+
+    def test_lru_prune_to_byte_budget(self, cache_dir):
+        import time as _time
+
+        digests = []
+        for i in range(4):
+            d = cc.derive_digest("demo", f"entry{i}")
+            cc.store_executable(d, _small_compiled(float(i + 1)))
+            digests.append(d)
+            _time.sleep(0.02)  # distinct mtimes for LRU ordering
+        one = st.list_entries(cache_dir)[0]["bytes"]
+        cc.load_executable(digests[0])  # refresh entry 0: recently used
+        report = st.prune(cache_dir, max_bytes=2 * one + one // 2)
+        assert report["removed"] == 2
+        kept = {r["digest"] for r in st.list_entries(cache_dir)}
+        assert digests[0] in kept  # the touched entry survived
+        assert digests[3] in kept  # the newest survived
+
+    def test_store_prunes_automatically_past_flag_budget(self, cache_dir):
+        d0 = cc.derive_digest("demo", "auto0")
+        cc.store_executable(d0, _small_compiled())
+        one = st.total_bytes(cache_dir)
+        set_flags({"compile_cache_max_bytes": int(one * 1.5)})
+        import time as _time
+
+        _time.sleep(0.02)
+        cc.store_executable(cc.derive_digest("demo", "auto1"),
+                            _small_compiled(3.0))
+        rows = [r for r in st.list_entries(cache_dir) if not r.get("orphan")]
+        assert len(rows) == 1  # the older entry was pruned at publish time
+
+
+# ------------------------------------------------------- the three sites
+class TestKernelCacheSite:
+    def test_no_vjp_entry_restores_bit_identical(self, cache_dir):
+        from paddle_tpu.core import kernel_cache
+
+        kernel_cache.clear()
+        a = paddle.ones([8, 8])
+        cold = paddle.matmul(a, a).numpy()
+        assert cc.stats()["store"] >= 1
+        kernel_cache.clear()  # the in-process restart proxy
+        hits_before = cc.stats()["hit"]
+        warm = paddle.matmul(a, a).numpy()
+        assert cc.stats()["hit"] > hits_before
+        assert np.array_equal(cold, warm)
+        entry = next(iter(kernel_cache._cache.values()))
+        assert entry.exec is not None  # replay serves the AOT executable
+        kernel_cache.clear()
+
+    def test_vjp_entry_skipped_and_grad_correct(self, cache_dir):
+        from paddle_tpu.core import kernel_cache
+
+        kernel_cache.clear()
+        x = paddle.Tensor(np.full((4, 4), 3.0, np.float32),
+                          stop_gradient=False)
+        out = paddle.matmul(x, x)
+        out.backward()
+        assert cc.stats()["vjp_skip"] >= 1
+        assert x.grad is not None
+        got = x.grad.numpy()
+        kernel_cache.clear()
+        set_flags({"compile_cache": False})
+        y = paddle.Tensor(np.full((4, 4), 3.0, np.float32),
+                          stop_gradient=False)
+        paddle.matmul(y, y).backward()
+        assert np.array_equal(got, y.grad.numpy())
+        kernel_cache.clear()
+
+    def test_rng_refused_kernel_never_reaches_disk(self, cache_dir):
+        """A kernel the staging RNG guard refuses (it draws from the
+        global generator under trace) is poisoned in-process — and must
+        leave NOTHING on disk: a warm restore replays without tracing,
+        so the guard could never re-detect the frozen randomness there."""
+        import jax
+
+        from paddle_tpu.base import global_state
+        from paddle_tpu.core import kernel_cache
+        from paddle_tpu.core.dispatch import primitive
+
+        kernel_cache.clear()
+        paddle.seed(7)
+
+        def bad_kernel(v):
+            k = global_state.default_generator.split()
+            return v + jax.random.uniform(k, v.shape, v.dtype)
+
+        x = paddle.Tensor(np.zeros((16,), np.float32))
+        o1 = primitive("aux_cc_rng", bad_kernel, [x])
+        o2 = primitive("aux_cc_rng", bad_kernel, [x])
+        assert not np.array_equal(o1.numpy(), o2.numpy())  # slow path serves
+        rows = [r for r in st.list_entries(cache_dir)
+                if (r.get("header") or {}).get("key_meta", {})
+                .get("site") == "kernel"]
+        assert rows == []  # the refused executable was never published
+        kernel_cache.clear()
+
+    def test_disabled_flag_means_no_disk_io(self, cache_dir):
+        from paddle_tpu.core import kernel_cache
+
+        set_flags({"compile_cache": False})
+        kernel_cache.clear()
+        a = paddle.ones([4, 4])
+        paddle.matmul(a, a)
+        assert not os.path.exists(cache_dir) or \
+            st.list_entries(cache_dir) == []
+        kernel_cache.clear()
+
+
+class TestCompiledFunctionSite:
+    def test_warm_restore_skips_compile_bit_identical(self, cache_dir):
+        from paddle_tpu.jit.functionalize import functionalize
+
+        w = paddle.Tensor(np.full((8, 8), 2.0, np.float32),
+                          stop_gradient=True)
+
+        def make():
+            return functionalize(lambda x: paddle.matmul(x, w) + 1)
+
+        f_cold = make()
+        cold = f_cold(paddle.ones([4, 8])).numpy()
+        s = cc.stats()
+        assert s["store"] == 1 and s["miss"] == 1
+        f_warm = make()  # fresh closure: no in-process jit reuse possible
+        warm = f_warm(paddle.ones([4, 8])).numpy()
+        assert cc.stats()["hit"] == 1
+        assert np.array_equal(cold, warm)
+        # steady state replays the restored executable, no further IO
+        hits = cc.stats()["hit"]
+        again = f_warm(paddle.ones([4, 8])).numpy()
+        assert cc.stats()["hit"] == hits
+        assert np.array_equal(warm, again)
+
+    def test_train_step_first_useful_step_bit_identical(self, cache_dir):
+        """The restarted-trainer path: two fresh TrainSteps over the same
+        seed and batch — the warm one restores the whole-step executable
+        from disk and its first-step loss is bit-identical."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit.api import TrainStep
+
+        def first_loss():
+            paddle.seed(0)
+            model = nn.Linear(8, 4)
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=model.parameters())
+            crit = nn.MSELoss()
+            step = TrainStep(model=model, optimizer=opt,
+                             loss_fn=lambda x, y: crit(model(x), y))
+            x = paddle.Tensor(np.ones((2, 8), np.float32),
+                              stop_gradient=True)
+            y = paddle.Tensor(np.zeros((2, 4), np.float32),
+                              stop_gradient=True)
+            return float(step(x, y).numpy())
+
+        cold = first_loss()
+        stores = cc.stats()["store"]
+        assert stores >= 1
+        hits_before = cc.stats()["hit"]
+        warm = first_loss()
+        assert cc.stats()["hit"] > hits_before
+        assert cold == warm  # bit-identical first useful step
+
+    def test_guarded_family_restores_per_specialization(self, cache_dir):
+        from paddle_tpu.jit.functionalize import functionalize
+
+        def make():
+            @functionalize
+            def g(x):
+                if paddle.sum(x) > 0:
+                    return x * 2
+                return x * 3
+
+            return g
+
+        g1 = make()
+        pos = g1(paddle.ones([4])).numpy()
+        neg = g1(paddle.full([4], -1.0)).numpy()
+        assert cc.stats()["store"] == 2  # one per specialization
+        g2 = make()
+        assert np.array_equal(g2(paddle.ones([4])).numpy(), pos)
+        assert np.array_equal(g2(paddle.full([4], -1.0)).numpy(), neg)
+        assert cc.stats()["hit"] == 2
+        assert g2.stats["compiled_steps"] == 2
+
+
+class TestServingSite:
+    @pytest.fixture
+    def exported(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        net.eval()
+        prefix = str(tmp_path / "model")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([None, 8], "float32")])
+        return prefix
+
+    def test_warm_ladder_restores_with_zero_traces(self, cache_dir,
+                                                   exported):
+        from paddle_tpu.inference import Config, Predictor
+
+        p_cold = Predictor(Config(exported))
+        p_cold.set_batch_ladder([1, 2, 4])
+        p_cold.warmup_ladder()
+        assert p_cold.compile_count == 3  # one trace per rung, as ever
+        assert cc.stats()["store"] == 3
+        x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        cold = p_cold.run_many([x])
+
+        p_warm = Predictor(Config(exported))
+        p_warm.set_batch_ladder([1, 2, 4])
+        p_warm.warmup_ladder()
+        # THE acceptance proof: whole ladder from disk, zero traces
+        assert p_warm.compile_count == 0
+        assert p_warm.restored_rungs == [1, 2, 4]
+        warm = p_warm.run_many([x])
+        assert all(np.array_equal(a, b) for a, b in zip(cold, warm))
+
+    def test_warm_engine_zero_compiles_after_warmup(self, cache_dir,
+                                                    exported):
+        from paddle_tpu import serving
+        from paddle_tpu.analysis.jaxpr_audit import audit_serving
+        from paddle_tpu.profiler.pipeline import ServingStats
+
+        # publish the ladder once (the "previous replica")
+        cold = serving.ServingEngine(exported, buckets=[1, 2, 4],
+                                     stats=ServingStats())
+        cold.warmup()
+        cold.shutdown(drain=True)
+        assert cc.stats()["store"] == 3
+
+        warm = serving.ServingEngine(exported, buckets=[1, 2, 4],
+                                     stats=ServingStats())
+        warm.warmup()
+        rs = np.random.RandomState(0)
+        for tenant, n in (("a", 1), ("b", 3), ("a", 4)):
+            warm.run(tenant, rs.randn(n, 8).astype(np.float32))
+        warm.shutdown(drain=True)
+        assert warm.compile_count == 0          # traces_on_warm_start == 0
+        assert warm.compiles_after_warmup == 0  # steady state holds too
+        assert [str(f) for f in audit_serving(warm)] == []
+
+    def test_corrupt_rung_falls_back_to_compile(self, cache_dir, exported):
+        """A replica must survive a rotted store: the corrupt rung
+        recompiles (one trace), the intact rungs still restore."""
+        from paddle_tpu.inference import Config, Predictor
+
+        p = Predictor(Config(exported))
+        p.set_batch_ladder([1, 2, 4])
+        p.warmup_ladder()
+        victim = next(r["path"] for r in st.list_entries(cache_dir)
+                      if not r.get("orphan"))
+        with open(victim, "r+b") as f:
+            f.truncate(64)
+        p2 = Predictor(Config(exported))
+        p2.set_batch_ladder([1, 2, 4])
+        p2.warmup_ladder()
+        assert p2.compile_count == 1  # exactly the corrupt rung recompiled
+        assert len(p2.restored_rungs) == 2
+        assert cc.stats()["corrupt"] == 1
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        assert p2.run_many([x])  # and it serves
+
+
+# ------------------------------------------------------------ operations
+class TestToolsCacheCli:
+    def test_ls_stats_verify_on_healthy_store(self, cache_dir, capsys):
+        import tools.cache as cli
+
+        cc.store_executable(cc.derive_digest("demo", "a"), _small_compiled(),
+                            key_meta={"site": "demo", "op": "a"})
+        assert cli.main(["ls", "--dir", cache_dir]) == 0
+        capsys.readouterr()
+        assert cli.main(["verify", "--dir", cache_dir]) == 0
+        capsys.readouterr()
+        assert cli.main(["stats", "--dir", cache_dir, "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["entries"] == 1 and payload["by_site"] == {"demo": 1}
+        assert payload["corrupt"] == 0 and payload["orphans"] == 0
+
+    def test_verify_exits_nonzero_on_corrupt_and_orphan(self, cache_dir,
+                                                        capsys):
+        """The CI satellite: any corrupt or orphan entry fails verify."""
+        import tools.cache as cli
+
+        d = cc.derive_digest("demo", "v")
+        cc.store_executable(d, _small_compiled())
+        assert cli.main(["verify", "--dir", cache_dir]) == 0
+        capsys.readouterr()
+        with open(st.entry_path(cache_dir, d), "r+b") as f:
+            f.truncate(16)
+        assert cli.main(["verify", "--dir", cache_dir]) == 1
+        capsys.readouterr()
+        os.unlink(st.entry_path(cache_dir, d))
+        with open(os.path.join(cache_dir, "x.ptcc.tmp.1.dead"), "wb") as f:
+            f.write(b"junk")
+        assert cli.main(["verify", "--dir", cache_dir]) == 1
+
+    def test_prune_subcommand_applies_cap(self, cache_dir, capsys):
+        import time as _time
+
+        import tools.cache as cli
+
+        for i in range(3):
+            cc.store_executable(cc.derive_digest("demo", f"p{i}"),
+                                _small_compiled(float(i + 1)))
+            _time.sleep(0.02)
+        biggest = max(r["bytes"] for r in st.list_entries(cache_dir))
+        assert cli.main(["prune", "--dir", cache_dir,
+                         "--max-bytes", str(biggest + 64)]) == 0
+        assert len(st.list_entries(cache_dir)) == 1
+
+    def test_missing_dir_exits_nonzero(self, capsys):
+        import tools.cache as cli
+
+        assert cli.main(["verify", "--dir", "/nonexistent/cache/dir"]) == 1
+
+
+class TestCacheLintFamily:
+    def test_cc700_non_hermetic_key_seeded(self, cache_dir):
+        from paddle_tpu.analysis.cache_check import audit_cache_dir
+
+        d = cc.derive_digest("demo", "ok")
+        cc.store_executable(d, _small_compiled())
+        # seed an entry whose header carries no fingerprint
+        path = st.entry_path(cache_dir, "f" * 64)
+        payload = b"fake"
+        header = {"version": st.FORMAT_VERSION, "digest": "f" * 64,
+                  "key_meta": {"site": "demo"},
+                  "payload_sha256": st._checksum(payload),
+                  "payload_bytes": len(payload), "created": 0}
+        head = json.dumps(header, sort_keys=True).encode()
+        import struct
+
+        with open(path, "wb") as f:
+            f.write(st.MAGIC + struct.pack(">Q", len(head)) + head + payload)
+        findings = audit_cache_dir(cache_dir)
+        assert {f.code for f in findings} == {"CC700"}
+        assert all(f.severity == "error" for f in findings)
+
+    def test_cc701_store_over_budget_seeded(self, cache_dir):
+        from paddle_tpu.analysis.cache_check import audit_cache_dir
+
+        cc.store_executable(cc.derive_digest("demo", "big"),
+                            _small_compiled())
+        findings = audit_cache_dir(cache_dir, max_bytes=16)
+        assert {f.code for f in findings} == {"CC701"}
+
+    def test_cc702_mixed_fingerprints_seeded(self, cache_dir, monkeypatch):
+        from paddle_tpu.analysis.cache_check import audit_cache_dir
+        from paddle_tpu.compile_cache import keys
+
+        cc.store_executable(cc.derive_digest("demo", "here"),
+                            _small_compiled())
+        other_fp = dict(keys.fingerprint())
+        other_fp["jaxlib"] = "9.9.9"
+        monkeypatch.setattr(keys, "_fingerprint_memo", [other_fp])
+        cc.store_executable(cc.derive_digest("demo", "elsewhere"),
+                            _small_compiled(3.0))
+        monkeypatch.setattr(keys, "_fingerprint_memo", [])
+        findings = audit_cache_dir(cache_dir)
+        assert {f.code for f in findings} == {"CC702"}
+        assert "2 incompatible" in findings[0].message
+
+    def test_cc703_corrupt_and_orphan_seeded(self, cache_dir):
+        from paddle_tpu.analysis.cache_check import audit_cache_dir
+
+        d = cc.derive_digest("demo", "c")
+        cc.store_executable(d, _small_compiled())
+        with open(st.entry_path(cache_dir, d), "r+b") as f:
+            f.truncate(8)
+        with open(os.path.join(cache_dir, "y.ptcc.tmp.2.dead"), "wb") as f:
+            f.write(b"junk")
+        codes = [f.code for f in audit_cache_dir(cache_dir)]
+        assert codes.count("CC703") == 2
+
+    def test_cache_family_rides_lint_cli_contract(self, capsys):
+        import tools.lint as lint_cli
+
+        rc = lint_cli.main(["--json", "--analyzer", "cache"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        payload = json.loads(out)
+        assert payload["analyzers"] == ["cache"]
+        assert "cache" in payload["timings_s"]
+
+
+class TestObservability:
+    def test_counters_land_in_snapshot(self, cache_dir):
+        from paddle_tpu.observability import snapshot
+
+        cc.store_executable(cc.derive_digest("demo", "obs"),
+                            _small_compiled())
+        cc.load_executable(cc.derive_digest("demo", "obs"))
+        snap = snapshot()
+        cache_ns = snap["metrics"]["compile_cache"]
+        assert cache_ns["type"] == "collected"
+        assert cache_ns["hit"] == 1 and cache_ns["store"] == 1
+
+    def test_load_and_store_spans_on_trace_timeline(self, cache_dir):
+        from paddle_tpu.observability.tracing import tracer
+
+        was = tracer.enabled
+        tracer.enable()
+        tracer.reset()
+        try:
+            cc.store_executable(cc.derive_digest("demo", "spans"),
+                                _small_compiled())
+            cc.load_executable(cc.derive_digest("demo", "spans"))
+            names = [e["name"] for e in tracer.tail_chrome_events()]
+        finally:
+            tracer.enabled = was
+        assert "compile_cache.store" in names
+        assert "compile_cache.load" in names

@@ -165,7 +165,10 @@ class TestWalker:
             jnp.ones((128, 128), jnp.float32)))
         assert rep.peak_bytes >= 4 * one
 
-    def test_collective_volume_per_axis(self):
+    def test_collective_volume_resolves_mesh_axis_size(self):
+        """Ring factors are axis-size-aware (ISSUE 9 satellite): the
+        shard_map mesh declares dp=1, and a 1-device ring moves ZERO
+        bytes — 2(n-1)/n with n=1, not the old constant 2x."""
         import jax
         import jax.numpy as jnp
         import jax.experimental.shard_map as shard_map
@@ -175,8 +178,42 @@ class TestWalker:
         f = shard_map.shard_map(lambda x: jax.lax.psum(x, "dp"),
                                 mesh=mesh, in_specs=P(), out_specs=P())
         rep = cost_jaxpr(jax.make_jaxpr(f)(jnp.ones((8, 8), jnp.float32)))
-        # ring all-reduce factor 2 x one 256-byte buffer
-        assert rep.comm_bytes == {"dp": 2.0 * 8 * 8 * 4}
+        assert rep.comm_bytes == {"dp": 0.0}
+
+    def test_collective_volume_ring_factor_from_seeded_axis_sizes(self):
+        """An explicit axis_sizes seed (the planner's Plan degrees)
+        prices psum at the exact 2(n-1)/n ring volume, and the same
+        program without the seed keeps the 2x static upper bound."""
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            return jax.lax.psum(x, "dp")
+
+        closed = jax.make_jaxpr(f, axis_env=[("dp", 8)])(
+            jnp.ones((8, 8), jnp.float32))
+        buf = 8 * 8 * 4
+        rep = cost_jaxpr(closed, axis_sizes={"dp": 8})
+        assert rep.comm_bytes == {"dp": pytest.approx(2.0 * 7 / 8 * buf)}
+        # unresolved axis: the historical static factor survives as the bound
+        rep_unseeded = cost_jaxpr(closed)
+        assert rep_unseeded.comm_bytes == {"dp": 2.0 * buf}
+
+    def test_collective_one_pass_family_ring_factor(self):
+        """all_gather moves (n-1)/n per ring step, not a flat 1x, once
+        the axis size is known."""
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            return jax.lax.all_gather(x, "dp")
+
+        closed = jax.make_jaxpr(f, axis_env=[("dp", 4)])(
+            jnp.ones((8, 8), jnp.float32))
+        buf = 8 * 8 * 4
+        rep = cost_jaxpr(closed, axis_sizes={"dp": 4})
+        assert rep.comm_bytes == {"dp": pytest.approx(3 / 4 * buf)}
+        assert cost_jaxpr(closed).comm_bytes == {"dp": 1.0 * buf}
 
     def test_dynamic_flops_delegates_to_cost_model(self):
         """The layer-hook front end and the cost model share one set of
@@ -326,6 +363,41 @@ class TestCostFindings:
         # a fat enough pipe: silent
         assert "CM503" not in _codes(check_cost(
             rep, bandwidth_gbps=1e12, device_tflops=197.0))
+
+    def test_cm505_guard_predicate_overhead_costed_and_gated(self):
+        """ISSUE 9 satellite: speculative branch families carry their
+        guard-predicate overhead (count + per-call device→host bytes) in
+        the report instead of being ignored, and CM505 fires past the
+        predicate budget."""
+        from paddle_tpu.jit.functionalize import functionalize
+
+        @functionalize
+        def many_branches(x):
+            out = x
+            for _ in range(3):  # 3 tensor-bool conversions = 3 predicates
+                if paddle.sum(out) > 0:
+                    out = out * 2
+                else:
+                    out = out * 3
+            return out
+
+        many_branches(paddle.ones([4]))
+        rep = many_branches.cost()
+        assert rep.guard_preds == 3
+        assert rep.guard_sync_bytes >= 3  # one bool per predicate, >=1B each
+        assert rep.to_dict()["guard_preds"] == 3
+        # over a 2-predicate budget: flagged; at the default budget: silent
+        findings = check_cost(rep, max_guard_preds=2)
+        assert "CM505" in _codes(findings)
+        f = next(f for f in findings if f.code == "CM505")
+        assert f.severity == "warning" and "3 guard predicates" in f.message
+        assert "CM505" not in _codes(check_cost(rep))
+        # an unguarded program reports zero overhead and never fires
+        plain = functionalize(lambda x: x * 2)
+        plain(paddle.ones([4]))
+        assert plain.cost().guard_preds == 0
+        assert "CM505" not in _codes(check_cost(plain.cost(),
+                                                max_guard_preds=0))
 
     def test_cm504_peak_over_hbm_budget_respects_plan(self):
         from paddle_tpu.distributed.auto_parallel.planner import Plan

@@ -1,12 +1,12 @@
 """CI gate: the repo itself passes its own static analysis.
 
-Runs all eight ``paddle_tpu.analysis`` analyzer families over the live
+Runs all nine ``paddle_tpu.analysis`` analyzer families over the live
 codebase and asserts ZERO error-severity findings, so a regression (a new
 jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug,
 a host callback in a compiled step, a typo'd mesh axis, a cost-model
 budget blowout, a serving-tier steady-state recompile, a leaked telemetry
-span or a sync inside a memory sampler) fails tier-1 instead of rotting
-until pod scale. The
+span, a sync inside a memory sampler or a non-hermetic persistent-cache
+entry) fails tier-1 instead of rotting until pod scale. The
 ``python -m tools.lint`` CLI contract (exit 0, machine-readable JSON
 with per-family wall-time, ``--include-tests``) is gated here too.
 """
@@ -143,6 +143,22 @@ def test_telemetry_contract_green_on_live_process():
     assert [str(f) for f in audit_telemetry()] == []  # live process state
 
 
+def test_cache_audit_green_on_demo_store(tmp_path):
+    """ISSUE 9: the persistent compile cache's hermeticity contract holds
+    on the representative store — two AOT executables published through
+    the public path, every entry fingerprinted, within budget, one
+    fingerprint, no corrupt/orphan files — and `tools.cache verify`
+    agrees with exit 0."""
+    from paddle_tpu.analysis.cache_check import (audit_cache_dir,
+                                                 record_demo_cache)
+
+    store_dir = record_demo_cache(str(tmp_path))
+    assert [str(f) for f in audit_cache_dir(store_dir)] == []
+    import tools.cache as cache_cli
+
+    assert cache_cli.main(["verify", "--dir", store_dir]) == 0
+
+
 def test_cli_exits_zero_with_machine_readable_findings(capsys):
     """`tools.lint --json --include-tests` over the repo: exit 0,
     parseable. Run in-process (the tests above already paid the analyzer
@@ -158,7 +174,7 @@ def test_cli_exits_zero_with_machine_readable_findings(capsys):
     assert payload["crashed"] == []
     assert set(payload["analyzers"]) == {"trace", "registry", "program",
                                          "jaxpr", "spmd", "cost", "serving",
-                                         "telemetry"}
+                                         "telemetry", "cache"}
     assert isinstance(payload["findings"], list)
     # per-family wall-time (CI satellite): one entry per analyzer run
     assert set(payload["timings_s"]) == set(payload["analyzers"])

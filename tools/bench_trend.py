@@ -15,6 +15,14 @@ The threshold is deliberately wider than the observed driver-box load
 swing (19.5k–25.1k tokens/sec across identical code) — this catches a
 framework regression, not scheduler noise. Wired as a tier-1 smoke test
 (``tests/test_bench_trend.py``) so the gate itself stays exercised.
+
+Alongside the headline, ``--extra`` dotted paths (default: the
+persistent-compile-cache cold-vs-warm start ratio,
+``coldstart.train_warm_speedup_x``) are tracked out of the SAME payloads:
+trend + deltas printed per run, judged with the same
+best-prior/threshold rule — which means no gate fires until at least two
+rounds carry the metric (a freshly introduced bench extra needs history
+before it can regress).
 """
 from __future__ import annotations
 
@@ -27,16 +35,34 @@ import sys
 from typing import List, Optional
 
 DEFAULT_METRIC = "gpt_tiny_train_tokens_per_sec_cpu"
+# extra dotted paths into the parsed payload tracked alongside the
+# headline — the persistent compile cache's cold-vs-warm start ratio
+# (bench extras.coldstart, ISSUE 9)
+DEFAULT_EXTRAS = ("coldstart.train_warm_speedup_x",)
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
 
-def load_trajectory(bench_dir: str, metric: str = DEFAULT_METRIC) -> List[dict]:
+def _extract_path(parsed: dict, dotted: str):
+    """Resolve one dotted path (``coldstart.train_warm_speedup_x``)
+    inside a parsed bench payload; None when any hop is absent."""
+    node = parsed
+    for key in dotted.split("."):
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    return node
+
+
+def load_trajectory(bench_dir: str, metric: str = DEFAULT_METRIC,
+                    extract: Optional[str] = None) -> List[dict]:
     """Every ``BENCH_r*.json`` under ``bench_dir`` in run order, reduced
     to ``{run, path, value, note, rc}``. Runs without a parsed payload
     (crashed/timed-out rounds) or reporting a different metric keep their
     row with ``value=None`` — visible in the trend print, ignored by the
-    regression math."""
+    regression math. With ``extract`` the value is the dotted path inside
+    the parsed payload instead of the headline (absent path → ``value
+    None``, note ``metric absent``) — the extras trajectory."""
     rows = []
     for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
         m = _RUN_RE.search(path)
@@ -53,7 +79,18 @@ def load_trajectory(bench_dir: str, metric: str = DEFAULT_METRIC) -> List[dict]:
             continue
         row["rc"] = payload.get("rc")
         parsed = payload.get("parsed")
-        if isinstance(parsed, dict) and parsed.get("metric") == metric:
+        if extract is not None:
+            if isinstance(parsed, dict):
+                raw = _extract_path(parsed, extract)
+                try:
+                    row["value"] = float(raw)
+                except (TypeError, ValueError):
+                    row["value"] = None
+                row["note"] = (parsed.get("note") if row["value"] is not None
+                               else "metric absent")
+            else:
+                row["note"] = "no parsed payload"
+        elif isinstance(parsed, dict) and parsed.get("metric") == metric:
             try:
                 row["value"] = float(parsed["value"])
             except (KeyError, TypeError, ValueError):
@@ -127,6 +164,13 @@ def main(argv=None) -> int:
         os.path.dirname(os.path.abspath(__file__))),
         help="directory holding BENCH_r*.json (default: repo root)")
     parser.add_argument("--metric", default=DEFAULT_METRIC)
+    parser.add_argument("--extra", action="append", metavar="DOTTED_PATH",
+                        help="extra parsed-payload paths to track and "
+                             "judge alongside the headline (repeatable; "
+                             "default: %s); pass --no-extras to disable"
+                             % ", ".join(DEFAULT_EXTRAS))
+    parser.add_argument("--no-extras", action="store_true",
+                        help="track the headline metric only")
     parser.add_argument("--threshold", type=float, default=0.20,
                         help="fractional regression that fails the gate "
                              "(default 0.20 = 20%%)")
@@ -135,14 +179,26 @@ def main(argv=None) -> int:
 
     rows = load_trajectory(args.dir, args.metric)
     verdict = judge(rows, args.threshold)
+    extras = [] if args.no_extras else (args.extra or list(DEFAULT_EXTRAS))
+    extra_out = {}
+    for dotted in extras:
+        erows = load_trajectory(args.dir, extract=dotted)
+        extra_out[dotted] = {"runs": erows,
+                             "verdict": judge(erows, args.threshold)}
+    ok = verdict["ok"] and all(e["verdict"]["ok"] for e in extra_out.values())
     if args.as_json:
         print(json.dumps({"metric": args.metric, "runs": rows,
-                          "verdict": verdict}, indent=2))
+                          "verdict": verdict, "extras": extra_out,
+                          "ok": ok}, indent=2))
     else:
         print(format_trend(rows, args.metric))
         print(("OK: " if verdict["ok"] else "REGRESSION: ")
               + str(verdict["reason"]))
-    return 0 if verdict["ok"] else 1
+        for dotted, e in extra_out.items():
+            print(format_trend(e["runs"], dotted))
+            print(("OK: " if e["verdict"]["ok"] else "REGRESSION: ")
+                  + str(e["verdict"]["reason"]))
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":  # pragma: no cover

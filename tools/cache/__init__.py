@@ -1,0 +1,224 @@
+"""``python -m tools.cache`` — the persistent compile cache operator CLI.
+
+Four subcommands over one store directory (``--dir``, default: the
+resolved ``FLAGS_compile_cache_dir``):
+
+- **ls**:     one row per entry — digest prefix, site/op, payload bytes,
+              age, fingerprint digest — plus orphan tmp files;
+- **verify**: structural + integrity pass over every file (magic, header
+              json, payload sha256, fingerprint presence). Exits
+              **non-zero when any entry is corrupt or orphaned** — the
+              CI hook: a store that would silently degrade to misses at
+              serve time fails loudly here instead;
+- **prune**:  apply the LRU byte cap (``--max-bytes``, default
+              ``FLAGS_compile_cache_max_bytes``) and sweep stale writer
+              tmp files;
+- **stats**:  machine-readable totals (entries, bytes, per-site counts,
+              fingerprints present, budget headroom).
+
+``--json`` on every subcommand prints one machine-readable object.
+Exit codes: 0 ok, 1 verify found corrupt/orphan entries (or the path
+does not exist for ls/verify/stats).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _resolve_dir(arg_dir):
+    if arg_dir:
+        return arg_dir
+    from paddle_tpu.compile_cache import cache_dir
+
+    return cache_dir()
+
+
+def _age(mtime: float) -> str:
+    s = max(time.time() - mtime, 0.0)
+    for unit, div in (("s", 1), ("m", 60), ("h", 3600), ("d", 86400)):
+        if s < 120 * div or unit == "d":
+            return f"{s / div:.0f}{unit}"
+    return f"{s:.0f}s"
+
+
+def _rows(cache_dir: str):
+    from paddle_tpu.compile_cache import store as st
+
+    return st.list_entries(cache_dir)
+
+
+def cmd_ls(cache_dir: str, as_json: bool) -> int:
+    rows = _rows(cache_dir)
+    out = []
+    for r in rows:
+        if r.get("orphan"):
+            out.append({"orphan": True, "file": os.path.basename(r["path"]),
+                        "bytes": r["bytes"], "age": _age(r["mtime"])})
+            continue
+        h = r["header"] or {}
+        meta = h.get("key_meta", {})
+        out.append({
+            "digest": (r.get("digest") or "")[:12],
+            "site": meta.get("site", "?"),
+            "what": meta.get("op") or meta.get("program")
+            or (f"bucket={meta['bucket']}" if "bucket" in meta else ""),
+            "bytes": r["bytes"],
+            "age": _age(r["mtime"]),
+            "fingerprint": (h.get("fingerprint_digest") or "?")[:8],
+            "corrupt": r["header"] is None,
+        })
+    if as_json:
+        print(json.dumps({"dir": cache_dir, "entries": out}, indent=2))
+    else:
+        print(f"{cache_dir}: {len(out)} file(s)")
+        for e in out:
+            if e.get("orphan"):
+                print(f"  ORPHAN  {e['file']}  {e['bytes']}B  {e['age']}")
+            else:
+                print(f"  {e['digest']}  {e['site']:<8} {str(e['what']):<18} "
+                      f"{e['bytes']:>8}B  {e['age']:>5}  fp={e['fingerprint']}")
+    return 0
+
+
+def cmd_verify(cache_dir: str, as_json: bool) -> int:
+    """Integrity pass: every entry must parse, checksum and carry a
+    fingerprint; no orphan tmp files. Non-zero exit on ANY defect."""
+    from paddle_tpu.compile_cache import store as st
+
+    problems = []
+    n_ok = 0
+    for r in _rows(cache_dir):
+        name = os.path.basename(r["path"])
+        if r.get("orphan"):
+            problems.append({"file": name, "problem": "orphan tmp file"})
+            continue
+        parsed = st._parse(r["path"])
+        if parsed is None:
+            problems.append({"file": name,
+                             "problem": "corrupt header/magic/format"})
+            continue
+        header, payload = parsed
+        if len(payload) != header.get("payload_bytes") or \
+                st._checksum(payload) != header.get("payload_sha256"):
+            problems.append({"file": name, "problem": "payload checksum "
+                             "mismatch (truncated or bit-rotted)"})
+            continue
+        if not header.get("fingerprint") or \
+                not header.get("fingerprint_digest"):
+            problems.append({"file": name,
+                             "problem": "no environment fingerprint "
+                             "(non-hermetic key, CC700)"})
+            continue
+        n_ok += 1
+    if as_json:
+        print(json.dumps({"dir": cache_dir, "ok": n_ok,
+                          "problems": problems}, indent=2))
+    else:
+        for p in problems:
+            print(f"BAD  {p['file']}: {p['problem']}")
+        print(f"tools.cache verify: {n_ok} ok, {len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+def cmd_prune(cache_dir: str, as_json: bool, max_bytes) -> int:
+    from paddle_tpu.compile_cache import store as st
+
+    report = st.prune(cache_dir, max_bytes=max_bytes)
+    if as_json:
+        print(json.dumps({"dir": cache_dir, **report}, indent=2))
+    else:
+        print(f"tools.cache prune: removed {report['removed']} "
+              f"({report['removed_bytes']}B), kept {report['kept']} "
+              f"({report['kept_bytes']}B)")
+    return 0
+
+
+def cmd_stats(cache_dir: str, as_json: bool) -> int:
+    rows = _rows(cache_dir)
+    sites = {}
+    fingerprints = set()
+    entry_bytes = orphan_bytes = 0
+    n_corrupt = n_orphans = 0
+    for r in rows:
+        if r.get("orphan"):
+            n_orphans += 1
+            orphan_bytes += r["bytes"]
+            continue
+        h = r["header"]
+        if h is None:
+            n_corrupt += 1
+            continue
+        entry_bytes += r["bytes"]
+        site = h.get("key_meta", {}).get("site", "?")
+        sites[site] = sites.get(site, 0) + 1
+        if h.get("fingerprint_digest"):
+            fingerprints.add(h["fingerprint_digest"])
+    try:
+        from paddle_tpu.base.flags import get_flag
+
+        budget = int(get_flag("compile_cache_max_bytes"))
+    except Exception:
+        budget = 0
+    payload = {
+        "dir": cache_dir,
+        "entries": sum(sites.values()),
+        "entry_bytes": entry_bytes,
+        "by_site": sites,
+        "fingerprints": sorted(fingerprints),
+        "corrupt": n_corrupt,
+        "orphans": n_orphans,
+        "orphan_bytes": orphan_bytes,
+        "budget_bytes": budget,
+        "budget_used": (round(entry_bytes / budget, 4)
+                        if budget > 0 else None),
+    }
+    if as_json:
+        print(json.dumps(payload, indent=2))
+    else:
+        print(f"{cache_dir}: {payload['entries']} entries, "
+              f"{entry_bytes}B"
+              + (f" ({payload['budget_used']:.0%} of budget)"
+                 if budget > 0 else "")
+              + f", {len(fingerprints)} fingerprint(s), "
+              f"{n_corrupt} corrupt, {n_orphans} orphan(s)")
+        for site, n in sorted(sites.items()):
+            print(f"  {site}: {n}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.cache",
+        description="operate the persistent compile cache "
+                    "(paddle_tpu.compile_cache): list, verify, prune, stats")
+    parser.add_argument("command", choices=("ls", "verify", "prune", "stats"))
+    parser.add_argument("--dir", default=None,
+                        help="store directory (default: resolved "
+                             "FLAGS_compile_cache_dir)")
+    parser.add_argument("--max-bytes", type=int, default=None,
+                        help="prune: byte cap override (default: "
+                             "FLAGS_compile_cache_max_bytes)")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+
+    cache_dir = _resolve_dir(args.dir)
+    if args.command != "prune" and not os.path.isdir(cache_dir):
+        print(json.dumps({"dir": cache_dir, "error": "no such directory"})
+              if args.as_json else
+              f"tools.cache: {cache_dir}: no such directory")
+        return 1
+    if args.command == "ls":
+        return cmd_ls(cache_dir, args.as_json)
+    if args.command == "verify":
+        return cmd_verify(cache_dir, args.as_json)
+    if args.command == "prune":
+        return cmd_prune(cache_dir, args.as_json, args.max_bytes)
+    return cmd_stats(cache_dir, args.as_json)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,6 +1,6 @@
 """``python -m tools.lint`` — the repo's static-analysis driver.
 
-Runs the eight ``paddle_tpu.analysis`` analyzers and reports findings:
+Runs the nine ``paddle_tpu.analysis`` analyzers and reports findings:
 
 - **trace**:    the trace-safety AST linter over ``paddle_tpu/`` (or the
                 paths given on the command line),
@@ -30,7 +30,12 @@ Runs the eight ``paddle_tpu.analysis`` analyzers and reports findings:
                 memory samplers, plus unclosed-span / duplicate-metric /
                 dead-anomaly-monitor / unbounded-egress audits over a
                 demo telemetry session (with a fed demo monitor) AND the
-                live process tracer + registry + monitor + exporters.
+                live process tracer + registry + monitor + exporters,
+- **cache**:    the persistent compile cache's hermeticity contract
+                (CC7xx) over a freshly recorded demo store (publish two
+                AOT executables → audit: every entry fingerprinted,
+                store within its byte budget, one fingerprint per dir,
+                no corrupt/orphan files).
 
 Exit-code contract (stable, CI-gateable):
   0 = no error-severity findings (warnings never gate)
@@ -53,7 +58,7 @@ import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd", "cost",
-              "serving", "telemetry")
+              "serving", "telemetry", "cache")
 
 
 def _source_paths(paths, include_tests=False):
@@ -210,16 +215,33 @@ def _run_telemetry(_paths, include_tests=False):
     return findings
 
 
+def _run_cache(_paths, include_tests=False):
+    """Record the representative persistent-compile-cache store (two AOT
+    executables published through the public path into a temp dir) and
+    audit its hermeticity contract (CC70x, analysis/cache_check.py)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.analysis.cache_check import audit_cache_dir, record_demo_cache
+
+    tmpdir = tempfile.mkdtemp(prefix="paddle_lint_cache_")
+    try:
+        return audit_cache_dir(record_demo_cache(tmpdir))
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 _RUNNERS = {"trace": _run_trace, "registry": _run_registry,
             "program": _run_program, "jaxpr": _run_jaxpr,
             "spmd": _run_spmd, "cost": _run_cost,
-            "serving": _run_serving, "telemetry": _run_telemetry}
+            "serving": _run_serving, "telemetry": _run_telemetry,
+            "cache": _run_cache}
 
 # analyzer -> its finding-code family prefix, so a crash finding
 # (<PREFIX>999) stays visible under --select filters for that family
 _FAMILY_PREFIX = {"trace": "TS", "registry": "RC", "program": "PV",
                   "jaxpr": "JX", "spmd": "SP", "cost": "CM",
-                  "serving": "JX", "telemetry": "OB"}
+                  "serving": "JX", "telemetry": "OB", "cache": "CC"}
 
 
 def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
