@@ -19,9 +19,19 @@ from . import amp_lists
 
 
 class AmpState:
-    def __init__(self, level="O1", dtype="bfloat16", custom_white_list=None, custom_black_list=None):
+    def __init__(self, level="O1", dtype="bfloat16", custom_white_list=None, custom_black_list=None,
+                 comm_dtype=None):
         self.level = level
         self.dtype = dtype_mod.np_dtype(dtype)
+        if comm_dtype not in (None, "int8"):
+            raise ValueError(
+                f"comm_dtype {comm_dtype!r} is not a supported gradient-sync "
+                "wire dtype; use 'int8' (the blockwise-quantized allreduce "
+                "tier, distributed/collective_opt) or None")
+        # wire dtype for gradient-sync collectives while this AMP state is
+        # active — "int8" engages the qpsum tier the same way
+        # FLAGS_comm_quantize_dp_grads does, scoped to the autocast region
+        self.comm_dtype = comm_dtype
         self.white = amp_lists.white_list()
         self.black = amp_lists.black_list()
         if custom_white_list:
@@ -57,11 +67,12 @@ class AmpState:
 
 
 @contextlib.contextmanager
-def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16", use_promote=True):
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16", use_promote=True,
+              comm_dtype=None):
     if not enable:
         yield
         return
-    state = AmpState(level, dtype, custom_white_list, custom_black_list)
+    state = AmpState(level, dtype, custom_white_list, custom_black_list, comm_dtype=comm_dtype)
     prev = global_state.set_amp_state(state)
     try:
         yield

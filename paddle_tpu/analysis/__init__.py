@@ -34,6 +34,10 @@ analyzers that run at commit time:
 - :mod:`telemetry_check` — the observability layer's own contract
   (OB6xx): no unclosed span at trace export, no duplicate metric
   registration, no blocking device sync inside a memory sampler.
+- :mod:`comm_check` — the comm-efficient collective tier's contract
+  (QZ8xx): quantized-allreduce accuracy/determinism gates, portable
+  reshard route engagement, no mixed gradient-sync wire dtypes on one
+  mesh axis.
 
 One CLI drives them all: ``python -m tools.lint`` (exit 1 on any
 error-severity finding, 2 on an analyzer crash; ``--json`` for

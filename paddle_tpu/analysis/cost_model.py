@@ -324,10 +324,15 @@ def _eqn_flops(eqn) -> tuple:
 
 def _eqn_comm(eqn, axis_sizes: Optional[Dict[str, int]] = None
               ) -> Dict[str, float]:
-    """Collective volume per mesh axis for one equation: operand bytes ×
+    """Collective volume per mesh axis for one equation: moved bytes ×
     the axis-size-aware ring factor (``axis_sizes`` is the environment
     threaded down from enclosing shard_map/pmap equations; an unknown
-    axis falls back to the static constants)."""
+    axis falls back to the static constants). The moved-bytes base is
+    the LARGER of operand/result bytes: all_gather's wire traffic scales
+    with the gathered result (n× its operand), psum_scatter's with its
+    operand (n× its result) — taking only operand bytes undercounted the
+    gather family by the axis size, which broke the quantized-collective
+    (int8 payload + fp32 scales) accounting the planner ranks plans on."""
     name = eqn.primitive.name
     if name not in _COLLECTIVE_PRIMS:
         return {}
@@ -337,8 +342,10 @@ def _eqn_comm(eqn, axis_sizes: Optional[Dict[str, int]] = None
     if not isinstance(axes, (list, tuple)):
         axes = (axes,)
     bytes_in = sum(_var_bytes(v) for v in eqn.invars)
+    bytes_out = sum(_var_bytes(v) for v in eqn.outvars)
+    moved = max(bytes_in, bytes_out)
     sizes = axis_sizes or {}
-    return {str(ax): _ring_factor(name, sizes.get(str(ax))) * bytes_in
+    return {str(ax): _ring_factor(name, sizes.get(str(ax))) * moved
             for ax in axes}
 
 

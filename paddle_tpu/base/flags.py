@@ -230,6 +230,29 @@ define_flag("compile_cache_max_bytes", 1 << 30,
             "a store pushes the directory past this, least-recently-USED "
             "entries (load refreshes mtime) are pruned; <=0 disables "
             "pruning (CC701 flags a store over budget)")
+define_flag("comm_quantize_dp_grads", False,
+            "comm-efficient collectives (distributed/collective_opt): "
+            "sync dp gradients through the blockwise-int8 quantized "
+            "allreduce tier (qpsum) instead of full-precision psum — "
+            "TrainStep's dp grad-sync stage, dist.spmd collectives and "
+            "communication.all_reduce all consult this; per-call override "
+            "via all_reduce(quantized=...) or amp.auto_cast("
+            "comm_dtype='int8')")
+define_flag("comm_quantize_min_bytes", 2048,
+            "quantized allreduce: tensors smaller than this stay on the "
+            "full-precision path (scale overhead + quantization noise "
+            "beat the bandwidth win on tiny buffers — layernorm gains, "
+            "biases); <=0 quantizes everything eligible")
+define_flag("comm_quantize_block", 256,
+            "quantized allreduce: elements per quantization block (one "
+            "fp32 scale per block on the wire; bigger blocks amortize "
+            "scale overhead, smaller blocks track local dynamic range)")
+define_flag("comm_portable_reshard", True,
+            "auto_parallel.reshard: route supported placement "
+            "transitions (s_to_s axis moves, r_to_s, s_to_r) through "
+            "composed all_to_all/slice/all_gather sequences that keep "
+            "peak per-device residency at O(shard); 0 restores the "
+            "legacy whole-array device_put path for every transition")
 define_flag("cost_max_guard_preds", 8,
             "cost-model lint (CM505): a speculative branch family "
             "verifying more guard predicates than this per call is "

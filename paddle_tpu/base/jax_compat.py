@@ -1,0 +1,57 @@
+"""Version shims over the moving parts of the jax API surface.
+
+The repo targets the current jax surface (``jax.shard_map``,
+``jax.sharding.get_abstract_mesh``); older jaxlibs (0.4.x) carry the same
+machinery under ``jax.experimental.shard_map`` with the pre-rename keyword
+(``check_rep``) and no abstract-mesh accessor. Every shard_map call site
+routes through :func:`shard_map` so one module owns the translation —
+collectives, pipeline schedules and the comm-efficient tier all run on
+both surfaces.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "get_abstract_mesh"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False,
+              axis_names=None):
+    """``jax.shard_map`` with a fallback onto the pre-rename
+    ``jax.experimental.shard_map.shard_map`` (where ``check_vma`` was
+    spelled ``check_rep`` and partial-manual regions were declared by the
+    complement kwarg ``auto`` instead of ``axis_names``)."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        kwargs = {} if axis_names is None else {"axis_names": frozenset(axis_names)}
+        return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kwargs = {}
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma, **kwargs)
+
+
+class _NoAbstractMesh:
+    """Stand-in for ``jax.sharding.get_abstract_mesh()`` on jax versions
+    without the accessor: reports no axes, so callers treat the context as
+    'not inside a Manual region' (the only answer the old API can give)."""
+
+    axis_names = ()
+    axis_types = ()
+
+    def __bool__(self):
+        return False
+
+
+def get_abstract_mesh():
+    """``jax.sharding.get_abstract_mesh`` or an empty-mesh stand-in when
+    the running jax predates it (nested-manual detection degrades to
+    'none', which matches the old surface's expressiveness)."""
+    fn = getattr(jax.sharding, "get_abstract_mesh", None)
+    if fn is not None:
+        return fn()
+    return _NoAbstractMesh()

@@ -83,21 +83,82 @@ def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements, *args, **kwargs
     return t
 
 
+def _reshard_route(dist_tensor: Tensor, mesh: ProcessMesh, placements):
+    """Plan the portable collective route for one eager reshard, or the
+    reason it falls back to the legacy device_put path."""
+    from ...base.flags import get_flag
+    from ..collective_opt import plan_route, ReshardRoute
+
+    if not get_flag("comm_portable_reshard"):
+        return ReshardRoute("fallback", reason="flag_off"), None
+    if isinstance(dist_tensor._value, jax.core.Tracer):
+        # inside a whole-program trace GSPMD already plans globally; the
+        # explicit sequence would pin a layout mid-program
+        return ReshardRoute("fallback", reason="traced"), None
+    src = getattr(dist_tensor, "_placements", None)
+    if src is None:
+        return ReshardRoute("fallback", reason="unknown_source"), None
+    src_mesh = getattr(dist_tensor, "_process_mesh", None)
+    if src_mesh is not None and list(getattr(src_mesh, "dim_names", ())) != \
+            list(mesh.dim_names):
+        return ReshardRoute("fallback", reason="mesh_change"), None
+    src = _normalize_placements(mesh, src)
+    route = plan_route(src, placements, mesh, dist_tensor.shape,
+                       dist_tensor._value.dtype.itemsize)
+    return route, src
+
+
 def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]) -> Tensor:
     """Transfer between placements (reference api.py:713; C++ reshard functions
-    paddle/phi/core/distributed/auto_parallel/reshard/*). All r_to_s / s_to_r /
-    p_to_r / s_to_s compositions reduce to one sharding-changing device_put —
-    XLA emits the minimal collective (slice, all_gather, psum, all_to_all)."""
+    paddle/phi/core/distributed/auto_parallel/reshard/*).
+
+    Eager transitions with a known source placement ride the *portable*
+    collective routes (``collective_opt.reshard``): s_to_s axis moves are
+    one tiled ``all_to_all`` (O(shard) peak residency instead of the
+    gather path's O(full array)), r_to_s is a comm-free local slice,
+    s_to_r one ``all_gather``. Everything else — traced values, Partial
+    sources, multi-dim transitions, indivisible shards, or
+    ``FLAGS_comm_portable_reshard=0`` — keeps the legacy sharding-changing
+    device_put, where XLA emits the movement. The route chosen (or the
+    fallback reason) ticks ``comm.reshard_route``."""
     placements = _normalize_placements(mesh, placements)
     if any(isinstance(p, Partial) for p in placements):
         raise ValueError("reshard target cannot be Partial")
     sharding = _named_sharding(mesh, placements, dist_tensor.ndim)
     from ...core.dispatch import primitive
+    from ..collective_opt import apply_route, _tick
 
-    if isinstance(dist_tensor._value, jax.core.Tracer):
-        out = primitive("reshard", lambda x: jax.lax.with_sharding_constraint(x, sharding), [dist_tensor])
+    route, src = _reshard_route(dist_tensor, mesh, placements)
+    if route.supported and route.kind != "noop":
+        from .placement_type import to_partition_spec
+
+        jmesh = mesh.to_jax_mesh()
+        src_spec = to_partition_spec(src, mesh.dim_names, dist_tensor.ndim)
+        dst_spec = to_partition_spec(placements, mesh.dim_names,
+                                     dist_tensor.ndim)
+        from ...observability.tracing import tracer
+
+        span = tracer.span("comm.reshard", track="comm", route=route.kind,
+                           axis=route.axis) if tracer.enabled else None
+        try:
+            out = primitive(
+                "reshard",
+                lambda x: apply_route(x, jmesh, route, src_spec, dst_spec),
+                [dist_tensor])
+        finally:
+            if span is not None:
+                span.end()
+        _tick("reshard_route", route=route.kind)
     else:
-        out = primitive("reshard", lambda x: jax.device_put(x, sharding), [dist_tensor])
+        # a supported no-op transition is not a fallback: label it as its
+        # own kind so the fallback-rate counter stays honest
+        label = "noop" if route.kind == "noop" \
+            else f"device_put:{route.reason or route.kind}"
+        _tick("reshard_route", route=label)
+        if isinstance(dist_tensor._value, jax.core.Tracer):
+            out = primitive("reshard", lambda x: jax.lax.with_sharding_constraint(x, sharding), [dist_tensor])
+        else:
+            out = primitive("reshard", lambda x: jax.device_put(x, sharding), [dist_tensor])
     out._placements = placements
     out._process_mesh = mesh
     out.stop_gradient = dist_tensor.stop_gradient
@@ -181,7 +242,15 @@ def shard_optimizer(optimizer, shard_fn: Optional[_ShardingStage] = None):
 
     mesh = get_mesh_from_jax(env_mod.get_mesh())
     if mesh_axis not in mesh.dim_names:
-        mesh_axis = mesh.dim_names[0]
+        from ...base.log import get_logger
+
+        fallback_axis = mesh.dim_names[0]
+        get_logger().warning(
+            "shard_optimizer: requested mesh_dim %r is not an axis of the "
+            "installed mesh %s; sharding optimizer state over %r instead — "
+            "pass one of the mesh's axes to shard where you intended",
+            mesh_axis, tuple(mesh.dim_names), fallback_axis)
+        mesh_axis = fallback_axis
 
     orig_get_acc = optimizer._get_accumulator
 

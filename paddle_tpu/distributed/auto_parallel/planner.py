@@ -320,7 +320,8 @@ def choose_plan(spec: ModelSpec, n_devices: int, batch_size: int,
 def estimate_step_cost(spec: ModelSpec, batch_size: int, plan: Plan,
                        device_tflops: float = 197.0,
                        ici_gbps: float = 100.0,
-                       cost_report=None) -> dict:
+                       cost_report=None,
+                       comm_quantize: Optional[bool] = None) -> dict:
     """Relative step-time model over a candidate plan (the reference
     Engine's cost-model pass, auto_parallel/static/cost/: compute + comm +
     bubble). Absolute numbers are nominal (bf16 peak, ICI link bw); only
@@ -330,7 +331,11 @@ def estimate_step_cost(spec: ModelSpec, batch_size: int, plan: Plan,
       ``cost_report`` (a traced step's CostReport, whose FLOPs already
       include forward + backward + optimizer at the traced batch) is
       given, in which case the measured-from-jaxpr FLOPs are preferred;
-    - dp comm: one gradient all-reduce per step, 2·(dp-1)/dp ring factor;
+    - dp comm: one gradient all-reduce per step, 2·(dp-1)/dp ring factor
+      — priced at the quantized tier's wire bytes (int8 payload + fp32
+      scale overhead, ``collective_opt.wire_report``) when
+      ``comm_quantize`` is True (default: ``FLAGS_comm_quantize_dp_grads``),
+      so plans are ranked on the bytes the sync actually moves;
     - mp comm: two activation all-reduces per layer (Megatron row+column),
       on the critical path;
     - pp bubble: (p-1)/(m+p-1) idle fraction on top of compute.
@@ -342,9 +347,26 @@ def estimate_step_cost(spec: ModelSpec, batch_size: int, plan: Plan,
     else:
         flops = 6.0 * tokens * spec.num_params
     compute_s = flops / (n * device_tflops * 1e12)
-    grad_bytes = 2.0 * spec.num_params / (plan.mp * plan.pp)
-    dp_comm_s = (2.0 * (plan.dp - 1) / max(plan.dp, 1)
-                 * grad_bytes / (ici_gbps * 1e9)) if plan.dp > 1 else 0.0
+    grad_elems = spec.num_params / (plan.mp * plan.pp)
+    grad_bytes = 2.0 * grad_elems
+    if comm_quantize is None:
+        try:
+            from ...base.flags import get_flag
+
+            comm_quantize = bool(get_flag("comm_quantize_dp_grads"))
+        except Exception:
+            comm_quantize = False
+    dp_comm_bytes = 2.0 * (plan.dp - 1) / max(plan.dp, 1) * grad_bytes \
+        if plan.dp > 1 else 0.0
+    if comm_quantize and plan.dp > 1:
+        from ..collective_opt import wire_report
+
+        # one fused-bucket model: the whole grad set syncs as one flat
+        # int8+scales payload (per-tensor min-bytes fallbacks are noise
+        # at planning granularity)
+        row = wire_report([(int(grad_elems), 2, True)], plan.dp)
+        dp_comm_bytes = row["wire_bytes"]
+    dp_comm_s = dp_comm_bytes / (ici_gbps * 1e9) if plan.dp > 1 else 0.0
     act_bytes = 2.0 * tokens / plan.dp * spec.hidden_size / plan.sep
     mp_comm_s = (2.0 * spec.num_layers * 2.0 * (plan.mp - 1) / plan.mp
                  * act_bytes / (ici_gbps * 1e9)) if plan.mp > 1 else 0.0
@@ -354,4 +376,6 @@ def estimate_step_cost(spec: ModelSpec, batch_size: int, plan: Plan,
     step_s = (compute_s + mp_comm_s) / max(1.0 - bubble, 1e-6) + dp_comm_s
     return {"step_seconds": step_s, "compute_seconds": compute_s,
             "dp_comm_seconds": dp_comm_s, "mp_comm_seconds": mp_comm_s,
+            "dp_comm_bytes": dp_comm_bytes,
+            "comm_quantized": bool(comm_quantize and plan.dp > 1),
             "pp_bubble_fraction": bubble}

@@ -1,0 +1,258 @@
+"""paddle_tpu.distributed.collective_opt — comm-efficient collectives.
+
+Two tiers over the comm hot paths (ISSUE 10; EQuARX arxiv 2506.17615,
+memory-efficient redistribution arxiv 2112.01075):
+
+- :mod:`qpsum` — the blockwise-int8 quantized allreduce: ``qpsum_lax``
+  (explicit wire path for shard_map/pmap regions), ``dp_sync_gspmd``
+  (the GSPMD sharding-constraint tier TrainStep's dp grad-sync stage
+  uses), ``qpsum_reference`` (single-device oracle) and the payload
+  accounting (``wire_report``) the bench/cost model cross-check.
+- :mod:`reshard` — portable resharding: ``plan_route`` /
+  ``apply_route`` compose placement transitions from
+  all_to_all/slice/all_gather sequences with O(shard) peak residency;
+  ``partial_to_shard`` / ``partial_to_replicate`` are the lax-tier
+  kernels for spmd-region code.
+
+This module owns the *engagement policy* — who rides the quantized tier
+(``FLAGS_comm_quantize_dp_grads``, ``amp.auto_cast(comm_dtype="int8")``,
+per-call ``all_reduce(quantized=...)``), the min-bytes / dtype gates —
+plus the ``comm.*`` telemetry counters and the per-axis wire-dtype
+record the QZ8xx lint family audits.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .qpsum import (dequantize_blockwise, dp_sync_gspmd, qpsum_lax,
+                    qpsum_reference, quantize_blockwise, tensor_wire_bytes,
+                    wire_report)
+from .reshard import (ReshardRoute, apply_route, partial_to_replicate,
+                      partial_to_shard, plan_route)
+
+__all__ = [
+    "ReshardRoute", "apply_route", "dequantize_blockwise", "dp_sync_gspmd",
+    "engaged_comm_dtype", "maybe_qpsum", "partial_to_replicate",
+    "partial_to_shard", "plan_route", "qpsum_lax", "qpsum_reference",
+    "quantize_blockwise", "quantize_decision", "stats", "axis_wire_dtypes",
+    "tensor_wire_bytes", "wire_report", "gspmd_sync_axis",
+    "reset_comm_records",
+]
+
+
+def _flag(name, default):
+    try:
+        from ...base.flags import get_flag
+
+        return get_flag(name)
+    except Exception:
+        return default
+
+
+# ------------------------------------------------------------- telemetry
+def _counter(name: str, help: str = ""):
+    from ...observability import registry
+
+    return registry.counter(name, help)
+
+
+def _tick(name: str, value: float = 1.0, **labels):
+    try:
+        _counter("comm." + name).inc(value, **labels)
+    except Exception:
+        pass
+
+
+# per-axis record of the wire dtypes engaged syncs actually used — the
+# QZ803 feed. Only *engaged, size/dtype-eligible* syncs record: a dense
+# entry next to int8 on one axis means some engaged syncs structurally
+# could not take the quantized route (multi-axis group, unresolvable
+# axis size) — mixed comm dtypes across one mesh axis.
+_axis_wire_dtypes: dict = {}
+
+
+def _note_wire_dtype(axis: str, wire_dtype: str) -> None:
+    _axis_wire_dtypes.setdefault(str(axis), set()).add(str(wire_dtype))
+
+
+def axis_wire_dtypes() -> dict:
+    return {ax: sorted(s) for ax, s in _axis_wire_dtypes.items()}
+
+
+def reset_comm_records() -> None:
+    """Clear the per-axis wire-dtype record (test isolation)."""
+    _axis_wire_dtypes.clear()
+
+
+def stats() -> dict:
+    """The ``comm.*`` view for debugging/tests: the wire-dtype record
+    (counters live in ``observability.snapshot()``)."""
+    return {"axis_wire_dtypes": axis_wire_dtypes()}
+
+
+# ------------------------------------------------------------ engagement
+def engaged_comm_dtype(explicit: Optional[bool] = None) -> Optional[str]:
+    """Resolve the comm dtype for a gradient-sync collective: explicit
+    per-call override > active AMP state's ``comm_dtype`` >
+    ``FLAGS_comm_quantize_dp_grads``. Returns ``"int8"`` or ``None``."""
+    if explicit is not None:
+        return "int8" if explicit else None
+    try:
+        from ...base import global_state
+
+        state = global_state.amp_state()
+    except Exception:
+        state = None
+    if state is not None and getattr(state, "comm_dtype", None):
+        return str(state.comm_dtype)
+    return "int8" if _flag("comm_quantize_dp_grads", False) else None
+
+
+class QuantizeDecision:
+    """Outcome of the per-collective tier choice (see
+    :func:`quantize_decision`)."""
+
+    __slots__ = ("quantize", "reason", "axis", "axis_size", "block")
+
+    def __init__(self, quantize, reason, axis="", axis_size=1, block=256):
+        self.quantize = bool(quantize)
+        self.reason = reason
+        self.axis = axis
+        self.axis_size = int(axis_size)
+        self.block = int(block)
+
+
+def quantize_decision(value, *, is_sum: bool, axes,
+                      explicit: Optional[bool] = None,
+                      axis_size: Optional[int] = None) -> QuantizeDecision:
+    """Decide whether one in-region allreduce rides the quantized tier.
+    ``value`` is the (possibly traced) local operand; ``axes`` the mesh
+    axes the collective reduces over. Callers that know the collective's
+    mesh pass ``axis_size`` (pipeline schedules do — their mesh need not
+    be the installed env mesh); otherwise it resolves from the mesh
+    *already installed* in the env (never building one as a side effect
+    mid-trace). Fallback reasons are counted (``comm.qpsum_fallback``)
+    and structural ones land in the per-axis wire-dtype record (the
+    QZ803 feed)."""
+    import jax.numpy as jnp
+
+    block = int(_flag("comm_quantize_block", 256))
+    if engaged_comm_dtype(explicit) != "int8":
+        return QuantizeDecision(False, "disengaged", block=block)
+    if not is_sum:
+        _tick("qpsum_fallback", reason="non_sum")
+        return QuantizeDecision(False, "non_sum", block=block)
+    dtype = getattr(value, "dtype", None)
+    if dtype is None or not jnp.issubdtype(dtype, jnp.floating):
+        _tick("qpsum_fallback", reason="non_float")
+        return QuantizeDecision(False, "non_float", block=block)
+    min_bytes = int(_flag("comm_quantize_min_bytes", 2048))
+    nbytes = 1
+    for d in getattr(value, "shape", ()):
+        nbytes *= int(d)
+    nbytes *= int(getattr(dtype, "itemsize", 4))
+    if 0 < min_bytes > nbytes:
+        _tick("qpsum_fallback", reason="below_min_bytes")
+        return QuantizeDecision(False, "below_min_bytes", block=block)
+    axes = tuple(axes)
+    if len(axes) != 1:
+        _tick("qpsum_fallback", reason="multi_axis")
+        for ax in axes:
+            _note_wire_dtype(ax, str(dtype))
+        return QuantizeDecision(False, "multi_axis", block=block)
+    ax = axes[0]
+    if axis_size is None:
+        try:
+            from .. import env as env_mod
+
+            mesh = env_mod.instance().mesh
+            axis_size = int(dict(mesh.shape)[ax]) if mesh is not None else None
+        except Exception:
+            axis_size = None
+    if axis_size is None:
+        _tick("qpsum_fallback", reason="axis_size_unknown")
+        _note_wire_dtype(ax, str(dtype))
+        return QuantizeDecision(False, "axis_size_unknown", axis=ax,
+                                block=block)
+    if axis_size <= 1:
+        return QuantizeDecision(False, "axis_size_1", axis=ax,
+                                axis_size=axis_size, block=block)
+    _note_wire_dtype(ax, "int8")
+    _tick("qpsum_calls")
+    row = tensor_wire_bytes(nbytes // int(getattr(dtype, "itemsize", 4)),
+                            int(getattr(dtype, "itemsize", 4)),
+                            axis_size, block)
+    _tick("qpsum_bytes_dense", row["dense_bytes"])
+    _tick("qpsum_bytes_wire", row["wire_bytes"])
+    return QuantizeDecision(True, "quantized", axis=ax,
+                            axis_size=axis_size, block=block)
+
+
+def maybe_qpsum(x, axis_name: str, axis_size: int,
+                explicit: Optional[bool] = None):
+    """Tiered dp gradient sync for explicit-collective sites (pipeline
+    schedules' ``batch_axis`` grad accumulation, spmd-region helpers):
+    qpsum when the tier engages and the tensor passes the gates, plain
+    ``lax.psum`` otherwise."""
+    from jax import lax
+
+    decision = quantize_decision(x, is_sum=True, axes=(axis_name,),
+                                 explicit=explicit, axis_size=axis_size)
+    if not decision.quantize:
+        return lax.psum(x, axis_name)
+    return qpsum_lax(x, axis_name, axis_size, decision.block)
+
+
+# ------------------------------------------------------- TrainStep facing
+def gspmd_sync_axis(axis: str = "dp") -> Optional[tuple]:
+    """(mesh, axis, size) when the GSPMD quantized dp sync should engage
+    for the current process: the tier is on, a mesh has been installed
+    (never build one as a side effect of a train step) and the dp axis
+    is real. None disengages the stage."""
+    if engaged_comm_dtype() != "int8":
+        return None
+    from .. import env as env_mod
+
+    mesh = env_mod.instance().mesh
+    if mesh is None:
+        return None
+    n = int(dict(mesh.shape).get(axis, 1))
+    if n <= 1:
+        return None
+    return mesh, axis, n
+
+
+def sync_gspmd_grads(params, mesh, axis: str, block: Optional[int] = None):
+    """Route every eligible parameter gradient through the GSPMD
+    quantized sync tier (TrainStep's dp grad-sync stage; runs inside the
+    whole-step trace, between backward and the optimizer update).
+    Returns the number of grads that took the quantized route."""
+    import jax.numpy as jnp
+
+    min_bytes = int(_flag("comm_quantize_min_bytes", 2048))
+    n = int(dict(mesh.shape).get(axis, 1))
+    synced = 0
+    for p in params:
+        g = getattr(p, "_grad", None)
+        if g is None:
+            continue
+        val = g._value
+        dtype = getattr(val, "dtype", None)
+        if dtype is None or not jnp.issubdtype(dtype, jnp.floating):
+            continue
+        nbytes = val.size * int(getattr(dtype, "itemsize", 4))
+        if 0 < min_bytes > nbytes:
+            continue
+        g._replace_value(dp_sync_gspmd(val, mesh, axis, block))
+        synced += 1
+        row = tensor_wire_bytes(int(val.size),
+                                int(getattr(dtype, "itemsize", 4)), n)
+        # the GSPMD tier quantizes the gather half only: fp32
+        # reduce-scatter + int8 all-gather
+        _tick("qpsum_bytes_dense", row["dense_bytes"])
+        _tick("qpsum_bytes_wire",
+              row["dense_bytes"] / 2.0 + row["wire_bytes"] / 2.0)
+    if synced:
+        _note_wire_dtype(axis, "int8")
+        _tick("qpsum_calls", synced)
+    return synced

@@ -144,6 +144,16 @@ def _group_size(group: Optional[Group]) -> int:
     return g.nranks
 
 
+def _axes_nranks(axes) -> int:
+    """Rank count across a set of mesh axes (the region-restricted group
+    size a scatter chunk divides over)."""
+    mesh = env_mod.get_mesh()
+    n = 1
+    for ax in axes:
+        n *= int(mesh.shape[ax])
+    return n
+
+
 # --------------------------------------------------------------------- helpers
 def _eager_world() -> int:
     return jax.process_count()
@@ -154,12 +164,29 @@ def _identity_inplace(tensor: Tensor) -> Tensor:
 
 
 # --------------------------------------------------------------------- ops
-def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
-    """In-place allreduce (reference communication/all_reduce.py:29)."""
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True,
+               quantized=None):
+    """In-place allreduce (reference communication/all_reduce.py:29).
+
+    ``quantized`` opts one SUM allreduce in/out of the blockwise-int8
+    qpsum tier (collective_opt) regardless of the process-wide
+    engagement (``FLAGS_comm_quantize_dp_grads`` /
+    ``amp.auto_cast(comm_dtype="int8")``); ``None`` defers to that
+    policy. Non-SUM ops, non-float dtypes and tensors below
+    ``FLAGS_comm_quantize_min_bytes`` always ride full precision.
+    """
     if in_spmd_region():
         axes = _axes_of(group)
+        from . import collective_opt as _copt
+
+        decision = _copt.quantize_decision(
+            tensor._value, is_sum=(op == ReduceOp.SUM), axes=axes,
+            explicit=quantized)
 
         def fn(x):
+            if decision.quantize:
+                return _copt.qpsum_lax(x, decision.axis, decision.axis_size,
+                                       decision.block)
             if op == ReduceOp.SUM:
                 return lax.psum(x, axes)
             if op == ReduceOp.MAX:
@@ -267,9 +294,34 @@ def reduce_scatter(tensor: Tensor, tensor_or_list, op=ReduceOp.SUM, group: Optio
         src = manipulation.concat(list(src), 0)
     if in_spmd_region():
         axes = _axes_of(group)
-        if op != ReduceOp.SUM:
-            raise NotImplementedError("reduce_scatter supports SUM on XLA")
-        out = primitive("reduce_scatter", lambda x: lax.psum_scatter(x, axes, scatter_dimension=0, tiled=True), [src])
+        if op == ReduceOp.SUM:
+            fn = lambda x: lax.psum_scatter(x, axes, scatter_dimension=0, tiled=True)  # noqa: E731
+        elif op in (ReduceOp.MAX, ReduceOp.MIN):
+            # XLA has no fused max/min reduce-scatter: pmax/pmin the full
+            # buffer, then each rank keeps its dim-0 chunk (one extra pass
+            # of residency, same comm volume as an all-reduce)
+            red = lax.pmax if op == ReduceOp.MAX else lax.pmin
+
+            def fn(x):
+                full = red(x, axes)
+                n = _axes_nranks(axes)
+                if full.shape[0] % n != 0:
+                    # same loud contract as the SUM path (tiled
+                    # psum_scatter): never silently drop trailing rows
+                    raise ValueError(
+                        f"reduce_scatter: scatter dimension size "
+                        f"{full.shape[0]} must be divisible by the group's "
+                        f"{n} ranks")
+                chunk = full.shape[0] // n
+                idx = _linear_axis_index(axes)
+                return lax.dynamic_slice_in_dim(full, idx * chunk, chunk, axis=0)
+        else:
+            name = {ReduceOp.PROD: "PROD", ReduceOp.AVG: "AVG"}.get(op, repr(op))
+            raise NotImplementedError(
+                f"reduce_scatter(op=ReduceOp.{name}) is not supported on "
+                "XLA; supported reductions: SUM (lax.psum_scatter), MAX and "
+                "MIN (lax.pmax/pmin + local slice)")
+        out = primitive("reduce_scatter", fn, [src])
         tensor._replace_value(out._value)
         tensor.stop_gradient = out.stop_gradient
         tensor._grad_node = out._grad_node

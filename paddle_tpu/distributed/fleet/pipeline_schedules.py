@@ -42,10 +42,23 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ...base import jax_compat
 from ...core.dispatch import primitive
 from ...core.tensor import Tensor
 from ...nn.layer.layers import Layer
 from .. import env as env_mod
+
+
+def _dp_grad_sync(grads, batch_axis: str, mesh):
+    """dp gradient sync for the pipelined schedules' accumulated weight
+    grads: each leaf rides the blockwise-int8 qpsum tier when the
+    quantized-comm policy engages (FLAGS_comm_quantize_dp_grads /
+    amp comm_dtype, size+dtype gates in collective_opt), plain psum
+    otherwise."""
+    from ..collective_opt import maybe_qpsum
+
+    n = int(dict(mesh.shape).get(batch_axis, 1))
+    return [maybe_qpsum(g, batch_axis, n) for g in grads]
 
 
 def chunk_permutation(num_layers: int, num_stages: int, num_chunks: int) -> List[int]:
@@ -265,7 +278,7 @@ def pipeline_spmd(
         # parallel layers inside the pipelined template keep their sharding
         # semantics — pp×mp composes in one program
         manual = {axis} | ({batch_axis} if batch_axis else set())
-        shmap = jax.shard_map(
+        shmap = jax_compat.shard_map(
             shard_body,
             mesh=mesh,
             in_specs=(x_spec,) + rng_specs + leaf_specs,
@@ -421,7 +434,7 @@ def _pipeline_1f1b(apply_layer, stacked_leaves, x, *, p, m, mesh, axis,
                 tick, (fbuf0, fcur0, bcur0, gacc0, dx0), jnp.arange(T2))
             dxout = jax.lax.psum(dxout, axis)  # only stage 0 wrote real rows
             if batch_axis:
-                gacc = [jax.lax.psum(ga, batch_axis) for ga in gacc]
+                gacc = _dp_grad_sync(gacc, batch_axis, mesh)
             return (dxout, *gacc)
 
         def bwd_body_zb(g, x_mb, rng, *leaves):
@@ -558,18 +571,18 @@ def _pipeline_1f1b(apply_layer, stacked_leaves, x, *, p, m, mesh, axis,
                 dw_tick, (fbuf, gacc, wq_ct), jnp.arange(p - 1))
             dxout = jax.lax.psum(dxout, axis)  # only stage 0 wrote real rows
             if batch_axis:
-                gacc = [jax.lax.psum(ga, batch_axis) for ga in gacc]
+                gacc = _dp_grad_sync(gacc, batch_axis, mesh)
             return (dxout, *gacc)
 
         if variant == "zb":
             bwd_body = bwd_body_zb
 
         manual = {axis} | ({batch_axis} if batch_axis else set())
-        fwd_shmap = jax.shard_map(
+        fwd_shmap = jax_compat.shard_map(
             fwd_body, mesh=mesh,
             in_specs=(x_spec, P()) + leaf_specs, out_specs=x_spec,
             axis_names=frozenset(manual), check_vma=False)
-        bwd_shmap = jax.shard_map(
+        bwd_shmap = jax_compat.shard_map(
             bwd_body, mesh=mesh,
             in_specs=(x_spec, x_spec, P()) + leaf_specs,
             out_specs=(x_spec,) + leaf_specs,
@@ -757,15 +770,15 @@ def _pipeline_vpp_1f1b(apply_layer, stacked_leaves, x, *, p, v, m, mesh,
             dxout = jax.lax.psum(dxout, axis)  # only chunk 0's device wrote
             gout = [ga.reshape((v * k,) + ga.shape[2:]) for ga in gacc]
             if batch_axis:
-                gout = [jax.lax.psum(gv, batch_axis) for gv in gout]
+                gout = _dp_grad_sync(gout, batch_axis, mesh)
             return (dxout, *gout)
 
         manual = {axis} | ({batch_axis} if batch_axis else set())
-        fwd_shmap = jax.shard_map(
+        fwd_shmap = jax_compat.shard_map(
             fwd_body, mesh=mesh,
             in_specs=(x_spec, P()) + leaf_specs, out_specs=x_spec,
             axis_names=frozenset(manual), check_vma=False)
-        bwd_shmap = jax.shard_map(
+        bwd_shmap = jax_compat.shard_map(
             bwd_body, mesh=mesh,
             in_specs=(x_spec, x_spec, P()) + leaf_specs,
             out_specs=(x_spec,) + leaf_specs,

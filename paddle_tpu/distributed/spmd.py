@@ -19,6 +19,7 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import PartitionSpec as P
 
+from ..base.jax_compat import shard_map as _shard_map
 from . import env as env_mod
 
 _tls = threading.local()
@@ -81,9 +82,21 @@ def spmd(fn=None, *, mesh=None, in_specs=None, out_specs=None, axes=None, check_
                 return tuple(o._value if isinstance(o, Tensor) else o for o in out)
             return out._value if isinstance(out, Tensor) else out
 
-        smapped = jax.shard_map(body, mesh=m, in_specs=ispecs, out_specs=ospecs, check_vma=check_vma)
+        smapped = _shard_map(body, mesh=m, in_specs=ispecs, out_specs=ospecs, check_vma=check_vma)
+
         # route through the dispatcher so the eager tape links across the
-        # shard_map boundary (jax.vjp differentiates through shard_map)
-        return primitive("spmd_region", smapped, list(args))
+        # shard_map boundary (jax.vjp differentiates through shard_map).
+        # The engaged comm wire dtype rides along as a static attr: the
+        # kernel cache keys on it, so flipping FLAGS_comm_quantize_dp_grads
+        # (or an amp comm_dtype region) retraces the region instead of
+        # replaying the other tier's cached executable
+        from .collective_opt import engaged_comm_dtype
+
+        def call(*vals, comm_dtype="fp32"):
+            del comm_dtype  # cache-key material only; the body reads policy
+            return smapped(*vals)
+
+        return primitive("spmd_region", call, list(args),
+                         attrs={"comm_dtype": engaged_comm_dtype() or "fp32"})
 
     return wrapped

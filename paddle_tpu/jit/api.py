@@ -81,6 +81,7 @@ class TrainStep:
         def step_fn(*batch):
             loss = self.loss_fn(*batch)
             loss.backward()
+            self._sync_dp_grads()
             # read the LR through the dispatcher so the functionalizer records
             # the cell (traced input, not baked constant)
             lr_traced = (self._lr_cell + 0.0)._value
@@ -93,9 +94,13 @@ class TrainStep:
             self.optimizer.clear_grad()
             return loss
 
-        static_key = None
-        if model is not None:
-            static_key = lambda: ("train" if model.training else "eval")  # noqa: E731
+        # the quantized dp-sync engagement is part of the program's shape:
+        # flipping FLAGS_comm_quantize_dp_grads (or entering an
+        # amp.auto_cast(comm_dtype=...) region) must recompile, not silently
+        # serve the other tier's cached program
+        base_key = (lambda: ("train" if model.training else "eval")) \
+            if model is not None else (lambda: "fn")
+        static_key = lambda: (base_key(), self._dp_sync_key())  # noqa: E731
         if bucket_axes:
             # dynamic-shape policy: pad variable dims to the log2 bucket
             # ladder so distinct lengths share ≤ log2(max/min)+1 programs
@@ -108,6 +113,30 @@ class TrainStep:
                 name="train_step")
         else:
             self._compiled = CompiledFunction(step_fn, static_key_fn=static_key, name="train_step")
+
+    def _dp_sync_key(self):
+        """Static cache-key component for the quantized dp grad-sync tier
+        (axis + size when engaged, 'fp32' otherwise)."""
+        from ..distributed import collective_opt as copt
+
+        spec = copt.gspmd_sync_axis()
+        return "fp32" if spec is None else ("int8", spec[1], spec[2])
+
+    def _sync_dp_grads(self):
+        """The dp gradient-sync stage (between backward and the optimizer
+        update): when the quantized tier engages
+        (FLAGS_comm_quantize_dp_grads / amp comm_dtype) and an installed
+        mesh has dp > 1, every eligible parameter grad reduce-scatters in
+        fp32 and gathers back as int8 blocks + scales
+        (collective_opt.dp_sync_gspmd). Off = zero work."""
+        from ..distributed import collective_opt as copt
+
+        spec = copt.gspmd_sync_axis()
+        if spec is None:
+            return
+        mesh, axis, _n = spec
+        params = getattr(self.optimizer, "_parameter_list", None) or []
+        copt.sync_gspmd_grads(params, mesh, axis)
 
     def __call__(self, *batch):
         # refresh the LR cell from the schedule before entering the program

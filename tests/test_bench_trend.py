@@ -19,7 +19,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _write_run(dirpath, n, value=None, rc=0, note="cpu_fallback",
                metric=DEFAULT_METRIC, parsed_override="unset",
-               coldstart=None):
+               coldstart=None, comm=None):
     payload = {"n": n, "cmd": "bench", "rc": rc, "tail": ""}
     if parsed_override != "unset":
         payload["parsed"] = parsed_override
@@ -28,6 +28,8 @@ def _write_run(dirpath, n, value=None, rc=0, note="cpu_fallback",
                              "unit": "tokens/sec", "note": note}
         if coldstart is not None:
             payload["parsed"]["coldstart"] = coldstart
+        if comm is not None:
+            payload["parsed"]["comm"] = comm
     else:
         payload["parsed"] = None
     path = os.path.join(dirpath, f"BENCH_r{n:02d}.json")
@@ -174,3 +176,37 @@ class TestColdstartTrack:
         assert rc == 0, payload
         extra = payload["extras"][self.PATH]
         assert extra["verdict"]["ok"] is True
+
+
+class TestCommTrack:
+    """ISSUE 10 satellite: the quantized dp-sync payload-saving ratio
+    (bench extras.comm) rides the same extras trajectory — tracked per
+    run, judged only once two rounds carry it."""
+
+    PATH = "comm.allreduce_bytes_saved_ratio"
+
+    def test_comm_ratio_is_a_default_extra(self):
+        assert self.PATH in DEFAULT_EXTRAS
+
+    def test_tracks_and_gates_like_the_headline(self, tmp_path):
+        _write_run(str(tmp_path), 1, 20000.0,
+                   comm={"allreduce_bytes_saved_ratio": 3.8})
+        _write_run(str(tmp_path), 2, 20000.0,
+                   comm={"allreduce_bytes_saved_ratio": 3.9})
+        rows = load_trajectory(str(tmp_path), extract=self.PATH)
+        assert [r["value"] for r in rows] == [3.8, 3.9]
+        assert main(["--dir", str(tmp_path)]) == 0
+        # a collapse of the saving (quantization silently off) gates
+        _write_run(str(tmp_path), 3, 20000.0,
+                   comm={"allreduce_bytes_saved_ratio": 1.0})
+        assert main(["--dir", str(tmp_path)]) == 1
+
+    def test_repo_history_tolerates_absent_comm(self, tmp_path):
+        """Pre-ISSUE-10 rounds carry no extras.comm: absent rows, no
+        gate until two rounds carry the ratio."""
+        _write_run(str(tmp_path), 1, 20000.0)
+        _write_run(str(tmp_path), 2, 20000.0,
+                   comm={"allreduce_bytes_saved_ratio": 3.8})
+        verdict = judge(load_trajectory(str(tmp_path), extract=self.PATH),
+                        0.20)
+        assert verdict["ok"] is True and "single parsed" in verdict["reason"]

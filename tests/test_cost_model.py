@@ -200,8 +200,11 @@ class TestWalker:
         assert rep_unseeded.comm_bytes == {"dp": 2.0 * buf}
 
     def test_collective_one_pass_family_ring_factor(self):
-        """all_gather moves (n-1)/n per ring step, not a flat 1x, once
-        the axis size is known."""
+        """all_gather's wire traffic scales with the gathered RESULT
+        (n× its operand): (n-1)/n × result bytes per device once the
+        axis size is known — each device receives n-1 remote shards and
+        forwards its own n-1 times (ISSUE 10: the operand-only base
+        undercounted the gather family by the axis size)."""
         import jax
         import jax.numpy as jnp
 
@@ -212,8 +215,10 @@ class TestWalker:
             jnp.ones((8, 8), jnp.float32))
         buf = 8 * 8 * 4
         rep = cost_jaxpr(closed, axis_sizes={"dp": 4})
-        assert rep.comm_bytes == {"dp": pytest.approx(3 / 4 * buf)}
-        assert cost_jaxpr(closed).comm_bytes == {"dp": 1.0 * buf}
+        assert rep.comm_bytes == {"dp": pytest.approx(3 / 4 * 4 * buf)}
+        # unresolved axis: the 1x static factor still applies to the
+        # moved-bytes base (the gathered result)
+        assert cost_jaxpr(closed).comm_bytes == {"dp": 1.0 * 4 * buf}
 
     def test_dynamic_flops_delegates_to_cost_model(self):
         """The layer-hook front end and the cost model share one set of

@@ -1,6 +1,6 @@
 """CI gate: the repo itself passes its own static analysis.
 
-Runs all nine ``paddle_tpu.analysis`` analyzer families over the live
+Runs all ten ``paddle_tpu.analysis`` analyzer families over the live
 codebase and asserts ZERO error-severity findings, so a regression (a new
 jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug,
 a host callback in a compiled step, a typo'd mesh axis, a cost-model
@@ -159,6 +159,20 @@ def test_cache_audit_green_on_demo_store(tmp_path):
     assert cache_cli.main(["verify", "--dir", store_dir]) == 0
 
 
+def test_comm_audit_green_on_demo_session():
+    """ISSUE 10: the comm-efficient collective tier's contract holds —
+    the quantized allreduce passes its accuracy gate against the exact
+    fp32 sum, the wire path is bitwise deterministic / replica-identical
+    / oracle-matching (this CI forces 8 CPU devices, so the shard_map
+    wire path really runs), the portable reshard tier plans all_to_all
+    for s_to_s, and no mesh axis mixed gradient-sync wire dtypes."""
+    from paddle_tpu.analysis.comm_check import audit_comm, record_demo_comm
+
+    report = record_demo_comm()
+    assert report["wire_checked"], report  # 8-device CI must gate the wire
+    assert [str(f) for f in audit_comm(report)] == []
+
+
 def test_cli_exits_zero_with_machine_readable_findings(capsys):
     """`tools.lint --json --include-tests` over the repo: exit 0,
     parseable. Run in-process (the tests above already paid the analyzer
@@ -174,7 +188,7 @@ def test_cli_exits_zero_with_machine_readable_findings(capsys):
     assert payload["crashed"] == []
     assert set(payload["analyzers"]) == {"trace", "registry", "program",
                                          "jaxpr", "spmd", "cost", "serving",
-                                         "telemetry", "cache"}
+                                         "telemetry", "cache", "comm"}
     assert isinstance(payload["findings"], list)
     # per-family wall-time (CI satellite): one entry per analyzer run
     assert set(payload["timings_s"]) == set(payload["analyzers"])

@@ -37,8 +37,11 @@ from typing import List, Optional
 DEFAULT_METRIC = "gpt_tiny_train_tokens_per_sec_cpu"
 # extra dotted paths into the parsed payload tracked alongside the
 # headline — the persistent compile cache's cold-vs-warm start ratio
-# (bench extras.coldstart, ISSUE 9)
-DEFAULT_EXTRAS = ("coldstart.train_warm_speedup_x",)
+# (bench extras.coldstart, ISSUE 9) and the quantized dp-sync payload
+# saving over the fp32 ring (bench extras.comm, ISSUE 10); each gates
+# only once two rounds carry it
+DEFAULT_EXTRAS = ("coldstart.train_warm_speedup_x",
+                  "comm.allreduce_bytes_saved_ratio")
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
 

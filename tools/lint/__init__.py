@@ -1,6 +1,6 @@
 """``python -m tools.lint`` — the repo's static-analysis driver.
 
-Runs the nine ``paddle_tpu.analysis`` analyzers and reports findings:
+Runs the ten ``paddle_tpu.analysis`` analyzers and reports findings:
 
 - **trace**:    the trace-safety AST linter over ``paddle_tpu/`` (or the
                 paths given on the command line),
@@ -35,7 +35,13 @@ Runs the nine ``paddle_tpu.analysis`` analyzers and reports findings:
                 (CC7xx) over a freshly recorded demo store (publish two
                 AOT executables → audit: every entry fingerprinted,
                 store within its byte budget, one fingerprint per dir,
-                no corrupt/orphan files).
+                no corrupt/orphan files),
+- **comm**:     the comm-efficient collective tier's contract (QZ8xx)
+                over a fresh demo sync session: quantized-allreduce
+                accuracy vs the exact fp32 sum, bitwise determinism /
+                replica identity of the wire path, the portable reshard
+                route engaging for s_to_s, and no mesh axis mixing
+                gradient-sync wire dtypes.
 
 Exit-code contract (stable, CI-gateable):
   0 = no error-severity findings (warnings never gate)
@@ -58,7 +64,7 @@ import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd", "cost",
-              "serving", "telemetry", "cache")
+              "serving", "telemetry", "cache", "comm")
 
 
 def _source_paths(paths, include_tests=False):
@@ -231,17 +237,28 @@ def _run_cache(_paths, include_tests=False):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _run_comm(_paths, include_tests=False):
+    """Record the representative quantized-sync session (accuracy +
+    determinism gates over the qpsum oracle and, multi-device, the
+    shard_map wire path) and audit the comm tier's contract (QZ8xx,
+    analysis/comm_check.py) plus the live per-axis wire-dtype record."""
+    from paddle_tpu.analysis.comm_check import audit_comm
+
+    return audit_comm()
+
+
 _RUNNERS = {"trace": _run_trace, "registry": _run_registry,
             "program": _run_program, "jaxpr": _run_jaxpr,
             "spmd": _run_spmd, "cost": _run_cost,
             "serving": _run_serving, "telemetry": _run_telemetry,
-            "cache": _run_cache}
+            "cache": _run_cache, "comm": _run_comm}
 
 # analyzer -> its finding-code family prefix, so a crash finding
 # (<PREFIX>999) stays visible under --select filters for that family
 _FAMILY_PREFIX = {"trace": "TS", "registry": "RC", "program": "PV",
                   "jaxpr": "JX", "spmd": "SP", "cost": "CM",
-                  "serving": "JX", "telemetry": "OB", "cache": "CC"}
+                  "serving": "JX", "telemetry": "OB", "cache": "CC",
+                  "comm": "QZ"}
 
 
 def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
