@@ -142,6 +142,10 @@ def bench_gpt(on_tpu):
         extras["comm"] = _comm_bench()
     except Exception as e:
         extras["comm"] = {"error": str(e).split("\n")[0][:200]}
+    try:
+        extras["zero1"] = _zero1_bench()
+    except Exception as e:
+        extras["zero1"] = {"error": str(e).split("\n")[0][:200]}
     return f"{name}_train_tokens_per_sec", tok_s, "tokens/sec", extras
 
 
@@ -1162,6 +1166,158 @@ def _comm_worker():
     print(json.dumps({"comm": out}), flush=True)
 
 
+def _zero1_bench(timeout=110):
+    """ZeRO-1 sharded optimizer states + weight update (ISSUE 12
+    tentpole): measured in a dedicated 8-device CPU subprocess (same
+    harness trick as extras.comm). Records the per-replica
+    optimizer-state bytes replicated vs zero1-sharded (the
+    ``opt_state_bytes_ratio`` headline bench_trend tracks), the per-
+    tensor padding gate, step wall both tiers, the gpt_tiny convergence
+    gate vs the unsharded fp32 run (≤1e-4, bitwise-deterministic rerun),
+    and the cost-model's predicted reduce-scatter/all-gather wire bytes
+    vs the accounting (≤1.3x). A timeout degrades to an error row."""
+    if os.environ.get("BENCH_SKIP_CONTROL") == "1":
+        return {"skipped": "budget"}
+    env = dict(os.environ)
+    env["BENCH_ZERO1"] = "1"
+    env.pop("BENCH_WORKER", None)
+    env.pop("BENCH_PROBE", None)
+    env.pop("BENCH_COMM", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":")
+        if p and ".axon_site" not in p)
+    parsed, rc, err = _spawn(env, timeout=timeout, want="zero1")
+    if parsed is None:
+        return {"error": f"zero1 worker rc={rc} "
+                         f"stderr_tail={err.strip()[-200:]!r}"}
+    return parsed["zero1"]
+
+
+def _zero1_worker():
+    """Runs in the 8-CPU-device subprocess: print {"zero1": {...}}."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.base.jax_compat import shard_map
+    from paddle_tpu.distributed.parallel import replicate_layer, shard_batch
+    from paddle_tpu.distributed.sharding import (opt_state_report,
+                                                 zero1_wire_report)
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import (GPTForCausalLM, GPTPretrainingCriterion,
+                                   gpt_tiny)
+
+    out = {"platform": jax.devices()[0].platform,
+           "n_devices": len(jax.devices())}
+    dist.init_parallel_env()
+    jmesh = dist.env.get_mesh()
+    dp = int(dict(jmesh.shape)["dp"])
+    out["dp"] = dp
+    cfg = gpt_tiny()
+    batch, seq, steps = 8, 32, 4
+    rs = np.random.RandomState(0)
+    batches = [rs.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+               for _ in range(steps)]
+
+    def train(stage):
+        paddle.set_flags({"sharding_stage": stage})
+        try:
+            paddle.seed(0)
+            model = GPTForCausalLM(cfg)
+            crit = GPTPretrainingCriterion(cfg)
+            replicate_layer(model, jmesh)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+            step = TrainStep(model=model, optimizer=opt,
+                             loss_fn=lambda ids: crit(model(ids), ids))
+            losses, walls = [], []
+            for b in batches:
+                ids = paddle.Tensor(b, stop_gradient=True)
+                shard_batch(ids, jmesh)
+                t0 = time.perf_counter()
+                losses.append(_sync(step(ids)))
+                walls.append(time.perf_counter() - t0)
+            return losses, opt, min(walls[1:])
+        finally:
+            paddle.set_flags({"sharding_stage": ""})
+
+    # --- convergence gate: unsharded fp32 vs zero1, + bitwise rerun -----
+    fp32, opt_rep, wall_rep = train("")
+    z1a, opt_z1, wall_z1 = train("zero1")
+    z1b, _, _ = train("zero1")
+    max_delta = max(abs(a - b) / max(abs(a), 1e-9)
+                    for a, b in zip(fp32, z1a))
+    out["convergence"] = {
+        "steps": steps,
+        "loss_fp32": [round(v, 6) for v in fp32],
+        "loss_zero1": [round(v, 6) for v in z1a],
+        "max_rel_delta": float(f"{max_delta:.2e}"),
+        "gate": "green" if max_delta <= 1e-4 else "red",
+        "bitwise_deterministic": z1a == z1b,
+    }
+    out["step_wall_us_replicated"] = round(wall_rep * 1e6, 1)
+    out["step_wall_us_zero1"] = round(wall_z1 * 1e6, 1)
+
+    # --- optimizer-state residency: replicated vs sharded ---------------
+    rep = opt_state_report(opt_rep)
+    sh = opt_state_report(opt_z1)
+    out["opt_state_bytes_replicated"] = rep["per_replica_bytes"]
+    out["opt_state_bytes_zero1"] = sh["per_replica_bytes"]
+    out["opt_state_bytes_ratio"] = round(
+        rep["per_replica_bytes"] / max(sh["per_replica_bytes"], 1), 3)
+    # acceptance: every sharded tensor holds ≤ 1/dp·replicated + one
+    # padded shard block per replica (at the block size the plan uses)
+    block_bytes = max(int(paddle.get_flags("comm_quantize_block")
+                          ["comm_quantize_block"]), 8) * 4
+    out["per_tensor_gate"] = "green" if all(
+        r["per_replica_bytes"] <= r["logical_bytes"] / dp + block_bytes
+        for r in sh["rows"] if r["sharded"]) else "red"
+    out["n_sharded_tensors"] = sum(1 for r in sh["rows"] if r["sharded"])
+
+    # --- cost model vs the rs/ag pair's wire accounting -----------------
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.analysis.cost_model import cost_jaxpr
+
+    numel = cfg.vocab_size * cfg.hidden_size
+
+    def rs_ag(x):
+        shard = jax.lax.psum_scatter(x, "dp", scatter_dimension=0,
+                                     tiled=True)
+        return jax.lax.all_gather(shard - 0.001 * shard, "dp", axis=0,
+                                  tiled=True)
+
+    f = shard_map(rs_ag, mesh=jmesh, in_specs=P(), out_specs=P(),
+                  check_vma=False)
+    closed = jax.make_jaxpr(f)(jnp.ones((numel,), jnp.float32))
+    predicted = cost_jaxpr(closed).comm_bytes.get("dp", 0.0)
+    measured = zero1_wire_report([("g", numel, 4)], dp)["wire_bytes"]
+    out["cost_model_pred_bytes"] = predicted
+    out["cost_model_vs_measured"] = round(predicted / max(measured, 1), 3)
+
+    # planner pricing of the same pair (what DistEngine.prepare ranks on)
+    from paddle_tpu.distributed.auto_parallel.planner import (
+        ModelSpec, Plan, estimate_step_cost)
+
+    mspec = ModelSpec(num_params=numel, num_layers=cfg.num_hidden_layers,
+                      hidden_size=cfg.hidden_size,
+                      vocab_size=cfg.vocab_size, seq_len=seq)
+    z_cost = estimate_step_cost(mspec, batch, Plan(dp=dp, mp=1, pp=1,
+                                                   sharding=dp))
+    out["planner_dp_comm_bytes"] = z_cost["dp_comm_bytes"]
+    # same accounting at the planner's bf16 grad convention (itemsize 2)
+    planner_expected = zero1_wire_report([("g", numel, 2)], dp)["wire_bytes"]
+    out["planner_vs_accounting"] = round(
+        z_cost["dp_comm_bytes"] / max(planner_expected, 1), 3)
+    print(json.dumps({"zero1": out}), flush=True)
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache beside this file: the expensive
     gpt2-small train-step compile happens once per toolchain; later bench
@@ -1381,6 +1537,9 @@ if __name__ == "__main__":
     elif os.environ.get("BENCH_COMM") == "1":
         sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
         _comm_worker()
+    elif os.environ.get("BENCH_ZERO1") == "1":
+        sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+        _zero1_worker()
     elif os.environ.get("BENCH_WORKER") == "1":
         if os.environ.get("JAX_PLATFORMS") == "cpu":
             sys.path[:] = [p for p in sys.path if ".axon_site" not in p]

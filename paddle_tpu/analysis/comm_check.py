@@ -31,6 +31,21 @@ QZ803  mixed comm dtypes      one mesh axis carried both int8 and dense
                               quantized traffic): the axis pays both
                               tiers' costs and the bandwidth win is
                               partial (warning)
+QZ804  zero1 parity break     the zero1 sharded weight update (reduce-
+                              scatter → shard-space optimizer update →
+                              all-gather) diverges from the single-
+                              device replicated oracle beyond its
+                              tier's gate (fp32 gather: ~ulp; int8
+                              gather: the quantization gate) — a
+                              sharded update that drifts from the
+                              replicated rule corrupts training
+                              silently (error)
+QZ805  shard-padding waste    a zero1 shard-plan row breaks the padding
+                              invariant: a sharded tensor carries a full
+                              block (or more) of padding per shard, or
+                              was sharded with no per-replica byte win —
+                              the plan *grows* optimizer state instead
+                              of shrinking it (warning)
 
 Driven by the ``comm`` analyzer of ``python -m tools.lint`` and the
 tier-1 zero-findings gate (``tests/test_lint_clean.py``).
@@ -109,7 +124,57 @@ def record_demo_comm() -> dict:
         get_flag("comm_portable_reshard"))
     report["s_to_s_route"] = route.kind
     report["axis_wire_dtypes"] = copt.axis_wire_dtypes()
+    _record_zero1(report, rs, devs)
     return report
+
+
+def _record_zero1(report: dict, rs, devs) -> None:
+    """The zero1 sharded-update section of the demo report (QZ804/QZ805
+    feed): the REAL strategy path (pad → reduce-scatter constraint →
+    shard-space ``_apply_one`` → all-gather) run against a replicated
+    single-device oracle on a demo mesh, plus the shard plan whose
+    padding invariant QZ805 audits. Hermetic: a throwaway optimizer, a
+    demo mesh built directly from the device list — no env/flag
+    mutation. Single-device processes fall back to the replicated rule
+    (axis size 1), so only the plan is gated there."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from ..core.tensor import Parameter, Tensor
+    from ..distributed import collective_opt as copt
+    from ..distributed.sharding import zero1
+    from ..optimizer.optimizers import AdamW
+
+    w0 = (rs.randn(37, 21) * 0.5).astype(np.float32)
+    gs = (rs.randn(3, 37, 21) * 0.2).astype(np.float32)
+
+    def run(spec):
+        p = Parameter(w0.copy(), name="zero1_demo_w")
+        opt = AdamW(learning_rate=1e-2, parameters=[p], weight_decay=0.01)
+        st = zero1.Zero1Strategy(opt)
+        for g0 in gs:
+            g = Tensor(g0.copy(), stop_gradient=True)
+            opt._step_tensor._replace_value(opt._step_tensor._value + 1)
+            if spec is None:
+                opt._apply_one(p, g, 1e-2, None)
+            else:
+                st.apply_one(opt, p, g, 1e-2, None, spec)
+        return np.asarray(jnp.asarray(p._value))
+
+    ref = run(None)
+    report["zero1_gather_dtype"] = copt.engaged_comm_dtype() or "fp32"
+    report["zero1_wire_checked"] = False
+    if len(devs) >= 2:
+        n = min(len(devs), 4)
+        mesh = Mesh(np.array(devs[:n]).reshape(n), ("dp",))
+        got = run((mesh, "dp", n))
+        report["zero1_wire_checked"] = True
+        report["zero1_parity_max_err"] = float(
+            np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-9))
+    report["zero1_plan"] = [r.to_dict() for r in zero1.plan_shards(
+        [("w", 37 * 21, 4), ("bias", 7, 4), ("emb", 50000, 4)], 4)]
 
 
 def audit_comm(report: Optional[dict] = None) -> List[Finding]:
@@ -169,4 +234,47 @@ def audit_comm(report: Optional[dict] = None) -> List[Finding]:
                 "back to dense transport next to quantized traffic "
                 "(multi-axis group or unresolvable axis size) — the axis "
                 "pays both tiers and the bandwidth win is partial", "qpsum"))
+
+    # QZ804: zero1 sharded-update parity vs the replicated oracle. The
+    # fp32 gather tier must track the oracle to reduction-order ulps;
+    # the int8 gather tier inherits the quantization gate.
+    if report.get("zero1_wire_checked"):
+        err = report.get("zero1_parity_max_err")
+        gate = (ACCURACY_GATE
+                if report.get("zero1_gather_dtype") == "int8" else 1e-5)
+        if err is None or err > gate:
+            findings.append(Finding(
+                _ANALYZER, "QZ804", "error",
+                f"zero1 sharded weight update diverges from the replicated "
+                f"single-device oracle (max rel err "
+                f"{'unmeasured' if err is None else f'{err:.2e}'} > "
+                f"{gate:g} gate, gather tier "
+                f"{report.get('zero1_gather_dtype')}) — the reduce-scatter/"
+                "shard-update/all-gather pipeline drifted from the "
+                "optimizer's replicated rule; sharded training corrupts "
+                "silently", "zero1"))
+
+    # QZ805: the shard plan's padding invariant — every sharded tensor
+    # must shrink per-replica bytes and carry less than one block of
+    # padding per shard.
+    for row in report.get("zero1_plan") or []:
+        name = row.get("name", "?")
+        if not row.get("sharded"):
+            continue
+        if row.get("shard_elems", 0) >= row.get("numel", 0):
+            findings.append(Finding(
+                _ANALYZER, "QZ805", "warning",
+                f"zero1 shard plan row '{name}' is sharded with no "
+                f"per-replica byte win (shard {row.get('shard_elems')} ≥ "
+                f"numel {row.get('numel')}) — block padding grew the "
+                "optimizer state this tensor was supposed to shrink; it "
+                "belongs on the replicated update path", "zero1"))
+        elif row.get("pad_per_shard", 0) >= row.get("block", 256):
+            findings.append(Finding(
+                _ANALYZER, "QZ805", "warning",
+                f"zero1 shard plan row '{name}' carries "
+                f"{row.get('pad_per_shard'):.0f} padding elements per "
+                f"shard (≥ one {row.get('block')}-element block) — the "
+                "plan wastes a full block of optimizer-state bytes per "
+                "replica on this tensor", "zero1"))
     return findings

@@ -27,7 +27,17 @@ aggregate:
   The running live-set maximum estimates the HBM high-water mark the way
   XLA's ``memory_analysis`` reports ``argument + temp`` — the planner's
   calibration target (scalar broadcasts/iota are treated as fused, not
-  materialized, matching XLA's fusion behavior).
+  materialized, matching XLA's fusion behavior). The walk is
+  **sharding-aware**: ``sharding_constraint`` equations record the
+  per-device residency divisor their partition spec implies
+  (conservatively propagated through elementwise chains — an output's
+  divisor is the *minimum* across its non-scalar operands), and program
+  arguments carry the divisors of the live cells they were retraced
+  from (``cost_jaxpr(arg_divisors=...)``). This is what lets the
+  liveness estimate show the ~1/dp optimizer-state drop of the zero1
+  sharded weight update: the moment/master cells really are
+  dp-sharded arrays, and the walk prices them at shard size the way
+  XLA's ``memory_analysis`` does.
 
 Everything lands in one :class:`CostReport`, exposed as
 ``CompiledFunction/BucketedFunction/TrainStep.cost()`` (per-entry
@@ -373,6 +383,53 @@ def _eqn_axis_sizes(eqn) -> Dict[str, int]:
     return sizes
 
 
+def _constraint_divisor(eqn) -> Optional[float]:
+    """Per-device residency divisor a ``sharding_constraint`` equation
+    implies: the product of the mesh-axis sizes its partition spec names
+    (1.0 for a replicated constraint). None when the sharding param
+    carries no inspectable NamedSharding."""
+    sh = eqn.params.get("sharding")
+    spec = getattr(sh, "spec", None)
+    mesh = getattr(sh, "mesh", None)
+    shape = getattr(mesh, "shape", None)
+    if spec is None or shape is None:
+        return None
+    try:
+        sizes = {str(k): int(v) for k, v in dict(shape).items()}
+    except (TypeError, ValueError):
+        return None
+    d = 1.0
+    for entry in spec:
+        axes = entry if isinstance(entry, (list, tuple)) else (
+            (entry,) if entry is not None else ())
+        for ax in axes:
+            d *= float(sizes.get(str(ax), 1))
+    return max(d, 1.0)
+
+
+def value_divisor(value) -> float:
+    """Per-device residency divisor of one LIVE jax array: total numel
+    over the committed sharding's shard numel (1.0 for replicated /
+    uncommitted / non-array values). Feeds ``cost_jaxpr(arg_divisors=)``
+    for program arguments retraced from live state cells."""
+    sh = getattr(value, "sharding", None)
+    shape = getattr(value, "shape", None)
+    if sh is None or shape is None:
+        return 1.0
+    try:
+        shard_shape = sh.shard_shape(tuple(shape))
+    except Exception:
+        return 1.0
+    total = per = 1
+    for d in shape:
+        total *= int(d)
+    for d in shard_shape:
+        per *= int(d)
+    if per <= 0 or total <= 0:
+        return 1.0
+    return max(float(total) / float(per), 1.0)
+
+
 def _is_fused_expansion(eqn) -> bool:
     """True for broadcast-of-scalar / iota results: XLA fuses these into
     their consumers, so charging their full output to the live set would
@@ -491,15 +548,20 @@ def _while_trip_count(eqn) -> int:
     return max(int(math.ceil(span / step)), 0)
 
 
-def _walk_jaxpr(jaxpr, axis_sizes: Optional[Dict[str, int]] = None
-                ) -> CostReport:
+def _walk_jaxpr(jaxpr, axis_sizes: Optional[Dict[str, int]] = None,
+                arg_divisors: Optional[List[float]] = None) -> CostReport:
     """Cost one (open) Jaxpr: totals + liveness peak. Recurses into
     pjit/scan/while/cond bodies; scan multiplies by trip count, cond takes
     the max across branches, while multiplies by the statically derived
     counter trip count when the loop has one (else the
     FLAGS_cost_while_default_trips lower bound). ``axis_sizes`` is the
     mesh-axis environment for collective ring factors, extended by every
-    shard_map/pmap equation recursed through."""
+    shard_map/pmap equation recursed through. ``arg_divisors`` carries a
+    per-device residency divisor per invar (sharded program arguments —
+    zero1 optimizer-state cells enter at shard size); the walk extends
+    it through ``sharding_constraint`` equations and elementwise chains
+    (minimum across non-scalar operands — conservative when sharded and
+    replicated values mix)."""
     import jax
 
     rep = CostReport(n_eqns=len(jaxpr.eqns))
@@ -515,13 +577,24 @@ def _walk_jaxpr(jaxpr, axis_sizes: Optional[Dict[str, int]] = None
         if isinstance(v, jax.core.Var):
             last_use[v] = n  # live to the end
 
-    # program arguments + constants resident at entry (XLA argument size)
-    rep.arg_bytes = sum(_var_bytes(v) for v in jaxpr.invars)
+    # per-var residency divisors (see docstring)
+    divs: Dict = {}
+    if arg_divisors:
+        for v, d in zip(jaxpr.invars, arg_divisors):
+            if isinstance(v, jax.core.Var) and d and d > 1.0:
+                divs[v] = float(d)
+
+    def _resident(v) -> float:
+        return _var_bytes(v) / divs.get(v, 1.0)
+
+    # program arguments + constants resident at entry (XLA argument size
+    # — per device: sharded arguments count their shard)
+    rep.arg_bytes = int(sum(_resident(v) for v in jaxpr.invars))
     rep.out_bytes = sum(_var_bytes(v) for v in jaxpr.outvars)
     entry_vars = list(jaxpr.invars) + list(jaxpr.constvars)
     live = {}
     for v in entry_vars:
-        live[v] = _var_bytes(v)
+        live[v] = _resident(v)
     live_bytes = sum(live.values())
     peak = live_bytes
     # arguments never read free right after entry (they still hit the peak
@@ -596,6 +669,22 @@ def _walk_jaxpr(jaxpr, axis_sizes: Optional[Dict[str, int]] = None
         rep.flops += flops
         rep.matmul_flops += mm
 
+        # ---- residency-divisor propagation -----------------------------
+        if pname == "sharding_constraint":
+            out_div = _constraint_divisor(eqn)
+        elif subs:
+            out_div = None  # container results: no propagation
+        else:
+            in_divs = [divs.get(v, 1.0) for v in eqn.invars
+                       if isinstance(v, jax.core.Var)
+                       and _aval_numel(getattr(v, "aval", None)) > 1]
+            out_div = min(in_divs) if in_divs else None
+        if out_div is not None and out_div > 1.0:
+            for v in eqn.outvars:
+                if isinstance(v, jax.core.Var) and \
+                        _aval_numel(getattr(v, "aval", None)) > 1:
+                    divs[v] = out_div
+
         # ---- liveness update -------------------------------------------
         materialized = 0 if _is_fused_expansion(eqn) else out_b
         if materialized > rep.largest_intermediate_bytes:
@@ -603,7 +692,7 @@ def _walk_jaxpr(jaxpr, axis_sizes: Optional[Dict[str, int]] = None
             rep.largest_intermediate_prim = pname
         for v in eqn.outvars:
             if isinstance(v, jax.core.Var) and v in last_use and v not in live:
-                b = 0 if _is_fused_expansion(eqn) else _var_bytes(v)
+                b = 0 if _is_fused_expansion(eqn) else _resident(v)
                 live[v] = b
                 live_bytes += b
         peak = max(peak, live_bytes + sub_peak_extra)
@@ -619,12 +708,18 @@ def _walk_jaxpr(jaxpr, axis_sizes: Optional[Dict[str, int]] = None
 
 
 def cost_jaxpr(closed_jaxpr, *, location: str = "",
-               axis_sizes: Optional[Dict[str, int]] = None) -> CostReport:
+               axis_sizes: Optional[Dict[str, int]] = None,
+               arg_divisors: Optional[List[float]] = None) -> CostReport:
     """Cost one ClosedJaxpr. Static — never compiles, never executes.
     ``axis_sizes`` seeds the mesh-axis environment for collective ring
     factors (e.g. ``{"dp": 8}`` from a planner Plan) — axes declared by
-    shard_map/pmap equations inside the program resolve themselves."""
-    rep = _walk_jaxpr(closed_jaxpr.jaxpr, dict(axis_sizes or {}) or None)
+    shard_map/pmap equations inside the program resolve themselves.
+    ``arg_divisors`` (one per invar, in flatten order) prices sharded
+    program arguments at per-device shard size in the liveness walk —
+    ``cost_compiled_function`` derives them from the live state cells'
+    committed shardings."""
+    rep = _walk_jaxpr(closed_jaxpr.jaxpr, dict(axis_sizes or {}) or None,
+                      arg_divisors=arg_divisors)
     rep.location = location
     return rep
 
@@ -653,7 +748,12 @@ def cost_compiled_function(cf) -> CostReport:
         except Exception as e:
             errors.append(f"{loc}: {str(e).splitlines()[0]}")
             return
-        rep = cost_jaxpr(closed, location=loc)
+        # program arguments = [cell values..., user args...]: cells are
+        # live arrays whose committed shardings tell us the per-device
+        # residency (zero1 moments enter at 1/dp), user args replicated
+        divisors = [value_divisor(c._value) for c in entry.get("cells", ())]
+        divisors += [1.0] * max(len(closed.jaxpr.invars) - len(divisors), 0)
+        rep = cost_jaxpr(closed, location=loc, arg_divisors=divisors)
         guards = entry.get("guards")
         if guards:
             # the guard-predicate overhead of a speculative branch family

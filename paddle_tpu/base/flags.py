@@ -253,6 +253,17 @@ define_flag("comm_portable_reshard", True,
             "composed all_to_all/slice/all_gather sequences that keep "
             "peak per-device residency at O(shard); 0 restores the "
             "legacy whole-array device_put path for every transition")
+define_flag("sharding_stage", "",
+            "ZeRO sharded weight update (distributed/sharding/zero1.py): "
+            "'zero1' shards optimizer states and the weight update across "
+            "the dp/sharding mesh axis — reduce-scatter(grads) → per-shard "
+            "optimizer update → all-gather(updated weights), ~1/dp "
+            "optimizer-state bytes per replica; '' (default) keeps the "
+            "replicated update. TrainStep(sharding=...) overrides per "
+            "step program; flips retrace (the tier is in the static "
+            "compile key). The weight all-gather rides the int8 "
+            "blockwise-scale wire when the comm quantized tier is engaged "
+            "(FLAGS_comm_quantize_dp_grads / amp comm_dtype)")
 define_flag("cost_max_guard_preds", 8,
             "cost-model lint (CM505): a speculative branch family "
             "verifying more guard predicates than this per call is "

@@ -42,17 +42,30 @@ class DistEngine:
     def prepare(self, batch_size: Optional[int] = None, seq_len: Optional[int] = None,
                 hbm_bytes: int = 16 << 30, n_devices: Optional[int] = None,
                 mode: str = "auto", passes: Optional[List[str]] = None,
-                shard_params: bool = True):
+                shard_params: bool = True, amortize_steps: int = 100):
         """Plan the mesh for this model WITHOUT user input: enumerate
-        candidates, prune by memory, rank by the step-cost model, then
-        initialize the hybrid environment and (mp>1) shard the parameters
+        candidates (each dp>1 shape both replicated and ZeRO-1-sharded),
+        prune by memory (zero1 variants price optimizer state at 1/dp —
+        they survive budgets that OOM the replicated twin), rank by the
+        step-cost model PLUS the one-time resharding cost of moving the
+        live parameters into the candidate's placement (``plan_route``
+        wire volume, amortized over ``amortize_steps``), then initialize
+        the hybrid environment and (mp>1) shard the parameters
         (reference static/engine.py:98 prepare → completion + planner +
-        partitioner). Returns the chosen Plan; the scored candidate list is
-        kept in ``cost_report``."""
+        partitioner + the reshard pass' cost). Ties between a zero1 and
+        a replicated candidate break to replicated (simpler program);
+        memory pressure and the quantized comm tier are what tip the
+        ranking to zero1. Returns the chosen Plan; the scored candidate
+        list is kept in ``cost_report`` (``zero_sharding``,
+        ``reshard_bytes``, ``score_seconds`` per row). A chosen zero1
+        plan auto-appends the ``sharding_stage1`` pass."""
+        import dataclasses
+
         import jax
 
         from .. import fleet
-        from .planner import ModelSpec, estimate_step_cost, iter_feasible
+        from .planner import (ModelSpec, estimate_per_device_bytes,
+                              estimate_step_cost, iter_feasible)
 
         known_passes = {"sharding_stage1", "sharding_stage2", "amp"}
         bad = [p for p in (passes or []) if p not in known_passes]
@@ -63,35 +76,110 @@ class DistEngine:
         bs = batch_size or max(n, 8)
         spec = ModelSpec.from_model(self._layer, seq_len=seq_len)
         self.cost_report = []
-        best, best_cost = None, float("inf")
+        best, best_score = None, float("inf")
+        # the reshard volume depends only on the candidate's mp degree
+        # (the target param placement), not dp/pp/sep — memoize it so a
+        # large-model prepare doesn't replan every param per candidate
+        reshard_by_mp: dict = {}
         for plan, why in iter_feasible(spec, n, bs, hbm_bytes=hbm_bytes):
             if why == "infeasible":
                 continue
-            if why is not None:
-                self.cost_report.append(
-                    {"plan": (plan.dp, plan.mp, plan.pp), "pruned": why,
-                     "bytes": plan.per_device_bytes})
-                continue
-            cost = estimate_step_cost(spec, bs, plan)
-            self.cost_report.append(
-                {"plan": (plan.dp, plan.mp, plan.pp),
-                 "bytes": plan.per_device_bytes, **cost})
-            if cost["step_seconds"] < best_cost:
-                best, best_cost = plan, cost["step_seconds"]
+            variants = [(plan, why)]
+            if plan.dp > 1 and why in (None, "oom"):
+                z = dataclasses.replace(plan, sharding=plan.dp)
+                z.per_device_bytes = estimate_per_device_bytes(
+                    spec, bs, z.dp, z.mp, z.pp, z.sep, sharding=z.sharding)
+                variants.append(
+                    (z, "oom" if z.per_device_bytes > hbm_bytes else None))
+            for cand, pruned in variants:
+                row = {"plan": (cand.dp, cand.mp, cand.pp),
+                       "zero_sharding": cand.sharding,
+                       "bytes": cand.per_device_bytes}
+                if pruned is not None:
+                    row["pruned"] = pruned
+                    self.cost_report.append(row)
+                    continue
+                cost = estimate_step_cost(spec, bs, cand)
+                if cand.mp not in reshard_by_mp:
+                    reshard_by_mp[cand.mp] = self._plan_reshard_bytes(cand)
+                reshard_bytes = reshard_by_mp[cand.mp]
+                reshard_s = reshard_bytes / 100e9
+                score = cost["step_seconds"] + \
+                    reshard_s / max(amortize_steps, 1)
+                row.update(cost, reshard_bytes=reshard_bytes,
+                           score_seconds=score)
+                self.cost_report.append(row)
+                if score < best_score:
+                    best, best_score = cand, score
         if best is None:
             raise ValueError(
                 f"no feasible parallel plan for {n} devices within "
                 f"{hbm_bytes / 2**30:.0f} GiB/device")
         best.reason = (f"cost-model best of {len(self.cost_report)} "
-                       f"candidates: ~{best_cost * 1e3:.2f} ms/step est")
+                       f"candidates: ~{best_score * 1e3:.2f} ms/step est"
+                       + (" (zero1 sharded update)"
+                          if best.sharding > 1 else ""))
         self._plan = best
         self._passes = list(passes or [])
+        if best.sharding > 1 and not any(
+                p.startswith("sharding_stage") for p in self._passes):
+            self._passes.append("sharding_stage1")
         strategy = fleet.DistributedStrategy()
         strategy.hybrid_configs = best.degrees
         fleet.init(is_collective=True, strategy=strategy)
         if shard_params and best.mp > 1:
             self._shard_parameters("mp")
         return self._plan
+
+    def _plan_reshard_bytes(self, plan) -> float:
+        """One-time wire bytes of moving the live parameters from their
+        CURRENT placements into ``plan``'s target layout (mp>1: sharded
+        over the mp axis on the largest divisible dim; else replicated),
+        priced by ``collective_opt.plan_route`` — the reshard-pass cost
+        the candidate ranking folds in. Fresh replicated models cost 0
+        (r_to_s is a local slice); re-preparing a live sharded model
+        pays the planned all_to_all/all_gather volume."""
+        from ..collective_opt import plan_route
+        from ..env import HYBRID_AXES
+        from .placement_type import Replicate, Shard
+
+        degrees = {"pp": plan.pp, "dp": plan.dp, "sharding": 1,
+                   "sep": plan.sep, "mp": plan.mp}
+
+        class _View:
+            dim_names = list(HYBRID_AXES)
+            shape = [degrees[a] for a in HYBRID_AXES]
+
+        mp_idx = _View.dim_names.index("mp")
+        total = 0.0
+        for p in self._layer.parameters():
+            shape = tuple(p._value.shape)
+            recorded = getattr(p, "_placements", None)
+            if recorded is None:
+                src = [Replicate() for _ in _View.dim_names]
+            else:
+                # remap the recorded placements (relative to the param's
+                # own ProcessMesh) onto the hybrid axis order by name
+                pm = getattr(p, "_process_mesh", None)
+                names = list(getattr(pm, "dim_names", _View.dim_names))
+                by_name = dict(zip(names, recorded))
+                src = [by_name.get(ax, Replicate())
+                       for ax in _View.dim_names]
+            dst = [Replicate() for _ in _View.dim_names]
+            if plan.mp > 1 and shape:
+                best_dim = max((d for d in range(len(shape))
+                                if shape[d] % plan.mp == 0
+                                and shape[d] >= plan.mp),
+                               key=lambda d: shape[d], default=None)
+                if best_dim is not None:
+                    dst[mp_idx] = Shard(best_dim)
+            route = plan_route(src, dst, _View, shape,
+                               int(p._value.dtype.itemsize))
+            if route.supported:
+                total += route.comm_bytes_new
+            else:
+                total += route.comm_bytes_old or 0.0
+        return total
 
     def _shard_parameters(self, axis: str):
         """GSPMD partitioning: place each parameter sharded over ``axis``

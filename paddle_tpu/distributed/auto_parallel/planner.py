@@ -209,10 +209,12 @@ def estimate_per_device_bytes_from_report(report, dp: int = 1, mp: int = 1,
     state + batch — the resident state XLA reports as argument size)
     shard over mp·pp, the transient remainder of the liveness peak
     (activations/grads) over dp·mp·sep. The ZeRO ``sharding`` degree is
-    ignored here — the traced single-replica program cannot separate the
-    optimizer-moment share of its arguments (documented tolerance vs the
-    closed-form spec: within ~4x on transformer steps, see
-    tests/test_cost_model.py)."""
+    deliberately NOT applied here: when the step was traced with the
+    zero1 strategy engaged, its optimizer-state cells are committed
+    dp-sharded arrays and the sharding-aware liveness walk already
+    prices them at shard size — dividing again would double-count the
+    drop (a replicated-traced report simply has no shard split to
+    apply)."""
     state = int(report.arg_bytes)
     transient = max(int(report.peak_bytes) - state, 0)
     del sharding  # see docstring
@@ -335,7 +337,12 @@ def estimate_step_cost(spec: ModelSpec, batch_size: int, plan: Plan,
       — priced at the quantized tier's wire bytes (int8 payload + fp32
       scale overhead, ``collective_opt.wire_report``) when
       ``comm_quantize`` is True (default: ``FLAGS_comm_quantize_dp_grads``),
-      so plans are ranked on the bytes the sync actually moves;
+      so plans are ranked on the bytes the sync actually moves. A zero1
+      plan (``plan.sharding > 1``) is priced at its actual pair — the
+      fp32 reduce-scatter of the grads plus the all-gather of the
+      updated weights ((dp-1)/dp each; the gather at int8+scales wire
+      bytes when ``comm_quantize``) — the ``sharding/zero1`` accounting
+      the bench cross-checks within 1.3x of measured;
     - mp comm: two activation all-reduces per layer (Megatron row+column),
       on the critical path;
     - pp bubble: (p-1)/(m+p-1) idle fraction on top of compute.
@@ -358,7 +365,18 @@ def estimate_step_cost(spec: ModelSpec, batch_size: int, plan: Plan,
             comm_quantize = False
     dp_comm_bytes = 2.0 * (plan.dp - 1) / max(plan.dp, 1) * grad_bytes \
         if plan.dp > 1 else 0.0
-    if comm_quantize and plan.dp > 1:
+    zero1 = plan.dp > 1 and getattr(plan, "sharding", 1) > 1
+    if zero1:
+        # the zero1 pair: fp32 reduce-scatter of the grads + all-gather
+        # of the updated weights (int8 blocks + fp32 scales on the wire
+        # when the quantized tier engages) — one fused-bucket model, same
+        # granularity as the all-reduce pricing above
+        from ...distributed.sharding.zero1 import zero1_wire_report
+
+        row = zero1_wire_report([("grads", int(grad_elems), 2)], plan.dp,
+                                quantize=bool(comm_quantize))
+        dp_comm_bytes = row["wire_bytes"]
+    elif comm_quantize and plan.dp > 1:
         from ..collective_opt import wire_report
 
         # one fused-bucket model: the whole grad set syncs as one flat
@@ -378,4 +396,5 @@ def estimate_step_cost(spec: ModelSpec, batch_size: int, plan: Plan,
             "dp_comm_seconds": dp_comm_s, "mp_comm_seconds": mp_comm_s,
             "dp_comm_bytes": dp_comm_bytes,
             "comm_quantized": bool(comm_quantize and plan.dp > 1),
+            "zero1": zero1,
             "pp_bubble_fraction": bubble}

@@ -34,9 +34,9 @@ __all__ = [
     "ReshardRoute", "apply_route", "dequantize_blockwise", "dp_sync_gspmd",
     "engaged_comm_dtype", "maybe_qpsum", "partial_to_replicate",
     "partial_to_shard", "plan_route", "qpsum_lax", "qpsum_reference",
-    "quantize_blockwise", "quantize_decision", "stats", "axis_wire_dtypes",
-    "tensor_wire_bytes", "wire_report", "gspmd_sync_axis",
-    "reset_comm_records",
+    "note_wire_dtype", "quantize_blockwise", "quantize_decision", "stats",
+    "axis_wire_dtypes", "tensor_wire_bytes", "wire_report",
+    "gspmd_sync_axis", "reset_comm_records",
 ]
 
 
@@ -73,6 +73,13 @@ _axis_wire_dtypes: dict = {}
 
 def _note_wire_dtype(axis: str, wire_dtype: str) -> None:
     _axis_wire_dtypes.setdefault(str(axis), set()).add(str(wire_dtype))
+
+
+def note_wire_dtype(axis: str, wire_dtype: str) -> None:
+    """Record one engaged sync's wire dtype on a mesh axis (the QZ803
+    mixed-dtype feed) — for comm tiers outside this package (the zero1
+    quantized weight all-gather)."""
+    _note_wire_dtype(axis, wire_dtype)
 
 
 def axis_wire_dtypes() -> dict:

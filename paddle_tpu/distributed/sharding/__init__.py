@@ -4,35 +4,53 @@ Reference: paddle.distributed.sharding.group_sharded_parallel
 (distributed/sharding/group_sharded.py) -> GroupShardedStage2/3 wrappers +
 GroupShardedOptimizerStage2 (fleet/meta_parallel/sharding/*).
 
-TPU-native: ZeRO is a *layout*, not a runtime. Stage1/2 shard the optimizer
-states (and thus the update computation) over the dp/sharding axis; stage3
-additionally shards the parameters. GSPMD partitions the optimizer update and
-inserts the gather/scatter collectives the reference implements by hand
-(SURVEY.md §7 translation table).
+TPU-native: stages 1/2 ("os" / "os_g") engage the :mod:`zero1` strategy —
+reduce-scatter(grads) → per-shard optimizer update (each replica owns a
+contiguous 1/dp slice of the flattened param/moment space) → all-gather
+(updated weights), with the optimizer states persisting as genuinely
+sharded arrays. Stage 3 ("p_g_os") additionally shards the parameters
+themselves over the axis (GSPMD partitions the forward/backward
+accordingly). ``save_group_sharded_model`` round-trips the sharded
+optimizer state: each process saves only its addressable shard pieces,
+and load re-scatters them onto the owning devices.
 """
 from __future__ import annotations
 
-from ..auto_parallel.api import (
-    ShardingStage1,
-    ShardingStage2,
-    ShardingStage3,
-    shard_optimizer,
-)
+from . import zero1
+from .zero1 import (Zero1Strategy, load_sharded_optimizer_state,
+                    opt_state_report, plan_shards,
+                    save_sharded_optimizer_state, zero1_wire_report)
+
+__all__ = [
+    "group_sharded_parallel", "save_group_sharded_model",
+    "load_group_sharded_model", "zero1", "Zero1Strategy", "plan_shards",
+    "opt_state_report", "zero1_wire_report",
+    "save_sharded_optimizer_state", "load_sharded_optimizer_state",
+]
 
 
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=None,
                            offload=False, sync_buffers=False, buffer_max_size=None,
                            segment_size=None, sync_comm=False):
-    """reference group_sharded.py: level in {'os', 'os_g', 'p_g_os'}."""
-    from .. import env as env_mod
+    """reference group_sharded.py: level in {'os', 'os_g', 'p_g_os'}.
 
-    axis = "sharding" if env_mod.instance().axis_degrees.get("sharding", 1) > 1 else "dp"
-    stage = {"os": ShardingStage1, "os_g": ShardingStage2, "p_g_os": ShardingStage3}[level]
-    shard_optimizer(optimizer, stage(axis))
+    'os' and 'os_g' attach the zero1 strategy (optimizer states + weight
+    update sharded over dp/sharding; gradients reduce-scatter as part of
+    the update, so stage 2 is subsumed); 'p_g_os' additionally shards the
+    parameters. Engagement is sticky for this optimizer — TrainStep
+    detects it and keys its compile cache on the sharded-update tier.
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"unknown group_sharded level {level!r} "
+                         "(os|os_g|p_g_os)")
+    zero1.ensure_strategy(optimizer, requested=True)
     if level == "p_g_os":
+        from .. import env as env_mod
         from ..auto_parallel.api import _shard_over_axis
         from ..auto_parallel.process_mesh import get_mesh_from_jax
 
+        axis = "sharding" if env_mod.instance().axis_degrees.get(
+            "sharding", 1) > 1 else "dp"
         mesh = get_mesh_from_jax(env_mod.get_mesh())
         for p in model.parameters():
             p._replace_value(_shard_over_axis(p._value, mesh, axis))
@@ -40,8 +58,37 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=No
 
 
 def save_group_sharded_model(model, output, optimizer=None):
+    """Save a group-sharded model + optimizer. Model parameters are
+    replicated (stages 1/2) and save whole; zero1 optimizer state saves
+    SHARDED — each process writes only its addressable shard pieces to
+    ``output + ".pdopt.shard{rank}of{world}"`` (plus the host-side
+    remainder in ``output + ".pdopt"``), no full-tensor gather. Without
+    sharded state this degrades to the legacy whole-state save."""
     from ...framework.io import save
 
     save(model.state_dict(), output + ".pdparams")
-    if optimizer is not None:
+    if optimizer is None:
+        return
+    st = zero1.attached(optimizer)
+    if st is not None and st.shard_entries(optimizer):
+        save_sharded_optimizer_state(optimizer, output)
+    else:
         save(optimizer.state_dict(), output + ".pdopt")
+
+
+def load_group_sharded_model(model, output, optimizer=None):
+    """Round-trip of :func:`save_group_sharded_model`: parameters load
+    whole; sharded optimizer state re-scatters each saved shard piece
+    straight onto its owning device."""
+    import glob
+    import os
+
+    from ...framework.io import load
+
+    model.set_state_dict(load(output + ".pdparams"))
+    if optimizer is None:
+        return
+    if glob.glob(output + ".pdopt.shard*of*"):
+        load_sharded_optimizer_state(optimizer, output)
+    elif os.path.exists(output + ".pdopt"):
+        optimizer.set_state_dict(load(output + ".pdopt"))

@@ -66,12 +66,20 @@ class TrainStep:
 
     def __init__(self, model=None, optimizer=None, loss_fn: Optional[Callable] = None, grad_accum_steps: int = 1,
                  bucket_axes: Optional[dict] = None, bucket_range: Optional[tuple] = None,
-                 bucket_pad_values: Optional[dict] = None):
+                 bucket_pad_values: Optional[dict] = None,
+                 sharding: Optional[str] = None):
         import jax.numpy as jnp
 
+        if sharding not in (None, "zero1", "replicated"):
+            raise ValueError(f"unknown TrainStep sharding {sharding!r} "
+                             "(None|'zero1'|'replicated')")
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
+        # zero1 engagement override: "zero1" forces the sharded update,
+        # "replicated" forces it off, None defers to FLAGS_sharding_stage /
+        # an attached group_sharded strategy (distributed/sharding/zero1.py)
+        self._sharding = sharding
         self._lr_cell = Tensor(jnp.asarray(0.0, jnp.float32), name="lr_cell")
         # host-side mirror of the cell's value: the device scalar re-uploads
         # only when the schedule actually moves, so a constant-LR steady
@@ -81,26 +89,33 @@ class TrainStep:
         def step_fn(*batch):
             loss = self.loss_fn(*batch)
             loss.backward()
-            self._sync_dp_grads()
+            if self._zero1_spec() is None:
+                # zero1 replaces the dp grad sync: its reduce-scatter IS
+                # the sync, fused into the sharded update
+                self._sync_dp_grads()
             # read the LR through the dispatcher so the functionalizer records
             # the cell (traced input, not baked constant)
             lr_traced = (self._lr_cell + 0.0)._value
             prev = getattr(self.optimizer, "_lr_override", None)
+            prev_sh = getattr(self.optimizer, "_sharding_override", None)
             self.optimizer._lr_override = lr_traced
+            self.optimizer._sharding_override = self._sharding
             try:
                 self.optimizer.step()
             finally:
                 self.optimizer._lr_override = prev
+                self.optimizer._sharding_override = prev_sh
             self.optimizer.clear_grad()
             return loss
 
-        # the quantized dp-sync engagement is part of the program's shape:
-        # flipping FLAGS_comm_quantize_dp_grads (or entering an
-        # amp.auto_cast(comm_dtype=...) region) must recompile, not silently
-        # serve the other tier's cached program
+        # the quantized dp-sync engagement AND the zero1 sharded-update
+        # tier are part of the program's shape: flipping
+        # FLAGS_comm_quantize_dp_grads / FLAGS_sharding_stage (or entering
+        # an amp.auto_cast(comm_dtype=...) region) must recompile, not
+        # silently serve the other tier's cached program
         base_key = (lambda: ("train" if model.training else "eval")) \
             if model is not None else (lambda: "fn")
-        static_key = lambda: (base_key(), self._dp_sync_key())  # noqa: E731
+        static_key = lambda: (base_key(), self._dp_sync_key(), self._sharding_key())  # noqa: E731
         if bucket_axes:
             # dynamic-shape policy: pad variable dims to the log2 bucket
             # ladder so distinct lengths share ≤ log2(max/min)+1 programs
@@ -121,6 +136,29 @@ class TrainStep:
 
         spec = copt.gspmd_sync_axis()
         return "fp32" if spec is None else ("int8", spec[1], spec[2])
+
+    def _zero1_spec(self):
+        """(mesh, axis, n) when the zero1 sharded weight update engages
+        for this step (explicit sharding= > FLAGS_sharding_stage >
+        group_sharded strategy), else None."""
+        if self.optimizer is None:
+            return None
+        from ..distributed.sharding import zero1
+
+        return zero1.step_spec(self.optimizer, explicit=self._sharding)
+
+    def _sharding_key(self):
+        """Static cache-key component for the zero1 sharded-update tier:
+        (axis, size, gather wire dtype) when engaged, 'replicated'
+        otherwise — flag flips retrace instead of replaying the other
+        tier's program."""
+        spec = self._zero1_spec()
+        if spec is None:
+            return "replicated"
+        from ..distributed import collective_opt as copt
+
+        return ("zero1", spec[1], spec[2],
+                copt.engaged_comm_dtype() or "fp32")
 
     def _sync_dp_grads(self):
         """The dp gradient-sync stage (between backward and the optimizer

@@ -44,6 +44,11 @@ class Optimizer:
         # traced state, not a python constant baked into compiled programs
         self._step_tensor = Tensor(jnp.asarray(0, jnp.int32), name="opt_step")
         self._lr_override = None  # traced LR injected by jit.TrainStep
+        # zero1 plumbing (distributed/sharding/zero1.py): the per-step
+        # engagement override injected by TrainStep(sharding=...) and the
+        # strategy attached by group_sharded_parallel
+        self._sharding_override = None
+        self._zero1_strategy = None
 
     # ------------------------------------------------ lr
     def get_lr(self) -> float:
@@ -94,13 +99,25 @@ class Optimizer:
         clip_map = {id(p): g for p, g in clipped}
         self._step_tensor._replace_value(self._step_tensor._value + 1)
         lr = self._lr_override if self._lr_override is not None else self.get_lr()
+        # zero1 sharded weight update: when engaged (TrainStep override /
+        # FLAGS_sharding_stage / group_sharded_parallel) every eligible
+        # parameter's update runs in its 1/dp shard space — grad clipping
+        # above stays on the full gradients, so clip semantics are
+        # identical across tiers
+        from ..distributed.sharding import zero1 as _zero1
+
+        spec = _zero1.step_spec(self)
+        strategy = _zero1.ensure_strategy(self) if spec is not None else None
         for p, _, group in pgs:
             g = clip_map.get(id(p))
             if g is None:
                 continue
             group_lr = lr * p.optimize_attr.get("learning_rate", 1.0) * group.get("learning_rate", 1.0)
             wd = group.get("weight_decay", self._weight_decay)
-            self._apply_one(p, g, group_lr, wd)
+            if strategy is not None:
+                strategy.apply_one(self, p, g, group_lr, wd, spec)
+            else:
+                self._apply_one(p, g, group_lr, wd)
 
     def _apply_one(self, p: Tensor, g: Tensor, lr, weight_decay):
         raise NotImplementedError
@@ -147,12 +164,23 @@ class Optimizer:
         return g._value + float(coeff) * p._value
 
     # ------------------------------------------------ state dict
+    def _lookup_cell(self, store, p):
+        """An accumulator cell for ``p``: the zero1 shard-space proxy's
+        when the sharded update owns one, else the param's own."""
+        if self._zero1_strategy is not None:
+            return self._zero1_strategy.cell_for(store, p)
+        return store.get(id(p))
+
     def state_dict(self):
         out = {}
         for name, store in self._accumulators.items():
             for p in self._parameter_list:
-                if id(p) in store:
-                    out[f"{p.name}_{name}"] = store[id(p)]
+                cell = self._lookup_cell(store, p)
+                if cell is not None:
+                    out[f"{p.name}_{name}"] = cell
+        if self._zero1_strategy is not None:
+            for m in self._zero1_strategy.extra_state_cells():
+                out[m.name] = m
         for k, v in self._aux_state.items():
             out[k] = v
         if isinstance(self._learning_rate, LRScheduler):
@@ -160,14 +188,28 @@ class Optimizer:
         out["@step"] = self._step_count
         return out
 
+    def _prime_target(self, p):
+        """The cell owner accumulator priming targets for ``p``: the
+        zero1 shard-space proxy (pre-shaped + sharded) when the sharded
+        update is engaged, else the param itself — primed cells must be
+        the SAME cells the first step will update, or the GradScaler's
+        overflow rollback snapshots dead state."""
+        from ..distributed.sharding import zero1 as _zero1
+
+        spec = _zero1.step_spec(self)
+        if spec is None:
+            return p
+        return _zero1.ensure_strategy(self).prime_proxy(p, spec)
+
     def _prime_accumulators(self):
         """Eagerly create every accumulator (GradScaler snapshots and the jit
         functionalizer need the full cell set before the first step)."""
         for p in self._parameter_list:
             if p.stop_gradient:
                 continue
+            target = self._prime_target(p)
             for name in self._accum_names:
-                self._get_accumulator(name, p)
+                self._get_accumulator(name, target)
 
     def set_state_dict(self, state):
         import numpy as np
@@ -178,7 +220,22 @@ class Optimizer:
                 if key in state:
                     src = state[key]
                     arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
-                    self._get_accumulator(name, p).set_value(arr)
+                    existing = self._lookup_cell(self._accumulators[name], p)
+                    if existing is not None:
+                        existing.set_value(arr)
+                    else:
+                        self._get_accumulator(name, p).set_value(arr)
+        strategy = self._zero1_strategy
+        if strategy is None and any(k.endswith("_zero1_master")
+                                    for k in state):
+            # a fresh optimizer restoring a master-carrying state: attach
+            # the strategy so the masters land instead of being dropped
+            from ..distributed.sharding import zero1 as _zero1
+
+            if _zero1.step_spec(self, explicit="zero1") is not None:
+                strategy = _zero1.ensure_strategy(self)
+        if strategy is not None:
+            strategy.restore_masters(self, state)
         for k in list(self._aux_state):
             if k in state:
                 src = state[k]
@@ -197,4 +254,6 @@ class Optimizer:
         for store in self._accumulators.values():
             cells.extend(store.values())
         cells.extend(self._aux_state.values())
+        if self._zero1_strategy is not None:
+            cells.extend(self._zero1_strategy.extra_state_cells())
         return cells

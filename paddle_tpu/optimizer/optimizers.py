@@ -111,7 +111,8 @@ class Adagrad(Optimizer):
     def _prime_accumulators(self):
         for p in self._parameter_list:
             if not p.stop_gradient:
-                self._get_accumulator("moment", p, fill=self._init_acc)
+                self._get_accumulator("moment", self._prime_target(p),
+                                      fill=self._init_acc)
 
     def _apply_one(self, p, g, lr, weight_decay):
         gv = self._decayed_grad(p, g, weight_decay)

@@ -19,7 +19,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _write_run(dirpath, n, value=None, rc=0, note="cpu_fallback",
                metric=DEFAULT_METRIC, parsed_override="unset",
-               coldstart=None, comm=None):
+               coldstart=None, comm=None, zero1=None):
     payload = {"n": n, "cmd": "bench", "rc": rc, "tail": ""}
     if parsed_override != "unset":
         payload["parsed"] = parsed_override
@@ -30,6 +30,8 @@ def _write_run(dirpath, n, value=None, rc=0, note="cpu_fallback",
             payload["parsed"]["coldstart"] = coldstart
         if comm is not None:
             payload["parsed"]["comm"] = comm
+        if zero1 is not None:
+            payload["parsed"]["zero1"] = zero1
     else:
         payload["parsed"] = None
     path = os.path.join(dirpath, f"BENCH_r{n:02d}.json")
@@ -207,6 +209,42 @@ class TestCommTrack:
         _write_run(str(tmp_path), 1, 20000.0)
         _write_run(str(tmp_path), 2, 20000.0,
                    comm={"allreduce_bytes_saved_ratio": 3.8})
+        verdict = judge(load_trajectory(str(tmp_path), extract=self.PATH),
+                        0.20)
+        assert verdict["ok"] is True and "single parsed" in verdict["reason"]
+
+
+class TestZero1Track:
+    """ISSUE 12 satellite: the zero1 sharded-vs-replicated optimizer
+    state residency ratio (bench extras.zero1) rides the same extras
+    trajectory — tracked per run, judged only once two rounds carry
+    it."""
+
+    PATH = "zero1.opt_state_bytes_ratio"
+
+    def test_zero1_ratio_is_a_default_extra(self):
+        assert self.PATH in DEFAULT_EXTRAS
+
+    def test_tracks_and_gates_like_the_headline(self, tmp_path):
+        _write_run(str(tmp_path), 1, 20000.0,
+                   zero1={"opt_state_bytes_ratio": 7.3})
+        _write_run(str(tmp_path), 2, 20000.0,
+                   zero1={"opt_state_bytes_ratio": 7.4})
+        rows = load_trajectory(str(tmp_path), extract=self.PATH)
+        assert [r["value"] for r in rows] == [7.3, 7.4]
+        assert main(["--dir", str(tmp_path)]) == 0
+        # a collapse of the residency win (sharding silently replicated
+        # again) gates
+        _write_run(str(tmp_path), 3, 20000.0,
+                   zero1={"opt_state_bytes_ratio": 1.0})
+        assert main(["--dir", str(tmp_path)]) == 1
+
+    def test_repo_history_tolerates_absent_zero1(self, tmp_path):
+        """Pre-ISSUE-12 rounds carry no extras.zero1: absent rows, no
+        gate until two rounds carry the ratio."""
+        _write_run(str(tmp_path), 1, 20000.0)
+        _write_run(str(tmp_path), 2, 20000.0,
+                   zero1={"opt_state_bytes_ratio": 7.3})
         verdict = judge(load_trajectory(str(tmp_path), extract=self.PATH),
                         0.20)
         assert verdict["ok"] is True and "single parsed" in verdict["reason"]

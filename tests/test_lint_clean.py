@@ -160,16 +160,22 @@ def test_cache_audit_green_on_demo_store(tmp_path):
 
 
 def test_comm_audit_green_on_demo_session():
-    """ISSUE 10: the comm-efficient collective tier's contract holds —
-    the quantized allreduce passes its accuracy gate against the exact
-    fp32 sum, the wire path is bitwise deterministic / replica-identical
-    / oracle-matching (this CI forces 8 CPU devices, so the shard_map
-    wire path really runs), the portable reshard tier plans all_to_all
-    for s_to_s, and no mesh axis mixed gradient-sync wire dtypes."""
+    """ISSUE 10 + 12: the comm-efficient collective tier's contract
+    holds — the quantized allreduce passes its accuracy gate against the
+    exact fp32 sum, the wire path is bitwise deterministic /
+    replica-identical / oracle-matching (this CI forces 8 CPU devices,
+    so the shard_map wire path really runs), the portable reshard tier
+    plans all_to_all for s_to_s, no mesh axis mixed gradient-sync wire
+    dtypes, the zero1 sharded weight update tracks the replicated
+    oracle (QZ804) and its shard plan holds the padding invariant
+    (QZ805)."""
     from paddle_tpu.analysis.comm_check import audit_comm, record_demo_comm
 
     report = record_demo_comm()
     assert report["wire_checked"], report  # 8-device CI must gate the wire
+    assert report["zero1_wire_checked"], report  # ...and the zero1 update
+    assert report["zero1_parity_max_err"] <= 1e-5
+    assert any(r["sharded"] for r in report["zero1_plan"])
     assert [str(f) for f in audit_comm(report)] == []
 
 

@@ -1,0 +1,703 @@
+"""ISSUE 12 — ZeRO-1 cross-replica sharded optimizer states and weight
+update (distributed/sharding/zero1.py).
+
+Covers the shard-space plan invariants, the eager + compiled sharded
+update's parity with the replicated oracle (bitwise on this backend),
+the measured ~1/dp optimizer-state residency drop, the engagement
+matrix (flag / TrainStep override / group_sharded_parallel) and its
+compile-cache keying (flag flips retrace), the optional int8 quantized
+weight all-gather tier (master shards, wire dtype), the sharded
+checkpoint round-trip, the planner/cost-model pricing of the
+reduce-scatter/all-gather pair, the sharding-aware liveness walk, and
+the QZ804/QZ805 lint seeded negatives. conftest forces 8 CPU devices,
+so every collective here is real.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.base.flags import get_flags, set_flags
+from paddle_tpu.distributed import collective_opt as copt
+from paddle_tpu.distributed.sharding import zero1
+from paddle_tpu.jit.api import TrainStep
+
+N_DEV = len(jax.devices())
+_FLAGS = ("sharding_stage", "comm_quantize_dp_grads")
+
+
+@pytest.fixture(autouse=True)
+def _flag_isolation():
+    prev = get_flags(_FLAGS)
+    yield
+    set_flags(prev)
+    copt.reset_comm_records()
+
+
+def _mesh():
+    # pin the dp=8 layout: earlier test files may leave a different
+    # hybrid mesh installed, and init without degrees keeps it
+    dist.init_parallel_env({"dp": 8})
+    return dist.env.get_mesh()
+
+
+# ---------------------------------------------------------------- shard plan
+class TestShardPlan:
+    def test_rows_hold_the_padding_invariant(self):
+        rows = zero1.plan_shards(
+            [("big", 50000, 4), ("mid", 777, 4), ("tiny", 7, 4),
+             ("edge", 2048, 4)], 8)
+        for r in rows:
+            if r.sharded:
+                assert r.shard_elems * r.axis_size == r.padded
+                assert r.shard_elems % r.block == 0
+                assert r.pad_per_shard < r.block
+                # strict per-replica byte win — the QZ805 invariant
+                assert r.shard_elems < r.numel
+            else:
+                # tiny tensors stay replicated: one padded block per
+                # shard would EXCEED the whole tensor
+                assert r.numel <= r.block * 8
+
+    def test_tiny_tensors_stay_replicated(self):
+        r = zero1.plan_row("b", 200, 4, 8)
+        assert not r.sharded  # one 256-block shard ≥ 200 elems
+        r2 = zero1.plan_row("w", 2049, 4, 8)  # 2049 > 8·256: two blocks
+        assert r2.sharded and r2.shard_elems == 512 and r2.padded == 4096
+
+    def test_wire_report_prices_the_rs_ag_pair(self):
+        n = 8
+        rep = zero1.zero1_wire_report([("g", 512 * 64, 4)], n)
+        ring = (n - 1) / n
+        padded = 512 * 64  # already divides n·block
+        assert rep["reduce_scatter_bytes"] == pytest.approx(
+            ring * padded * 4)
+        assert rep["all_gather_bytes"] == pytest.approx(ring * padded * 4)
+        # fp32 pair == the all-reduce ring: zero1 is memory-, not
+        # bandwidth-motivated until the gather quantizes
+        assert rep["wire_bytes"] == pytest.approx(rep["allreduce_bytes"])
+        q = zero1.zero1_wire_report([("g", 512 * 64, 4)], n, quantize=True)
+        assert q["all_gather_bytes"] < rep["all_gather_bytes"] / 3
+        assert q["wire_bytes"] < rep["wire_bytes"]
+
+
+# ------------------------------------------------------------- eager parity
+@pytest.mark.skipif(N_DEV < 8, reason="needs the 8-device CPU mesh")
+class TestEagerShardedUpdate:
+    def _train(self, stage, steps=3):
+        set_flags({"sharding_stage": stage})
+        jmesh = _mesh()
+        del jmesh
+        paddle.seed(7)
+        m = paddle.nn.Sequential(paddle.nn.Linear(32, 64), paddle.nn.GELU(),
+                                 paddle.nn.Linear(64, 8))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        xs = np.random.RandomState(1).randn(steps, 16, 32).astype(np.float32)
+        losses = []
+        for i in range(steps):
+            x = paddle.Tensor(xs[i], stop_gradient=True)
+            loss = paddle.mean(m(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        return losses, m, opt
+
+    def test_bitwise_parity_and_sharded_moments(self):
+        l0, m0, o0 = self._train("")
+        l1, m1, o1 = self._train("zero1")
+        assert l0 == l1  # r_to_s slice + elementwise update: bit-exact
+        for (_, p0), (_, p1) in zip(m0.named_parameters(),
+                                    m1.named_parameters()):
+            np.testing.assert_array_equal(np.asarray(p0._value),
+                                          np.asarray(p1._value))
+        rep = zero1.opt_state_report(o1)
+        assert rep["ratio"] > 3.0, rep  # mixed tensor sizes: < full 8x
+        sharded = [r for r in rep["rows"] if r["sharded"]]
+        assert sharded
+        for r in sharded:
+            assert r["per_replica_bytes"] <= r["logical_bytes"] / 8 + 256 * 4
+
+    def test_state_dict_reaches_proxy_cells(self):
+        _, _, opt = self._train("zero1")
+        sd = opt.state_dict()
+        moment_keys = [k for k in sd if k.endswith("_moment1")]
+        assert len(moment_keys) == 4  # 2 weights + 2 biases
+        # sharded cells carry the flat padded shard-space shape
+        flat = [k for k in moment_keys
+                if len(sd[k]._value.shape) == 1]
+        assert flat, moment_keys
+
+
+# --------------------------------------------------- compiled TrainStep tier
+@pytest.mark.skipif(N_DEV < 8, reason="needs the 8-device CPU mesh")
+class TestTrainStepZero1:
+    """ISSUE 12 acceptance: gpt_tiny on the 8-device CPU mesh — zero1
+    convergence within 1e-4 of the unsharded fp32 run, bitwise
+    run-to-run deterministic, ~1/dp optimizer-state bytes, and the
+    engagement keyed into the compile cache."""
+
+    STEPS = 5
+    GATE = 1e-4
+
+    def _train(self, stage=None, steps=None):
+        from paddle_tpu.distributed.parallel import (replicate_layer,
+                                                     shard_batch)
+        from paddle_tpu.models import (GPTForCausalLM,
+                                       GPTPretrainingCriterion, gpt_tiny)
+
+        jmesh = _mesh()
+        cfg = gpt_tiny()
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        replicate_layer(model, jmesh)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = TrainStep(model=model, optimizer=opt,
+                         loss_fn=lambda ids: crit(model(ids), ids),
+                         sharding=stage)
+        rs = np.random.RandomState(0)
+        losses = []
+        for _ in range(steps or self.STEPS):
+            ids = paddle.Tensor(
+                rs.randint(0, cfg.vocab_size, (8, 32)).astype(np.int64),
+                stop_gradient=True)
+            shard_batch(ids, jmesh)
+            losses.append(float(step(ids).numpy()))  # noqa: TS107 (gate compares per-step losses on purpose)
+        return losses, step, opt
+
+    def test_convergence_within_gate_and_deterministic(self):
+        fp32, s0, _ = self._train()
+        z1, s1, opt = self._train("zero1")
+        z2, _, _ = self._train("zero1")
+        assert z1 == z2, "zero1 training must be bitwise reproducible"
+        deltas = [abs(a - b) / max(abs(a), 1e-9) for a, b in zip(fp32, z1)]
+        assert max(deltas) <= self.GATE, (fp32, z1)
+        assert s1.fallback_reason is None
+        assert s1._compiled.stats["eager_steps"] == 0
+        rep = zero1.opt_state_report(opt)
+        assert rep["ratio"] > 5.0, rep  # gpt_tiny is matrix-dominated
+        for r in rep["rows"]:
+            if r["sharded"]:
+                assert r["per_replica_bytes"] <= \
+                    r["logical_bytes"] / 8 + 256 * 4
+
+    def test_flag_flip_retraces_not_silently_reuses(self):
+        """FLAGS_sharding_stage is part of the static cache key: the
+        same TrainStep serves replicated and zero1 as separate
+        programs (ISSUE 12 acceptance: flag flips provably retrace)."""
+        _, step, _ = self._train(steps=2)
+        assert step.audit_report()["n_cache_keys"] == 1
+        builds0 = step.audit_report()["total_builds"]
+        set_flags({"sharding_stage": "zero1"})
+        from paddle_tpu.distributed.parallel import shard_batch
+
+        ids = paddle.Tensor(np.zeros((8, 32), np.int64), stop_gradient=True)
+        shard_batch(ids, _mesh())
+        float(step(ids).numpy())
+        report = step.audit_report()
+        assert report["n_cache_keys"] == 2
+        assert report["total_builds"] == builds0 + 1
+        # flipping back replays the FIRST program — no third build
+        set_flags({"sharding_stage": ""})
+        float(step(ids).numpy())
+        assert step.audit_report()["n_cache_keys"] == 2
+        assert step.audit_report()["total_builds"] == builds0 + 1
+
+    def test_explicit_replicated_overrides_flag(self):
+        set_flags({"sharding_stage": "zero1"})
+        _, step, opt = self._train("replicated", steps=1)
+        assert step._sharding_key() == "replicated"
+        rep = zero1.opt_state_report(opt)
+        assert all(not r["sharded"] for r in rep["rows"])
+
+    def test_cost_model_sees_the_residency_drop(self):
+        """The sharding-aware liveness walk prices the zero1 step's
+        moment cells at shard size: arg bytes drop vs the replicated
+        program, track XLA's memory_analysis within 1.3x, and
+        compare_with_measured reports the drop across all three tiers."""
+        from paddle_tpu.distributed.auto_parallel.planner import (
+            ModelSpec, compare_with_measured)
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+        _, s0, _ = self._train(steps=2)
+        _, s1, opt1 = self._train("zero1", steps=2)
+        r0, r1 = s0.cost(), s1.cost()
+        assert r1.arg_bytes < 0.55 * r0.arg_bytes, (r1.arg_bytes,
+                                                    r0.arg_bytes)
+        ma = s1._compiled.memory_analysis()
+        measured = int(ma.argument_size_in_bytes)
+        assert measured / 1.3 <= r1.arg_bytes <= measured * 1.3, \
+            (r1.arg_bytes, measured)
+        # the walk's resident-state drop IS the optimizer-state shard
+        # savings (moments now priced at 1/dp)
+        state = zero1.opt_state_report(opt1)
+        saved = state["replicated_bytes"] - state["per_replica_bytes"]
+        assert r0.arg_bytes - r1.arg_bytes >= 0.8 * saved, \
+            (r0.arg_bytes, r1.arg_bytes, saved)
+        # ISSUE 12 acceptance: the drop verified against
+        # compare_with_measured (cost-model peak tracks the sharded
+        # program's XLA ground truth)
+        paddle.seed(0)
+        spec = ModelSpec.from_model(GPTForCausalLM(gpt_tiny()), seq_len=32)
+        cmp0 = compare_with_measured(s0, spec, 8, {"dp_degree": 8})
+        cmp1 = compare_with_measured(
+            s1, spec, 8, {"dp_degree": 8, "zero_sharding": 8})
+        assert cmp1["xla"] is not None
+        # the residency drop is visible in BOTH the static walk and the
+        # XLA ground truth it calibrates against (the absolute peak
+        # ratio stays gated by test_cost_model's own 2x acceptance —
+        # transient overestimates on tiny batches are a separate,
+        # pre-existing looseness)
+        assert cmp1["cost_model"]["program_peak_bytes"] < \
+            cmp0["cost_model"]["program_peak_bytes"]
+        assert cmp1["xla"]["peak_bytes"] < cmp0["xla"]["peak_bytes"]
+        assert cmp1["cost_model"]["arg_bytes"] < \
+            0.55 * cmp0["cost_model"]["arg_bytes"]
+
+    def test_unknown_sharding_arg_rejected(self):
+        with pytest.raises(ValueError, match="sharding"):
+            TrainStep(model=None, optimizer=None, loss_fn=lambda: None,
+                      sharding="zero3")
+
+
+# --------------------------------------------------------- int8 gather tier
+@pytest.mark.skipif(N_DEV < 8, reason="needs the 8-device CPU mesh")
+class TestQuantizedGatherTier:
+    def _train(self, stage, quantize, steps=4):
+        set_flags({"sharding_stage": stage,
+                   "comm_quantize_dp_grads": quantize})
+        from paddle_tpu.distributed.parallel import (replicate_layer,
+                                                     shard_batch)
+
+        jmesh = _mesh()
+        paddle.seed(7)
+        m = paddle.nn.Sequential(paddle.nn.Linear(32, 64), paddle.nn.GELU(),
+                                 paddle.nn.Linear(64, 8))
+        replicate_layer(m, jmesh)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        step = TrainStep(model=m, optimizer=opt,
+                         loss_fn=lambda x: paddle.mean(m(x) ** 2))
+        xs = np.random.RandomState(1).randn(steps, 16, 32).astype(np.float32)
+        losses = []
+        for i in range(steps):
+            x = paddle.Tensor(xs[i], stop_gradient=True)
+            shard_batch(x, jmesh)
+            losses.append(float(step(x).numpy()))  # noqa: TS107 (loss-curve gate)
+        return losses, opt, step
+
+    def test_int8_gather_converges_with_master_shards(self):
+        fp32, _, _ = self._train("", False)
+        q1, opt, step = self._train("zero1", True)
+        q2, _, _ = self._train("zero1", True)
+        assert q1 == q2, "int8 gather must stay bitwise reproducible"
+        assert q1 != fp32, "the quantized gather never engaged"
+        deltas = [abs(a - b) / max(abs(a), 1e-9) for a, b in zip(fp32, q1)]
+        assert max(deltas) <= 0.05, (fp32, q1)  # quantization gate
+        assert q1[-1] < q1[0], "updates swallowed — master shard broken"
+        st = zero1.attached(opt)
+        assert st is not None and st._masters, "int8 tier needs masters"
+        for m in st._masters.values():
+            assert m._value.sharding.spec == jax.sharding.PartitionSpec(
+                "dp")
+        assert copt.axis_wire_dtypes().get("dp") == ["int8"]
+        # the engagement is in the static key: int8-gather and fp32
+        # programs never share a cache entry
+        assert step._sharding_key()[3] == "int8"
+
+    def test_masters_round_trip_through_plain_state_dict(self):
+        """state_dict emits the fp32 master shards; set_state_dict must
+        restore them (not silently drop them and rebuild from the
+        dequantized int8 weights, which would lose the accumulated
+        sub-quantum residual)."""
+        _, opt, _ = self._train("zero1", True, steps=2)
+        state = opt.state_dict()
+        master_keys = [k for k in state if k.endswith("_zero1_master")]
+        assert master_keys
+        ref = {k: np.asarray(state[k]._value).copy() for k in master_keys}
+
+        set_flags({"sharding_stage": "zero1",
+                   "comm_quantize_dp_grads": True})
+        paddle.seed(123)
+        m2 = paddle.nn.Sequential(paddle.nn.Linear(32, 64),
+                                  paddle.nn.GELU(),
+                                  paddle.nn.Linear(64, 8))
+        opt2 = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                      parameters=m2.parameters())
+        # same generated-name sequence (fresh build in the same order)
+        # is NOT guaranteed — remap the saved keys onto the twin's names
+        remap = {}
+        olds = sorted(master_keys)
+        news = sorted(p.name for p in m2.parameters()
+                      if zero1.plan_row(p.name, int(np.prod(p.shape)), 4,
+                                        8).sharded)
+        for old_k, new_name in zip(olds, news):
+            remap[f"{new_name}_zero1_master"] = ref[old_k]
+        full_state = {k: v for k, v in state.items()
+                      if not k.endswith("_zero1_master")}
+        full_state.update(remap)
+        opt2.set_state_dict(full_state)
+        st2 = zero1.attached(opt2)
+        assert st2 is not None and len(st2._masters) == len(master_keys)
+        for m_cell in st2._masters.values():
+            np.testing.assert_array_equal(np.asarray(m_cell._value),
+                                          remap[m_cell.name])
+            assert len(m_cell._value.sharding.device_set) == 8
+
+    def test_gather_dtype_keys_the_cache(self):
+        _, _, step = self._train("zero1", False)
+        assert step.audit_report()["n_cache_keys"] == 1
+        set_flags({"comm_quantize_dp_grads": True})
+        from paddle_tpu.distributed.parallel import shard_batch
+
+        x = paddle.Tensor(np.zeros((16, 32), np.float32),
+                          stop_gradient=True)
+        shard_batch(x, _mesh())
+        float(step(x).numpy())
+        assert step.audit_report()["n_cache_keys"] == 2
+
+
+# ---------------------------------------------------------- amp grad scaler
+@pytest.mark.skipif(N_DEV < 8, reason="needs the 8-device CPU mesh")
+class TestGradScalerInterop:
+    def test_priming_targets_the_shard_space_cells(self):
+        """GradScaler primes accumulators before its snapshot; under
+        zero1 the primed cells must BE the sharded shard-space cells the
+        first step updates (a param-keyed full-shape cell would make the
+        overflow rollback restore dead state)."""
+        _mesh()
+        set_flags({"sharding_stage": "zero1"})
+        paddle.seed(11)
+        m = paddle.nn.Linear(64, 64)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        x = paddle.Tensor(np.random.RandomState(0).randn(8, 64).astype(
+            np.float32), stop_gradient=True)
+        loss = paddle.mean(m(x) ** 2)
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        st = zero1.attached(opt)
+        w = m.parameters()[0]
+        cell = st.cell_for(opt._accumulators["moment1"], w)
+        assert cell is not None and len(cell._value.shape) == 1
+        assert len(cell._value.sharding.device_set) == 8
+        # exactly one moment cell per param: priming and the step agreed
+        assert len(opt._accumulators["moment1"]) == 2
+
+
+# ------------------------------------------------------ engagement plumbing
+class TestEngagement:
+    def test_disengaged_without_mesh_axis(self):
+        m = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        set_flags({"sharding_stage": "zero1"})
+        if N_DEV >= 8:
+            dist.init_parallel_env({"dp": 1, "mp": 8})
+            try:
+                assert zero1.step_spec(opt) is None  # dp axis size 1
+            finally:
+                dist.init_parallel_env({"dp": 8, "mp": 1})
+        else:
+            assert zero1.step_spec(opt) is None
+
+    @pytest.mark.skipif(N_DEV < 8, reason="needs the 8-device CPU mesh")
+    def test_group_sharded_parallel_attaches_and_engages(self):
+        _mesh()
+        m = paddle.nn.Linear(32, 32)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        assert zero1.step_spec(opt) is None
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        m, opt, _ = group_sharded_parallel(m, opt, level="os")
+        spec = zero1.step_spec(opt)
+        assert spec is not None and spec[1] == "dp" and spec[2] == 8
+        # explicit per-step override still wins
+        opt._sharding_override = "replicated"
+        assert zero1.step_spec(opt) is None
+        opt._sharding_override = None
+
+    def test_bad_level_rejected(self):
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        with pytest.raises(ValueError, match="group_sharded level"):
+            group_sharded_parallel(None, None, level="bogus")
+
+
+# -------------------------------------------------------- sharded checkpoint
+@pytest.mark.skipif(N_DEV < 8, reason="needs the 8-device CPU mesh")
+class TestShardedCheckpoint:
+    def _train(self, steps=2):
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        _mesh()
+        paddle.seed(3)
+        m = paddle.nn.Sequential(paddle.nn.Linear(32, 64), paddle.nn.GELU(),
+                                 paddle.nn.Linear(64, 8))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        m, opt, _ = group_sharded_parallel(m, opt, level="os")
+        xs = np.random.RandomState(2).randn(steps + 2, 16, 32).astype(
+            np.float32)
+        for i in range(steps):
+            x = paddle.Tensor(xs[i], stop_gradient=True)
+            loss = paddle.mean(m(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return m, opt, xs
+
+    def test_round_trip_restores_sharded_state_bitwise(self, tmp_path):
+        from paddle_tpu.distributed.sharding import (
+            load_group_sharded_model, save_group_sharded_model)
+
+        m, opt, xs = self._train()
+        path = str(tmp_path / "ckpt")
+        save_group_sharded_model(m, path, opt)
+
+        import glob
+        import os
+
+        shard_files = glob.glob(path + ".pdopt.shard*of*")
+        assert shard_files, "sharded save produced no shard file"
+        # the shard file holds pieces, not gathered tensors: it must be
+        # FAR smaller than world_size times the state
+        assert os.path.getsize(path + ".pdparams") > 0
+
+        paddle.seed(99)  # fresh, differently-initialized twin
+        m2 = paddle.nn.Sequential(paddle.nn.Linear(32, 64),
+                                  paddle.nn.GELU(),
+                                  paddle.nn.Linear(64, 8))
+        opt2 = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                      parameters=m2.parameters())
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        m2, opt2, _ = group_sharded_parallel(m2, opt2, level="os")
+        load_group_sharded_model(m2, path, opt2)
+
+        # params restored
+        for (_, p), (_, q) in zip(m.named_parameters(),
+                                  m2.named_parameters()):
+            np.testing.assert_array_equal(np.asarray(p._value),
+                                          np.asarray(q._value))
+        # sharded moments restored bitwise AND re-scattered
+        st, st2 = zero1.attached(opt), zero1.attached(opt2)
+        e1 = {(a, b): c for a, b, c, _ in st.shard_entries(opt)}
+        e2 = {(a, b): c for a, b, c, _ in st2.shard_entries(opt2)}
+        # param names differ between instances; compare by position
+        assert len(e1) == len(e2) and len(e1) > 0
+        for (k1, c1), (k2, c2) in zip(sorted(e1.items(), key=str),
+                                      sorted(e2.items(), key=str)):
+            assert k1[1] == k2[1]  # same state name
+            np.testing.assert_array_equal(np.asarray(c1._value),
+                                          np.asarray(c2._value))
+            assert len(c2._value.sharding.device_set) == 8
+        assert int(opt2._step_count) == int(opt._step_count)
+
+        # and training continues identically from the restored state
+        def cont(model, o):
+            x = paddle.Tensor(xs[-1], stop_gradient=True)
+            loss = paddle.mean(model(x) ** 2)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return float(loss.numpy())
+
+        assert cont(m, opt) == cont(m2, opt2)
+
+    def test_legacy_unsharded_save_still_round_trips(self, tmp_path):
+        from paddle_tpu.distributed.sharding import (
+            load_group_sharded_model, save_group_sharded_model)
+
+        paddle.seed(5)
+        m = paddle.nn.Linear(8, 8)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        path = str(tmp_path / "legacy")
+        save_group_sharded_model(m, path, opt)
+        load_group_sharded_model(m, path, opt)  # no shard files: legacy
+
+
+# ----------------------------------------------------- planner / cost model
+class TestPlannerPricing:
+    def test_estimate_step_cost_prices_the_pair(self):
+        from paddle_tpu.distributed.auto_parallel.planner import (
+            ModelSpec, Plan, estimate_step_cost)
+
+        spec = ModelSpec(num_params=10_000_000, num_layers=4)
+        repl = estimate_step_cost(spec, 64, Plan(dp=8, mp=1, pp=1),
+                                  comm_quantize=False)
+        z = estimate_step_cost(spec, 64, Plan(dp=8, mp=1, pp=1, sharding=8),
+                               comm_quantize=False)
+        assert z["zero1"] and not repl["zero1"]
+        # fp32 rs+ag == the all-reduce ring (same bytes, ~1% padding)
+        assert z["dp_comm_bytes"] == pytest.approx(repl["dp_comm_bytes"],
+                                                   rel=0.02)
+        zq = estimate_step_cost(spec, 64, Plan(dp=8, mp=1, pp=1, sharding=8),
+                                comm_quantize=True)
+        # int8 gather: the ag half's bytes halve (bf16 grads: int8+scales
+        # ≈ 1.02 bytes/elem vs 2) → the pair lands at ~3/4 the fp32 ring
+        assert zq["dp_comm_bytes"] < 0.8 * z["dp_comm_bytes"]
+
+    def test_memory_estimate_divides_opt_state(self):
+        from paddle_tpu.distributed.auto_parallel.planner import (
+            ModelSpec, estimate_per_device_bytes)
+
+        spec = ModelSpec(num_params=10_000_000, num_layers=4)
+        full = estimate_per_device_bytes(spec, 64, 8, 1, 1, sharding=1)
+        shard = estimate_per_device_bytes(spec, 64, 8, 1, 1, sharding=8)
+        assert shard < full
+
+    @pytest.mark.skipif(N_DEV < 8, reason="needs the 8-device CPU mesh")
+    def test_cost_model_volume_matches_accounting_within_1_3x(self):
+        """ISSUE 12 acceptance: the static cost model's predicted wire
+        bytes for the reduce-scatter/all-gather pair track the zero1
+        accounting within 1.3x."""
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from paddle_tpu.analysis.cost_model import cost_jaxpr
+        from paddle_tpu.base.jax_compat import shard_map
+
+        n, numel = 8, 512 * 64
+        mesh = Mesh(np.array(jax.devices()[:n]).reshape(n), ("dp",))
+
+        def rs_ag(x):
+            shard = jax.lax.psum_scatter(x, "dp", scatter_dimension=0,
+                                         tiled=True)
+            return jax.lax.all_gather(shard - 0.01 * shard, "dp", axis=0,
+                                      tiled=True)
+
+        f = shard_map(rs_ag, mesh=mesh, in_specs=P(), out_specs=P(),
+                      check_vma=False)
+        closed = jax.make_jaxpr(f)(jnp.ones((numel,), jnp.float32))
+        predicted = cost_jaxpr(closed).comm_bytes["dp"]
+        measured = zero1.zero1_wire_report([("g", numel, 4)], n)["wire_bytes"]
+        assert measured / 1.3 <= predicted <= measured * 1.3, \
+            (predicted, measured)
+
+    def test_cost_jaxpr_arg_divisors_shrink_the_liveness_peak(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.analysis.cost_model import cost_jaxpr
+
+        def f(m, g):
+            m2 = 0.9 * m + 0.1 * g
+            return m2
+
+        closed = jax.make_jaxpr(f)(jnp.ones((8, 1024)), jnp.ones((8, 1024)))
+        base = cost_jaxpr(closed)
+        sharded = cost_jaxpr(closed, arg_divisors=[8.0, 8.0])
+        assert sharded.arg_bytes == base.arg_bytes // 8
+        assert sharded.peak_bytes < base.peak_bytes
+
+
+@pytest.mark.skipif(N_DEV < 8, reason="needs the 8-device CPU mesh")
+class TestEnginePrepare:
+    def _engine(self):
+        from paddle_tpu.distributed.auto_parallel.engine import DistEngine
+        from paddle_tpu.models import (GPTForCausalLM,
+                                       GPTPretrainingCriterion, gpt_tiny)
+
+        paddle.seed(0)
+        model = GPTForCausalLM(gpt_tiny())
+        crit = GPTPretrainingCriterion(model.config)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        return DistEngine(model, loss=lambda o, y: crit(o, y),
+                          optimizer=opt), model
+
+    def test_zero1_candidates_ranked_and_reshard_priced(self):
+        eng, _ = self._engine()
+        eng.prepare(batch_size=8, seq_len=64, n_devices=8,
+                    shard_params=False)
+        z_rows = [r for r in eng.cost_report
+                  if r.get("zero_sharding", 1) > 1]
+        assert z_rows, eng.cost_report
+        scored = [r for r in eng.cost_report if "score_seconds" in r]
+        assert scored and all("reshard_bytes" in r for r in scored)
+        # fresh replicated params: r_to_s is a comm-free slice
+        assert all(r["reshard_bytes"] == 0.0 for r in scored)
+
+    def test_memory_pressure_tips_the_plan_to_zero1(self):
+        """With mp/pp structurally infeasible (1 layer, 1 head) and the
+        HBM budget between the replicated and sharded footprints, only
+        the zero1 candidates survive pruning — prepare picks one and
+        auto-appends the sharding pass."""
+        import types
+
+        from paddle_tpu.distributed.auto_parallel.engine import DistEngine
+        from paddle_tpu.distributed.auto_parallel.planner import (
+            ModelSpec, estimate_per_device_bytes)
+
+        paddle.seed(0)
+        model = paddle.nn.Linear(256, 256)
+        model.config = types.SimpleNamespace(
+            num_hidden_layers=1, num_attention_heads=1, hidden_size=256,
+            vocab_size=256, max_position_embeddings=8)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        eng = DistEngine(model, loss=lambda o, y: paddle.mean(o),
+                         optimizer=opt)
+        spec = ModelSpec.from_model(model, seq_len=8)
+        full = estimate_per_device_bytes(spec, 8, 8, 1, 1, sharding=1)
+        shard = estimate_per_device_bytes(spec, 8, 8, 1, 1, sharding=8)
+        budget = (full + shard) // 2  # replicated OOMs, zero1 fits
+        plan = eng.prepare(batch_size=8, seq_len=8, n_devices=8,
+                           hbm_bytes=budget, shard_params=False)
+        assert plan.sharding > 1, (plan.describe, eng.cost_report[:6])
+        assert "zero1" in plan.reason
+        assert "sharding_stage1" in eng._passes
+        # the replicated dp=8 twin was memory-pruned, visibly
+        assert any(r.get("pruned") == "oom"
+                   and r.get("zero_sharding", 1) == 1
+                   and r["plan"][0] == 8 for r in eng.cost_report)
+
+
+# ------------------------------------------------------------- lint family
+class TestZero1Lint:
+    def _clean_report(self):
+        from paddle_tpu.analysis.comm_check import record_demo_comm
+
+        return record_demo_comm()
+
+    def test_qz804_parity_break(self):
+        from paddle_tpu.analysis.comm_check import audit_comm
+
+        rep = self._clean_report()
+        assert rep["zero1_wire_checked"]
+        rep["zero1_parity_max_err"] = 0.5
+        assert [f.code for f in audit_comm(rep)] == ["QZ804"]
+        rep["zero1_parity_max_err"] = None
+        assert [f.code for f in audit_comm(rep)] == ["QZ804"]
+        # the int8 gather tier inherits the quantization gate instead
+        rep["zero1_gather_dtype"] = "int8"
+        rep["zero1_parity_max_err"] = 0.01
+        assert audit_comm(rep) == []
+
+    def test_qz805_padding_waste(self):
+        from paddle_tpu.analysis.comm_check import audit_comm
+
+        rep = self._clean_report()
+        rep["zero1_plan"] = [
+            {"name": "no_win", "numel": 100, "sharded": True,
+             "shard_elems": 256, "block": 256, "pad_per_shard": 39.0},
+            {"name": "wastes_a_block", "numel": 100000, "sharded": True,
+             "shard_elems": 12800, "block": 256, "pad_per_shard": 300.0},
+            {"name": "fine", "numel": 4096, "sharded": True,
+             "shard_elems": 512, "block": 256, "pad_per_shard": 0.0},
+        ]
+        findings = audit_comm(rep)
+        assert [f.code for f in findings] == ["QZ805", "QZ805"]
+        assert "no_win" in findings[0].message
+        assert "wastes_a_block" in findings[1].message
