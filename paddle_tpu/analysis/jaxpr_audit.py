@@ -69,6 +69,15 @@ JX331  cold ladder             the engine serves without warmup, or rungs
                                of its bucket ladder were never
                                warm-compiled: the first live request on a
                                cold rung pays the compile (warning)
+JX332  KV pool growth          a decode engine's KV slot pool changed its
+                               device footprint after warmup — the pool
+                               must be allocated once and reuse slots
+                               (O(max_slots) residency, not O(traffic))
+                               (error)
+JX333  slot leak               KV slots remain allocated with no active
+                               request: a retired sequence never released
+                               its slot and the pool will exhaust
+                               (warning)
 
 Entry points: ``CompiledFunction.audit()`` / ``TrainStep.audit()`` (this
 module's :func:`audit_compiled_function`), and the ``jaxpr`` analyzer of
@@ -506,13 +515,34 @@ def audit_serving(engine) -> List[Finding]:
     predictor = getattr(engine, "predictor", None)
     prog = getattr(predictor, "_batch_program", None)
     if prog is not None and getattr(prog, "warmed", None) is not None:
-        missing = sorted(set(prog.ladder) - set(prog.warmed))
+        rungs = getattr(prog, "rungs", None) or prog.ladder
+        missing = sorted(set(rungs) - set(prog.warmed))
         if missing and delta is not None:
             findings.append(Finding(
                 "serving", "JX331", "warning",
                 f"bucket rungs {missing} were never warm-compiled — the "
                 "first live batch assembled at those rungs compiles "
                 "mid-traffic", name))
+
+    # KV-cache decode engines (serving/kv_cache.py): the pool must be
+    # allocated ONCE — steady state reuses freed slots, never grows
+    pool = getattr(engine, "kv_pool", None)
+    if pool is not None:
+        baseline = getattr(pool, "bytes_at_warmup", None)
+        if baseline is not None and pool.device_bytes() != baseline:
+            findings.append(Finding(
+                "serving", "JX332", "error",
+                f"KV slot pool device bytes changed after warmup "
+                f"({baseline} -> {pool.device_bytes()}) — the pool must be "
+                "allocated once and reuse slots; growth means decode "
+                "memory is O(traffic), not O(max_slots)", name))
+        if (not getattr(engine, "active_requests", lambda: 0)()
+                and pool.in_use() > 0):
+            findings.append(Finding(
+                "serving", "JX333", "warning",
+                f"{pool.in_use()} KV slot(s) still allocated with no "
+                "active request — a retired sequence leaked its slot and "
+                "the pool will exhaust under sustained traffic", name))
     return findings
 
 
@@ -556,6 +586,54 @@ def record_demo_engine(tmpdir: str):
     rs = np.random.RandomState(0)
     for tenant, n in (("a", 1), ("b", 3), ("a", 2), ("b", 4)):
         engine.run(tenant, rs.randn(n, 8).astype(np.float32))
+    engine.shutdown(drain=True)
+    return engine
+
+
+def record_demo_decode_engine():
+    """Build, warm and briefly drive the representative DECODE engine the
+    ``serving`` lint analyzer audits alongside the batch demo: a tiny GPT
+    behind a KV slot pool, two tenants' mixed prompts joining and leaving
+    the running batch. Exercises the full KV path — prefill grid, decode
+    rungs, slot alloc/release — so JX330-JX333 all see real state. One
+    definition so the CLI and the test gate audit the SAME engine."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from ..base import global_state
+    from ..profiler.pipeline import ServingStats
+
+    gen = global_state.default_generator
+    prev_seed = gen._seed
+    prev_cell = gen._cell
+    prev_key = None if prev_cell is None else prev_cell._value
+    try:
+        paddle.seed(0)
+        from ..models.gpt import GPTForCausalLM, gpt_tiny
+
+        model = GPTForCausalLM(gpt_tiny(
+            num_hidden_layers=1, hidden_size=32, num_attention_heads=2,
+            max_position_embeddings=32))
+        model.eval()
+    finally:
+        gen._seed = prev_seed
+        if prev_cell is None:
+            gen._cell = None
+        else:
+            gen._cell = prev_cell
+            prev_cell._replace_value(prev_key)
+
+    from ..serving import DecodeEngine
+
+    engine = DecodeEngine(model, max_slots=2, max_seq=16, seq_buckets=[8],
+                          prefill_max_batch=2, stats=ServingStats())
+    engine.warmup()
+    rs = np.random.RandomState(0)
+    reqs = [engine.submit(t, rs.randint(0, 512, size=n).astype(np.int32),
+                          max_new_tokens=3)
+            for t, n in (("a", 4), ("b", 6), ("a", 3))]
+    for r in reqs:
+        r.result(60)
     engine.shutdown(drain=True)
     return engine
 

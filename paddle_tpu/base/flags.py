@@ -157,6 +157,28 @@ define_flag("serving_batch_timeout_ms", 2.0,
 define_flag("serving_slo_ms", 50.0,
             "serving tier: the latency SLO the bench/stats report "
             "requests/sec against (enqueue->complete, per request)")
+define_flag("serving_max_slots", 8,
+            "decode serving: KV cache slots held device-resident by "
+            "KVSlotPool — the hard cap on concurrently decoding sequences "
+            "(serving/kv_cache.py); memory is allocated ONCE at this size")
+define_flag("serving_max_seq", 0,
+            "decode serving: longest sequence (prompt + generated) a slot "
+            "holds; 0 defers to the model's max_position_embeddings")
+define_flag("serving_seq_bucket_min", 16,
+            "decode serving: smallest rung of the sequence-length bucket "
+            "ladder (powers of two from here up to serving_max_seq); "
+            "prefill prompts pad up to their rung")
+define_flag("serving_prefill_max_batch", 4,
+            "decode serving: largest prefill batch rung — prompts sharing "
+            "a seq rung group up to this many per prefill program call")
+define_flag("serving_request_ttl_ms", 0.0,
+            "serving tier: expire requests whose queue wait exceeds this "
+            "(AdmissionError reason='ttl', serving.expired counter) "
+            "instead of executing dead work; <=0 disables")
+define_flag("serving_bulk_queue_share", 0.5,
+            "serving tier: fraction of serving_max_queue a bulk-tier "
+            "tenant may fill — the headroom above it is reserved for "
+            "interactive tiers (AdmissionController.set_tier)")
 define_flag("cost_while_default_trips", 1,
             "cost model: trip-count multiplier assumed for a while-loop "
             "whose counter pattern cannot be statically derived (1 keeps "

@@ -656,12 +656,47 @@ def save_sharded_optimizer_state(optimizer, path_prefix: str) -> dict:
     return manifest
 
 
+def _reslice_piece(by_off: dict, start: int, length: int, entry: dict,
+                   pname: str, sname: str):
+    """One target shard slice ``[start, start+length)`` of the flat
+    padded space, assembled from saved pieces of a DIFFERENT layout.
+    Copies only the overlapping ranges (O(shard) residency — the full
+    tensor never materializes); target elements past the old padded span
+    are new-layout shard padding and stay zero. Real data
+    (``[0, numel)``) must be fully covered by saved pieces — a gap there
+    is an incomplete shard-file set and fails loudly."""
+    import numpy as np
+
+    sample = next(iter(by_off.values()))
+    out = np.zeros(length, dtype=sample.dtype)
+    end = start + length
+    covered = np.zeros(length, dtype=bool)
+    for off, arr in by_off.items():
+        lo = max(start, int(off))
+        hi = min(end, int(off) + arr.shape[0])
+        if lo >= hi:
+            continue
+        out[lo - start: hi - start] = arr[lo - off: hi - off]
+        covered[lo - start: hi - start] = True
+    real_end = min(end, int(entry["numel"]))
+    if real_end > start and not covered[: real_end - start].all():
+        raise ValueError(
+            f"sharded state {pname}/{sname}: saved pieces "
+            f"(axis_size={entry['axis_size']}) do not cover "
+            f"[{start}, {real_end}) of the flat value — shard file set "
+            "incomplete; cannot re-slice onto the new topology")
+    return out
+
+
 def load_sharded_optimizer_state(optimizer, path_prefix: str) -> int:
     """Round-trip of :func:`save_sharded_optimizer_state`: host state
     restores through ``set_state_dict``; each shard file re-scatters its
     pieces straight to the owning devices (``device_put`` per piece +
     ``make_array_from_single_device_arrays`` — the full tensor never
-    materializes on host). Returns the number of sharded cells
+    materializes on host). A checkpoint saved under a DIFFERENT dp/
+    sharding degree (dp=8 pieces onto dp=4 and vice versa) re-slices the
+    pieces onto the new shard grid at load (:func:`_reslice_piece`)
+    instead of rejecting the layout. Returns the number of sharded cells
     restored."""
     import glob
     import os
@@ -713,23 +748,35 @@ def load_sharded_optimizer_state(optimizer, path_prefix: str) -> int:
             continue
         pname = p.name
         row = st.row(p, n)
-        if e["padded"] != row.padded or e["axis_size"] != n:
-            raise ValueError(
-                f"sharded state {pname}/{sname}: saved layout "
-                f"(padded={e['padded']}, axis_size={e['axis_size']}) does "
-                f"not match the installed mesh's (padded={row.padded}, "
-                f"axis_size={n}) — re-scatter across topologies is not "
-                "supported yet")
+        resliced = e["padded"] != row.padded or e["axis_size"] != n
+        if resliced:
+            # CHANGED topology (e.g. a dp=8 checkpoint onto dp=4): the
+            # logical flat value is identical, only the shard grid moved —
+            # re-slice the saved pieces onto the new offsets instead of
+            # rejecting the layout. O(shard) per target slice: each new
+            # piece copies only the old-piece ranges overlapping it
+            # (regions past the old padded span are shard padding, zeros
+            # by construction).
+            from ...base.log import get_logger
+
+            get_logger().info(
+                "load_sharded_optimizer_state: re-slicing %s/%s from "
+                "axis_size=%d (padded=%d) onto axis_size=%d (padded=%d)",
+                pname, sname, e["axis_size"], e["padded"], n, row.padded)
         by_off = {off: np.asarray(arr) for off, arr in e["pieces"]}
         idx_map = sharding.addressable_devices_indices_map((row.padded,))
         arrays = []
         for dev, idx in idx_map.items():
             off = int(idx[0].start or 0)
-            piece = by_off.get(off)
-            if piece is None:
-                raise ValueError(
-                    f"sharded state {pname}/{sname}: no saved piece for "
-                    f"offset {off} — shard file set incomplete")
+            if resliced:
+                piece = _reslice_piece(by_off, off, row.shard_elems, e,
+                                       pname, sname)
+            else:
+                piece = by_off.get(off)
+                if piece is None:
+                    raise ValueError(
+                        f"sharded state {pname}/{sname}: no saved piece "
+                        f"for offset {off} — shard file set incomplete")
             arrays.append(jax.device_put(piece, dev))
         value = jax.make_array_from_single_device_arrays(
             (row.padded,), sharding, arrays)

@@ -167,13 +167,45 @@ class _BatchProgram:
     must leave ``traces`` unchanged (``analysis`` JX330 audits exactly
     this delta)."""
 
-    def __init__(self, layer, dynamic_axes: Sequence, ladder: Sequence[int]):
+    def __init__(self, layer, dynamic_axes: Sequence, ladder: Sequence[int],
+                 seq_ladder: Optional[Sequence[int]] = None,
+                 dynamic_ranks: Optional[Sequence] = None):
         import jax
 
         self._exported = layer._exported
         self._params = jax.device_put(layer._params)
-        self.dynamic_axes = {int(i): int(ax) for i, ax in dynamic_axes}
+        # which LADDER each dynamic axis rides: rank 0 = batch, rank 1 =
+        # sequence (jit.save's per-rank symbols). Legacy exports without
+        # ranks bound every None dim to the one batch symbol — rank 0.
+        self.dynamic_ranks = {(int(i), int(ax)): int(r)
+                              for i, ax, r in (dynamic_ranks or [])}
+        # input -> BATCH axis only (rank 0): a two-axis input would
+        # otherwise collapse {(0,0),(0,1)} into {0: seq_axis} and batch
+        # assembly would stack along the wrong dim
+        if self.dynamic_ranks:
+            self.dynamic_axes = {i: ax for (i, ax), r
+                                 in self.dynamic_ranks.items() if r == 0}
+        else:
+            self.dynamic_axes = {int(i): int(ax) for i, ax in dynamic_axes}
         self.ladder = sorted(int(b) for b in ladder)
+        # second bucket axis (seq-dynamic exports): rungs become (b, s)
+        # pairs over the grid; None keeps the historical one-axis contract
+        self.seq_ladder = (sorted(int(s) for s in seq_ladder)
+                           if seq_ladder else None)
+        # which OUTPUT leaf axes carry the seq symbol ("s"), read from the
+        # exported module's symbolic out_avals — the seq pad is sliced
+        # back off exactly there, never by shape coincidence (a static
+        # axis that happens to equal the rung must survive untouched)
+        self.out_seq_axes: Dict[int, int] = {}
+        if self.seq_ladder is not None:
+            try:
+                for i, av in enumerate(self._exported.out_avals):
+                    for ax, d in enumerate(av.shape):
+                        if not isinstance(d, int) and str(d) == "s":
+                            self.out_seq_axes[i] = ax
+                            break
+            except Exception:
+                pass  # no metadata: outputs keep their pad (still correct rows)
         self.traces = 0          # += 1 per compiled specialization
         self.warmed: List[int] = []
         # persistent compile cache (paddle_tpu.compile_cache): rungs served
@@ -203,15 +235,31 @@ class _BatchProgram:
         self._donate = donate
         self._jitted = jax.jit(_fwd, donate_argnums=donate)
 
+    @property
+    def rungs(self) -> List:
+        """Every warmup/serving rung key: ints on the one-axis ladder,
+        ``(batch, seq)`` pairs over the two-axis grid."""
+        if self.seq_ladder is None:
+            return list(self.ladder)
+        from ..jit.bucketing import bucket_grid
+
+        return bucket_grid(self.ladder, self.seq_ladder)
+
+    @staticmethod
+    def _rung_key(bucket):
+        return tuple(int(b) for b in bucket) \
+            if isinstance(bucket, (tuple, list)) else int(bucket)
+
     def warmup(self, dtype_shapes: Sequence) -> None:
         """Compile every ladder rung once (zeros of the recorded specs) so
         live traffic replays warm executables. Idempotent per rung. With
         FLAGS_compile_cache on, each rung restores its AOT executable from
         the persistent store instead — a fully warm-disk replica restores
-        the WHOLE ladder with zero traces and zero compiles
-        (``traces == 0`` and ``restored == ladder`` after warmup)."""
+        the WHOLE ladder (the full two-axis grid for seq-dynamic exports)
+        with zero traces and zero compiles (``traces == 0`` and
+        ``restored == rungs`` after warmup)."""
         with self._lock:
-            for bucket in self.ladder:
+            for bucket in self.rungs:
                 if bucket in self.warmed:
                     continue
                 if self._warm_from_cache(bucket, dtype_shapes):
@@ -222,7 +270,7 @@ class _BatchProgram:
                 self(zeros, bucket)
                 self.warmed.append(bucket)
 
-    def _rung_digest(self, bucket: int, dtype_shapes: Sequence):
+    def _rung_digest(self, bucket, dtype_shapes: Sequence):
         """Static key for one rung's executable: exported-module content
         hash + padded input specs + donation spec (+ the environment
         fingerprint inside derive_digest). None when the model carries no
@@ -238,9 +286,10 @@ class _BatchProgram:
         return cc.derive_digest(
             "serving", ("serving", self._content_hash,
                         tuple(sorted(self.dynamic_axes.items())),
+                        tuple(sorted(self.dynamic_ranks.items())),
                         tuple(self._donate), shapes))
 
-    def _warm_from_cache(self, bucket: int, dtype_shapes: Sequence) -> bool:
+    def _warm_from_cache(self, bucket, dtype_shapes: Sequence) -> bool:
         """Arm one rung through the persistent tier: disk restore (zero
         traces) or AOT compile-and-publish (one trace — the same one the
         legacy ``self(zeros, bucket)`` warmup pays). False defers to the
@@ -252,9 +301,10 @@ class _BatchProgram:
         digest = self._rung_digest(bucket, dtype_shapes)
         if digest is None:
             return False
-        compiled = cc.load_executable(digest, site=f"serving:b{bucket}")
+        compiled = cc.load_executable(
+            digest, site=f"serving:b{self._rung_key(bucket)}")
         if compiled is not None:
-            self._aot[bucket] = compiled
+            self._aot[self._rung_key(bucket)] = compiled
             self.restored.append(bucket)
             return True
         zeros = [np.zeros(self._bucket_shape(i, s, bucket), np.dtype(d))
@@ -263,19 +313,29 @@ class _BatchProgram:
         compiled = lowered.compile()
         cc.store_executable(
             digest, compiled,
-            key_meta={"site": "serving", "bucket": int(bucket),
+            key_meta={"site": "serving", "bucket": repr(self._rung_key(bucket)),
                       "model": (self._content_hash or "")[:16]})
-        self._aot[bucket] = compiled
+        self._aot[self._rung_key(bucket)] = compiled
         return True
 
     def _bucket_shape(self, idx, spec_shape, bucket):
-        # dynamic axes were recorded as None in the spec; fixed-shape
-        # exports have all-int specs and a single-rung ladder
-        return tuple(bucket if d is None else d for d in spec_shape)
+        # dynamic axes were recorded as None in the spec; each one
+        # substitutes its own ladder's rung (rank 0 = batch, rank 1 = seq).
+        # Fixed-shape exports have all-int specs and a single-rung ladder.
+        rung = bucket if isinstance(bucket, (tuple, list)) else (bucket,)
+        out = []
+        for ax, d in enumerate(spec_shape):
+            if d is None:
+                rank = self.dynamic_ranks.get((idx, ax), 0)
+                out.append(int(rung[min(rank, len(rung) - 1)]))
+            else:
+                out.append(d)
+        return tuple(out)
 
-    def __call__(self, arrays: Sequence, bucket: int):
-        """Run one assembled batch already padded to ``bucket``."""
-        ex = self._aot.get(bucket)
+    def __call__(self, arrays: Sequence, bucket):
+        """Run one assembled batch already padded to ``bucket`` (an int on
+        the one-axis ladder, a ``(batch, seq)`` pair on the grid)."""
+        ex = self._aot.get(self._rung_key(bucket))
         if ex is not None:
             # AOT-armed rung (persistent tier): a Compiled cannot retrace,
             # so the compile-event bookkeeping below has nothing to see
@@ -328,6 +388,11 @@ class Predictor:
         self._outputs: List[Tensor_] = []
         self._input_shapes = meta.get("input_shapes")
         self._dynamic_axes = list(meta.get("dynamic_axes") or [])
+        # per-rank symbol binding (two-axis exports); legacy models saved
+        # before dynamic_ranks bound every None dim to the batch symbol
+        self._dynamic_ranks = list(
+            meta.get("dynamic_ranks")
+            or [(i, ax, 0) for i, ax in self._dynamic_axes])
         self._batch_program = _shared_batch
         if _shared_layer is None and self._input_shapes:
             self._warmup()
@@ -358,8 +423,22 @@ class Predictor:
         return bool(self._dynamic_axes)
 
     @property
+    def dynamic_seq(self) -> bool:
+        """True when the export carries a second (sequence) symbolic dim
+        — ``run_many`` then serves from the two-axis (batch x seq) bucket
+        grid instead of the one-axis batch ladder."""
+        return any(r == 1 for _, _, r in self._dynamic_ranks)
+
+    @property
     def batch_ladder(self) -> List[int]:
         return list(self._ensure_batch_program().ladder)
+
+    @property
+    def seq_ladder(self) -> Optional[List[int]]:
+        """The sequence-length rungs of a two-axis export (None on
+        batch-only exports)."""
+        sl = self._ensure_batch_program().seq_ladder
+        return list(sl) if sl is not None else None
 
     @property
     def compile_count(self) -> int:
@@ -392,8 +471,17 @@ class Predictor:
                 # fixed-shape export: the ladder is the one exported batch
                 shape0 = (self._input_shapes or [([1], "float32")])[0][0]
                 ladder = [int(shape0[0])]
+            seq_ladder = None
+            if any(r == 1 for _, _, r in self._dynamic_ranks):
+                # two-axis export: the seq ladder defaults to powers of two
+                # from FLAGS_serving_seq_bucket_min up to FLAGS_serving_max_seq
+                # (128 when unset) — override via set_seq_ladder
+                max_seq = int(get_flag("serving_max_seq")) or 128
+                seq_ladder = powers_of_two_buckets(
+                    int(get_flag("serving_seq_bucket_min")), max_seq)
             self._batch_program = _BatchProgram(
-                self._layer, self._dynamic_axes, ladder)
+                self._layer, self._dynamic_axes, ladder,
+                seq_ladder=seq_ladder, dynamic_ranks=self._dynamic_ranks)
         return self._batch_program
 
     def set_batch_ladder(self, buckets: Sequence[int]) -> None:
@@ -405,6 +493,15 @@ class Predictor:
                              f"{prog.ladder}")
         prog.ladder = sorted(int(b) for b in buckets)
 
+    def set_seq_ladder(self, buckets: Sequence[int]) -> None:
+        """Override the sequence-length rungs of a two-axis export
+        (before :meth:`warmup_ladder`)."""
+        prog = self._ensure_batch_program()
+        if prog.seq_ladder is None:
+            raise ValueError("this export has no dynamic sequence axis; "
+                             "only the batch ladder applies")
+        prog.seq_ladder = sorted(int(b) for b in buckets)
+
     def warmup_ladder(self) -> List[int]:
         """AOT-compile every rung of the batch ladder; returns the rungs."""
         prog = self._ensure_batch_program()
@@ -414,37 +511,60 @@ class Predictor:
     def run_many(self, inputs: Sequence[np.ndarray], n: Optional[int] = None):
         """Serve a stacked request batch: each array in ``inputs`` carries
         ``n`` samples on its dynamic (batch) axis; the batch is padded up
-        the bucket ladder, run through the shared warm-compiled
-        specialization for that rung, and the outputs are sliced back to
-        ``n`` on axis 0. Returns a list of np arrays (one per output
-        leaf). Bit-exact with per-request :meth:`run`: padding rows never
-        feed back into real rows (row-independent inference programs)."""
+        the bucket ladder — and, on two-axis exports, the sequence axis up
+        ITS ladder — run through the shared warm-compiled specialization
+        for that rung, and the outputs are sliced back to ``n`` on axis 0
+        (and the real seq length on axis 1 for seq-dynamic exports).
+        Returns a list of np arrays (one per output leaf). Bit-exact with
+        per-request :meth:`run`: padding rows never feed back into real
+        rows (row-independent inference programs; causal/length-masked
+        along the padded seq axis)."""
         import jax
 
         from ..jit.bucketing import bucket_for
 
         prog = self._ensure_batch_program()
         arrays = [np.asarray(a) for a in inputs]
+        ranks = {(i, ax): r for i, ax, r in self._dynamic_ranks}
         if n is None:
             idx0, ax0 = (self._dynamic_axes or [(0, 0)])[0]
             n = arrays[idx0].shape[ax0]
         bucket = bucket_for(n, prog.ladder)
-        if bucket != n:
-            padded = []
-            dyn = (prog.dynamic_axes
-                   or {i: 0 for i in range(len(arrays))})
-            for i, a in enumerate(arrays):
-                if i in dyn:
-                    ax = dyn[i]
-                    widths = [(0, 0)] * a.ndim
-                    widths[ax] = (0, bucket - n)
-                    a = np.pad(a, widths)
-                padded.append(a)
-            arrays = padded
-        out = prog(arrays, bucket)
+        seq = seq_bucket = None
+        if prog.seq_ladder is not None:
+            seq = max(arrays[i].shape[ax]
+                      for (i, ax), r in ranks.items() if r == 1)
+            seq_bucket = bucket_for(seq, prog.seq_ladder)
+        # every dynamic axis pads up to its own ladder's rung
+        targets = {(i, ax): (seq_bucket if r == 1 else bucket)
+                   for (i, ax), r in ranks.items()}
+        if not targets:  # fixed-shape export: pad axis 0 to the one rung
+            targets = {(i, 0): bucket for i in range(len(arrays))}
+        padded = []
+        for i, a in enumerate(arrays):
+            widths = [(0, 0)] * a.ndim
+            changed = False
+            for ax in range(a.ndim):
+                target = targets.get((i, ax))
+                if target is not None and target > a.shape[ax]:
+                    widths[ax] = (0, target - a.shape[ax])
+                    changed = True
+            padded.append(np.pad(a, widths) if changed else a)
+        rung = (bucket, seq_bucket) if seq_bucket is not None else bucket
+        out = prog(padded, rung)
         leaves = jax.tree_util.tree_leaves(
             out, is_leaf=lambda x: hasattr(x, "shape"))
-        return [np.asarray(leaf)[:n] for leaf in leaves]
+        outs = []
+        for i, leaf in enumerate(leaves):
+            arr = np.asarray(leaf)[:n]
+            # slice the seq pad back off exactly where the export's
+            # out_avals carry the seq symbol (never by shape coincidence)
+            ax = prog.out_seq_axes.get(i)
+            if (ax is not None and seq_bucket is not None
+                    and seq != seq_bucket and arr.shape[ax] == seq_bucket):
+                arr = np.take(arr, range(seq), axis=ax)
+            outs.append(arr)
+        return outs
 
     def get_input_shapes(self):
         return {n: list(s) for n, (s, _) in zip(

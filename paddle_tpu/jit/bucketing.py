@@ -47,6 +47,24 @@ def bucket_for(n: int, buckets: Sequence[int]) -> int:
     raise ValueError(f"length {n} exceeds largest bucket {buckets[-1]}")
 
 
+def bucket_grid(batch_ladder: Sequence[int],
+                seq_ladder: Sequence[int]) -> List[tuple]:
+    """Every ``(batch, seq)`` rung pair of a two-axis ladder — the warmup
+    set of a seq-dynamic serving program (one compiled specialization per
+    pair; ``len(batch) · len(seq)`` programs total, all restorable whole
+    from the persistent compile cache)."""
+    return [(int(b), int(s)) for b in batch_ladder for s in seq_ladder]
+
+
+def bucket_pair_for(n: int, seq_len: int, batch_ladder: Sequence[int],
+                    seq_ladder: Sequence[int]) -> tuple:
+    """The two-axis rung for one request shape: batch count ``n`` and
+    sequence length ``seq_len`` each round up their own ladder
+    independently — a short prompt in a big batch never pays a long
+    rung's compute."""
+    return bucket_for(n, batch_ladder), bucket_for(seq_len, seq_ladder)
+
+
 def assemble_bucket(counts: Sequence[int], buckets: Sequence[int],
                     max_total: Optional[int] = None):
     """Mixed-size batch assembly for the serving tier: given the FIFO

@@ -47,29 +47,45 @@ def save(layer, path, input_spec=None, **configs):
 
         from ..base import dtype as dtype_mod
 
-        # A None dim in an InputSpec becomes the shared symbolic batch dim
-        # "b" (jax.export shape polymorphism): the exported module then
-        # serves ANY batch size, and the serving tier warm-compiles one
-        # specialization per bucket rung instead of one export per shape.
-        # All None dims share ONE symbol — mixed-rate dims would need a
-        # per-dim ladder the bucket scheduler does not assemble.
-        sym_b = []  # created lazily: symbolic_shape costs an export import
+        # A None dim in an InputSpec becomes a symbolic dim (jax.export
+        # shape polymorphism): the exported module then serves ANY size on
+        # that axis, and the serving tier warm-compiles one specialization
+        # per bucket rung instead of one export per shape. Symbols are
+        # assigned by RANK — the first None dim of every input shares "b"
+        # (the batch axis), the second shares "s" (the sequence axis), and
+        # so on — so a GPT forward exported with InputSpec([None, None])
+        # carries a TWO-AXIS ladder (batch x seq) from one module, while
+        # single-None exports keep the historical one-symbol contract.
+        _SYM_NAMES = ("b", "s", "d2", "d3")
+        # all symbols must share ONE scope: count the ranks first, then
+        # mint them together in a single symbolic_shape call
+        n_ranks = 0
+        for s in input_spec:
+            if not isinstance(s, Tensor) and hasattr(s, "shape"):
+                n_ranks = max(n_ranks,
+                              sum(1 for d in s.shape if d is None))
+        names = [(_SYM_NAMES[r] if r < len(_SYM_NAMES) else f"d{r}")
+                 for r in range(n_ranks)]
+        syms = (list(jax_export.symbolic_shape(", ".join(names)))
+                if names else [])
         dynamic_axes = []
+        dynamic_ranks = []  # (input_idx, axis, rank) triples
 
-        def _sym():
-            if not sym_b:
-                sym_b.append(jax_export.symbolic_shape("b")[0])
-            return sym_b[0]
+        def _sym(rank):
+            return syms[rank]
 
         def _as_shaped(s, idx):
             if isinstance(s, Tensor):
                 return unwrap(s)
             if hasattr(s, "shape") and hasattr(s, "dtype"):  # InputSpec
                 shape = list(s.shape)
+                rank = 0
                 for ax, d in enumerate(shape):
                     if d is None:
                         dynamic_axes.append((idx, ax))
-                        shape[ax] = _sym()
+                        dynamic_ranks.append((idx, ax, rank))
+                        shape[ax] = _sym(rank)
+                        rank += 1
                 return jax.ShapeDtypeStruct(tuple(shape), dtype_mod.np_dtype(s.dtype))
             return s
 
@@ -112,6 +128,9 @@ def save(layer, path, input_spec=None, **configs):
              str(a.dtype))
             for a in args_shaped]
         meta["dynamic_axes"] = dynamic_axes
+        # which symbol each dynamic axis bound to: rank 0 = the batch
+        # ladder, rank 1 = the sequence ladder (the two-axis bucket grid)
+        meta["dynamic_ranks"] = dynamic_ranks
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f)
 

@@ -31,7 +31,16 @@ def cast(x, dtype):
 def reshape(x, shape, name=None):
     if isinstance(shape, Tensor):
         shape = shape.tolist()
-    shape = tuple(int(s) for s in shape)
+
+    def _dim(s):
+        try:
+            return int(s)
+        except Exception:
+            # symbolic dim (jax.export shape polymorphism): jnp.reshape
+            # accepts it; int() would reject the dynamic-shape export
+            return s
+
+    shape = tuple(_dim(s) for s in shape)
     return primitive("reshape", lambda v: jnp.reshape(v, shape), [x])
 
 
@@ -164,7 +173,18 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
 def expand(x, shape, name=None):
     if isinstance(shape, Tensor):
         shape = shape.tolist()
-    shape = [int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in shape]
+
+    def _dim(s):
+        s = unwrap(s) if isinstance(s, Tensor) else s
+        try:
+            return int(s)
+        except Exception:
+            # symbolic dim (jax.export shape polymorphism) — int() raises
+            # InconclusiveDimensionOperation; pass it through, broadcast_to
+            # accepts symbolic sizes (anything else fails loudly there)
+            return s
+
+    shape = [_dim(s) for s in shape]
 
     def fn(v):
         tgt = list(shape)

@@ -121,6 +121,7 @@ class ServingStats:
             self.requests = 0
             self.samples = 0
             self.rejected = 0
+            self.expired = 0
             self.batches = 0
             self.padded_slots = 0
             self.batch_slots = 0
@@ -132,6 +133,16 @@ class ServingStats:
             #                                  "lat": bounded ring like _lat}
             self._t_first = None
             self._t_last = None
+            # decode tier (serving/decode.py): per-step prefill-vs-decode
+            # latency split, emitted-token throughput, slot occupancy
+            self._decode = {
+                "prefill_steps": 0, "decode_steps": 0,
+                "prefill_s": 0.0, "decode_s": 0.0,
+                "prefill_ms": [], "decode_ms": [],   # bounded rings
+                "tokens": 0, "t_first": None, "t_last": None,
+                "occ_sum": 0, "occ_samples": 0, "occ_peak": 0,
+                "slots": 0,
+            }
 
     def _tenant_cell(self, tenant) -> dict:
         # caller holds the lock
@@ -174,6 +185,53 @@ class ServingStats:
             if tenant is not None:
                 self._tenant_cell(tenant)["rejected"] += int(n)
 
+    def record_expired(self, n: int = 1, tenant: str = None):
+        """Requests whose queue wait outlived FLAGS_serving_request_ttl_ms
+        (failed with AdmissionError reason='ttl', never executed)."""
+        with self._lock:
+            self.expired += int(n)
+            if tenant is not None:
+                cell = self._tenant_cell(tenant)
+                cell["expired"] = cell.get("expired", 0) + int(n)
+
+    def retire_tenant(self, tenant: str) -> bool:
+        """Drop a tenant's stats lane (mid-traffic tenant churn): its
+        ring and counters leave ``summary()["tenants"]``; the global
+        aggregates keep everything it already contributed."""
+        with self._lock:
+            return self._tenants.pop(tenant, None) is not None
+
+    def record_decode_step(self, kind: str, seconds: float, n_lanes: int,
+                           n_tokens: int):
+        """One decode-tier program call: ``kind`` is ``"prefill"`` or
+        ``"decode"``; ``n_tokens`` real tokens were emitted by ``n_lanes``
+        real lanes (pad lanes excluded). Feeds the prefill-vs-decode
+        latency split and tokens/sec."""
+        now = time.perf_counter()
+        with self._lock:
+            cell = self._decode
+            cell[f"{kind}_steps"] += 1
+            cell[f"{kind}_s"] += float(seconds)
+            ring = cell[f"{kind}_ms"]
+            ring.append(float(seconds) * 1e3)
+            if len(ring) > self._max_samples:
+                del ring[: len(ring) - self._max_samples]
+            cell["tokens"] += int(n_tokens)
+            if cell["t_first"] is None:
+                cell["t_first"] = now - seconds
+            cell["t_last"] = now
+
+    def record_slot_occupancy(self, in_use: int, capacity: int):
+        """KV slot occupancy at a step boundary (peak proves slot reuse:
+        under oversubscribed traffic it reaches ``capacity`` while pool
+        bytes stay constant)."""
+        with self._lock:
+            cell = self._decode
+            cell["occ_sum"] += int(in_use)
+            cell["occ_samples"] += 1
+            cell["occ_peak"] = max(cell["occ_peak"], int(in_use))
+            cell["slots"] = max(cell["slots"], int(capacity))
+
     def record_batch(self, n_samples: int, bucket: int):
         """One dispatched batch: ``n_samples`` real rows padded to
         ``bucket`` slots (fill ratio = batching efficiency)."""
@@ -212,6 +270,7 @@ class ServingStats:
                 "requests": self.requests,
                 "samples": self.samples,
                 "rejected": self.rejected,
+                "expired": self.expired,
                 "batches": self.batches,
                 "slo_ms": slo_ms,
                 "p50_ms": (round(self._pct(total, 0.50) * 1e3, 3)
@@ -239,8 +298,42 @@ class ServingStats:
                 "tenants": {
                     name: self._tenant_summary(cell, window)
                     for name, cell in sorted(self._tenants.items())},
+                "decode": self._decode_summary(),
             }
         return out
+
+    def _decode_summary(self):
+        """The decode tier's split (caller holds the lock): prefill vs
+        decode step latency percentiles, emitted-token throughput, slot
+        occupancy. None when no decode steps ran (batch-only engines)."""
+        cell = self._decode
+        if not cell["prefill_steps"] and not cell["decode_steps"]:
+            return None
+        window = ((cell["t_last"] - cell["t_first"])
+                  if cell["t_first"] is not None else 0.0)
+        prefill = sorted(cell["prefill_ms"])
+        decode = sorted(cell["decode_ms"])
+
+        def pct(vals, q):
+            v = self._pct(vals, q)
+            return round(v, 3) if v is not None else None
+
+        return {
+            "prefill_steps": cell["prefill_steps"],
+            "decode_steps": cell["decode_steps"],
+            "prefill_p50_ms": pct(prefill, 0.50),
+            "prefill_p99_ms": pct(prefill, 0.99),
+            "decode_p50_ms": pct(decode, 0.50),
+            "decode_p99_ms": pct(decode, 0.99),
+            "tokens": cell["tokens"],
+            "tokens_per_sec": (round(cell["tokens"] / window, 1)
+                               if window > 0 else None),
+            "slot_occupancy_mean": (round(cell["occ_sum"]
+                                          / cell["occ_samples"], 2)
+                                    if cell["occ_samples"] else None),
+            "slot_occupancy_peak": cell["occ_peak"],
+            "slots": cell["slots"],
+        }
 
     def _tenant_summary(self, cell: dict, window: float) -> dict:
         """Per-tenant breakdown (caller holds the lock): latency
@@ -253,6 +346,7 @@ class ServingStats:
             "requests": cell["requests"],
             "samples": cell["samples"],
             "rejected": cell["rejected"],
+            "expired": cell.get("expired", 0),
             "p50_ms": (round(self._pct(total, 0.50) * 1e3, 3)
                        if total else None),
             "p99_ms": (round(self._pct(total, 0.99) * 1e3, 3)

@@ -15,8 +15,24 @@ Layers (each its own module, composable and separately testable):
   sharing), runs the scheduler thread, and proves zero steady-state
   retraces (``compiles_after_warmup == 0``, audited by JX330).
 
+Serving phase 2 (ISSUE 13) adds TRUE continuous batching for GPT decode:
+
+- :mod:`kv_cache`      — :class:`KVSlotPool`: ONE device-resident K/V
+  buffer pair ([layers, slots+1, seq, heads, dim], allocated once),
+  free-list slot alloc/release, functional in-place row updates under
+  donation;
+- :mod:`decode`        — :class:`DecodePrograms` (functional GPT
+  prefill/decode programs, one warm specialization per bucket rung,
+  whole set restorable from the persistent compile cache) and
+  :class:`DecodeEngine` (the decode front door: priority tiers, TTL,
+  per-tenant lanes);
+- :class:`DecodeScheduler` (in :mod:`scheduler`) — one
+  prefill-or-decode program call per step; requests join freed slots
+  mid-flight and leave the step they finish — no batch re-assembly.
+
 Latency accounting (enqueue→admit→dispatch→complete, queue depth,
-p50/p99, requests/sec at FLAGS_serving_slo_ms) flows through
+p50/p99, requests/sec at FLAGS_serving_slo_ms, the prefill-vs-decode
+step split and decode tokens/sec) flows through
 ``profiler.pipeline.serving_stats``; ``bench.py`` publishes it as
 ``extras.serving``.
 
@@ -28,13 +44,18 @@ p50/p99, requests/sec at FLAGS_serving_slo_ms) flows through
     req.result()
     engine.shutdown(drain=True)
 """
-from .engine import ServingEngine
+from .decode import DecodeEngine, DecodePrograms
+from .engine import EngineBase, ServingEngine
+from .kv_cache import KVSlotPool
 from .request_queue import (AdmissionController, AdmissionError,
-                            RejectedError, Request, RequestQueue)
-from .scheduler import Scheduler, scatter_outputs, stack_requests
+                            DecodeRequest, RejectedError, Request,
+                            RequestQueue)
+from .scheduler import (DecodeScheduler, Scheduler, scatter_outputs,
+                        stack_requests)
 
 __all__ = [
-    "AdmissionController", "AdmissionError", "RejectedError", "Request",
-    "RequestQueue", "Scheduler", "ServingEngine", "scatter_outputs",
-    "stack_requests",
+    "AdmissionController", "AdmissionError", "DecodeEngine",
+    "DecodePrograms", "DecodeRequest", "DecodeScheduler", "EngineBase",
+    "KVSlotPool", "RejectedError", "Request", "RequestQueue", "Scheduler",
+    "ServingEngine", "scatter_outputs", "stack_requests",
 ]

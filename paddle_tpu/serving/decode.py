@@ -1,0 +1,477 @@
+"""True continuous batching for GPT decode (serving phase 2).
+
+The batch tier (``ServingEngine``) batches at assembly time: stack, run
+once, scatter — so autoregressive decode would degenerate into
+batch-per-token re-assembly, and one long request holds every
+co-batched one hostage. This module serves decode the TPU-native way:
+
+- :class:`DecodePrograms` — functional prefill and decode-step programs
+  built straight from a ``models.gpt.GPTForCausalLM``'s parameters
+  (plain jnp math, no Tensor dispatch), operating against the
+  device-resident :class:`~.kv_cache.KVSlotPool`. One compiled
+  specialization per bucket rung — ``(batch, seq)`` pairs for prefill,
+  batch rungs for decode — all AOT-warmed through the persistent compile
+  cache (a warm-disk replica restores the WHOLE program set with zero
+  traces).
+- :class:`DecodeEngine` — the serving front door
+  (:class:`~.engine.EngineBase`): admission control with priority tiers
+  and TTL, per-tenant stats lanes, telemetry egress, and a
+  :class:`~.scheduler.DecodeScheduler` thread running the join/leave
+  loop: requests enter a running batch the step after a slot frees and
+  leave the step they finish — no full re-assembly, ever.
+
+Decoding is greedy (argmax), which makes the bit-exactness contract
+testable: the tokens a request receives are identical whether it decoded
+alone or joined a full batch mid-flight (per-lane math touches only the
+lane's own slot; masked pad columns contribute exact zeros).
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base.flags import get_flag
+from ..profiler.pipeline import serving_stats
+from . import kv_cache as kvc
+from .engine import EngineBase
+from .kv_cache import KVSlotPool
+from .request_queue import DecodeRequest
+from .scheduler import DecodeScheduler
+
+__all__ = ["DecodeEngine", "DecodePrograms"]
+
+
+def _extract_gpt(model):
+    """The model's parameters as a plain pytree (shared device arrays,
+    zero-copy) plus its config. Only the single-device GPT path serves
+    here — parallel layouts keep their training-side machinery."""
+    cfg = model.config
+    if (cfg.tensor_parallel or cfg.pipeline_parallel
+            or cfg.sequence_parallel or cfg.context_parallel):
+        raise ValueError(
+            "decode serving builds single-device programs; export the "
+            "model unsharded (tensor/pipeline/sequence/context-parallel "
+            "configs are training layouts)")
+
+    def val(p):
+        return p._value
+
+    blocks = []
+    for blk in model.gpt.h:
+        a, m = blk.attn, blk.mlp
+        blocks.append({
+            "ln1_w": val(blk.ln_1.weight), "ln1_b": val(blk.ln_1.bias),
+            "qkv_w": val(a.qkv_proj.weight), "qkv_b": val(a.qkv_proj.bias),
+            "out_w": val(a.out_proj.weight), "out_b": val(a.out_proj.bias),
+            "ln2_w": val(blk.ln_2.weight), "ln2_b": val(blk.ln_2.bias),
+            "fc1_w": val(m.fc1.weight), "fc1_b": val(m.fc1.bias),
+            "fc2_w": val(m.fc2.weight), "fc2_b": val(m.fc2.bias),
+        })
+    params = {
+        "wte": val(model.gpt.embeddings.word_embeddings.weight),
+        "wpe": val(model.gpt.embeddings.position_embeddings.weight),
+        "lnf_w": val(model.gpt.ln_f.weight),
+        "lnf_b": val(model.gpt.ln_f.bias),
+        "blocks": blocks,
+    }
+    if not cfg.tie_word_embeddings:
+        params["head_w"] = val(model.lm_head.weight)
+    return params, cfg
+
+
+def _ln(x, w, b, eps):
+    import jax.numpy as jnp
+    from jax import lax
+
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * w + b
+
+
+class DecodePrograms:
+    """The decode tier's compiled program set over one GPT's weights.
+
+    Two program families, each specialized per bucket rung:
+
+    - ``prefill``: ``[B, S]`` prompt tokens → per-layer K/V written into
+      the pool's slots (``lax.dynamic_update_slice`` on the B=1
+      interactive path, one all-layer scatter otherwise) + the first
+      generated token per lane (greedy, from each lane's last real
+      position);
+    - ``decode``: ``[B]`` last tokens → one attention step per lane over
+      its own slot's cached rows (cols ≤ position), K/V appended at the
+      lane's write position, next token per lane.
+
+    Both take and return the pool buffers functionally; KV args are
+    donated on accelerators so XLA aliases output onto input — zero
+    per-step reallocation. ``traces`` ticks inside the traced bodies
+    (the zero-retrace proof); warmup arms every rung through the
+    persistent compile cache (``restored`` rungs paid zero traces).
+    """
+
+    def __init__(self, model, pool: KVSlotPool, *,
+                 seq_ladder: Sequence[int],
+                 prefill_batch_rungs: Sequence[int],
+                 decode_rungs: Sequence[int]):
+        import jax
+
+        params, cfg = _extract_gpt(model)
+        self.params = jax.device_put(params)
+        self.pool = pool
+        self.seq_ladder = sorted(int(s) for s in seq_ladder)
+        self.prefill_batch_rungs = sorted(int(b) for b in prefill_batch_rungs)
+        self.decode_rungs = sorted(int(b) for b in decode_rungs)
+        self._heads = cfg.num_attention_heads
+        self._head_dim = cfg.head_dim
+        self._hidden = cfg.hidden_size
+        self._eps = float(cfg.layer_norm_epsilon)
+        self._tied = bool(cfg.tie_word_embeddings)
+        self._scale = 1.0 / math.sqrt(cfg.head_dim)
+        self.traces = 0
+        self.warmed: List[tuple] = []
+        self.restored: List[tuple] = []
+        self._aot: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        try:
+            backend = jax.devices()[0].platform
+        except Exception:
+            backend = "cpu"
+        # serving-step donation idiom: the pool buffers are dead after the
+        # call (the scheduler commits the outputs), so donate them and XLA
+        # updates the KV cache in place. CPU ignores donation — skip the
+        # warning noise there; the footprint proof holds either way
+        # (commit() pins shape/dtype, device_bytes stays constant).
+        self._donate = (1, 2) if backend != "cpu" else ()
+        # executables are parameter-VALUE independent (params are runtime
+        # args), so the cache key needs only the structural identity —
+        # which includes every compile-time CONSTANT baked into the traced
+        # bodies (eps is one; miss it and two models differing only in
+        # layer_norm_epsilon would share executables)
+        self._model_key = (
+            int(cfg.vocab_size), int(cfg.hidden_size),
+            int(cfg.num_hidden_layers), int(cfg.num_attention_heads),
+            int(cfg.max_position_embeddings), self._tied, self._eps,
+            tuple(int(d) for d in pool.k.shape), str(pool.k.dtype),
+            tuple(self._donate))
+        self._jit_prefill = jax.jit(self._prefill_fn,
+                                    donate_argnums=self._donate)
+        self._jit_decode = jax.jit(self._decode_fn,
+                                   donate_argnums=self._donate)
+
+    # ------------------------------------------------------------ programs
+    def _logits_head(self, params, x):
+        import jax.numpy as jnp
+
+        w = params["wte"].T if self._tied else params["head_w"]
+        return x @ w
+
+    def _prefill_fn(self, params, ck, cv, tokens, lengths, slot_ids):
+        import jax
+        import jax.numpy as jnp
+
+        self.traces += 1  # runs under trace only: the recompile proof
+        B, S = tokens.shape
+        eps = self._eps
+        x = params["wte"][tokens] + params["wpe"][:S][None, :, :]
+        ks, vs = [], []
+        for blk in params["blocks"]:
+            h = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
+            qkv = (h @ blk["qkv_w"] + blk["qkv_b"]).reshape(
+                B, S, self._heads, 3, self._head_dim)
+            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+            ks.append(k)
+            vs.append(v)
+            logits = jnp.einsum("bshd,bthd->bhst", q, k) * self._scale
+            causal = jnp.tril(jnp.ones((S, S), bool))
+            logits = jnp.where(causal[None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(x.dtype)
+            att = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(
+                B, S, self._hidden)
+            x = x + att @ blk["out_w"] + blk["out_b"]
+            h2 = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
+            x = x + jax.nn.gelu(h2 @ blk["fc1_w"] + blk["fc1_b"],
+                                approximate=True) @ blk["fc2_w"] + blk["fc2_b"]
+        # each lane's next token comes from its LAST REAL position (rows
+        # past the prompt are garbage, never attended by real rows)
+        idx = (lengths - 1).astype(jnp.int32)
+        x_last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+        hfin = _ln(x_last, params["lnf_w"], params["lnf_b"], eps)
+        next_tok = jnp.argmax(self._logits_head(params, hfin),
+                              axis=-1).astype(jnp.int32)
+        krows = jnp.stack(ks)  # [layers, B, S, heads, head_dim]
+        vrows = jnp.stack(vs)
+        if B == 1:
+            # interactive path: one dynamic_update_slice per buffer
+            ck = kvc.write_prompt(ck, slot_ids[0], krows[:, 0])
+            cv = kvc.write_prompt(cv, slot_ids[0], vrows[:, 0])
+        else:
+            ck = kvc.write_prompt_batch(ck, slot_ids, krows)
+            cv = kvc.write_prompt_batch(cv, slot_ids, vrows)
+        return ck, cv, next_tok
+
+    def _decode_fn(self, params, ck, cv, tokens, slot_ids, positions):
+        import jax
+        import jax.numpy as jnp
+
+        self.traces += 1
+        B = tokens.shape[0]
+        eps = self._eps
+        x = params["wte"][tokens] + params["wpe"][positions]
+        col = jnp.arange(self.pool.max_seq)
+        for li, blk in enumerate(params["blocks"]):
+            h = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
+            qkv = (h @ blk["qkv_w"] + blk["qkv_b"]).reshape(
+                B, self._heads, 3, self._head_dim)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            # write this token's K/V at (layer, slot, position), then
+            # attend over the slot's rows 0..position inclusive
+            ck = kvc.append_token(ck, li, slot_ids, positions, k)
+            cv = kvc.append_token(cv, li, slot_ids, positions, v)
+            keys = ck[li, slot_ids]    # [B, max_seq, heads, head_dim]
+            vals = cv[li, slot_ids]
+            logits = jnp.einsum("bhd,bthd->bht", q, keys) * self._scale
+            mask = col[None, None, :] <= positions[:, None, None]
+            logits = jnp.where(mask, logits, -1e30)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(x.dtype)
+            att = jnp.einsum("bht,bthd->bhd", probs, vals).reshape(
+                B, self._hidden)
+            x = x + att @ blk["out_w"] + blk["out_b"]
+            h2 = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
+            x = x + jax.nn.gelu(h2 @ blk["fc1_w"] + blk["fc1_b"],
+                                approximate=True) @ blk["fc2_w"] + blk["fc2_b"]
+        hfin = _ln(x, params["lnf_w"], params["lnf_b"], eps)
+        next_tok = jnp.argmax(self._logits_head(params, hfin),
+                              axis=-1).astype(jnp.int32)
+        return ck, cv, next_tok
+
+    # ------------------------------------------------------------- rungs
+    @property
+    def rungs(self) -> List[tuple]:
+        """Every specialization warmup arms: ``("decode", b)`` per batch
+        rung plus ``("prefill", b, s)`` over the (batch x seq) grid."""
+        out = [("decode", b) for b in self.decode_rungs]
+        out += [("prefill", b, s) for b in self.prefill_batch_rungs
+                for s in self.seq_ladder]
+        return out
+
+    def _zero_args(self, key):
+        pad = self.pool.pad_slot
+        if key[0] == "decode":
+            b = key[1]
+            return (np.zeros(b, np.int32), np.full(b, pad, np.int32),
+                    np.zeros(b, np.int32))
+        _, b, s = key
+        return (np.zeros((b, s), np.int32), np.ones(b, np.int32),
+                np.full(b, pad, np.int32))
+
+    def _jitted(self, key):
+        return self._jit_decode if key[0] == "decode" else self._jit_prefill
+
+    def warmup(self) -> List[tuple]:
+        """Arm every rung: restored from the persistent compile cache
+        (zero traces) or AOT compile-and-publish (one trace — the same
+        one an in-memory warm call pays). Idempotent per rung."""
+        with self._lock:
+            for key in self.rungs:
+                if key in self.warmed:
+                    continue
+                self._warm(key)
+                self.warmed.append(key)
+        return list(self.warmed)
+
+    def _digest(self, key):
+        from .. import compile_cache as cc
+
+        return cc.derive_digest(
+            "serving.decode", ("serving.decode", self._model_key, key))
+
+    def _warm(self, key) -> None:
+        from .. import compile_cache as cc
+
+        args = self._zero_args(key)
+        if cc.enabled():
+            digest = self._digest(key)
+            compiled = cc.load_executable(
+                digest, site=f"serving.decode:{key[0]}{key[1:]}")
+            if compiled is not None:
+                self._aot[key] = compiled
+                self.restored.append(key)
+                return
+            lowered = self._jitted(key).lower(
+                self.params, self.pool.k, self.pool.v, *args)  # traces += 1
+            compiled = lowered.compile()
+            cc.store_executable(
+                digest, compiled,
+                key_meta={"site": "serving.decode", "rung": repr(key)})
+            self._aot[key] = compiled
+            return
+        # in-memory warm: one traced call against the pad slot (harmless
+        # writes land in the trash slot); outputs are committed so a
+        # donation backend keeps the pool buffers alive
+        k, v, _ = self._jitted(key)(self.params, self.pool.k, self.pool.v,
+                                    *args)
+        self.pool.commit(k, v)
+
+    # -------------------------------------------------------------- calls
+    def prefill(self, ck, cv, tokens, lengths, slot_ids):
+        key = ("prefill", int(tokens.shape[0]), int(tokens.shape[1]))
+        ex = self._aot.get(key)
+        if ex is not None:
+            return ex(self.params, ck, cv, tokens, lengths, slot_ids)
+        return self._jit_prefill(self.params, ck, cv, tokens, lengths,
+                                 slot_ids)
+
+    def decode(self, ck, cv, tokens, slot_ids, positions):
+        key = ("decode", int(tokens.shape[0]))
+        ex = self._aot.get(key)
+        if ex is not None:
+            return ex(self.params, ck, cv, tokens, slot_ids, positions)
+        return self._jit_decode(self.params, ck, cv, tokens, slot_ids,
+                                positions)
+
+
+class DecodeEngine(EngineBase):
+    """GPT decode serving with true continuous batching.
+
+    ``model`` is a live ``models.gpt.GPTForCausalLM`` (eval mode; its
+    device weights are shared zero-copy with training/export users).
+    Requests (:meth:`submit`) borrow a KV slot, join the running batch at
+    the next step boundary, and leave the step they finish — the
+    :class:`~.scheduler.DecodeScheduler` runs ONE prefill-or-decode
+    program call per step against the warmed rung set, so
+    ``compiles_after_warmup == 0`` holds under any mix of prefill and
+    decode traffic (JX330), the KV pool footprint never moves after
+    warmup (JX332), and emitted tokens are bit-exact with a
+    single-request decode of the same prompt.
+    """
+
+    def __init__(self, model, *,
+                 max_slots: Optional[int] = None,
+                 max_seq: Optional[int] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 prefill_max_batch: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 kv_dtype: str = "float32",
+                 max_queue: Optional[int] = None,
+                 tenant_quota: Optional[int] = None,
+                 request_ttl_ms: Optional[float] = None,
+                 serve_telemetry_port: Optional[int] = None,
+                 stats=serving_stats):
+        from ..jit.bucketing import powers_of_two_buckets
+
+        super().__init__(max_queue=max_queue, tenant_quota=tenant_quota,
+                         request_ttl_ms=request_ttl_ms,
+                         serve_telemetry_port=serve_telemetry_port,
+                         stats=stats)
+        cfg = model.config
+        max_slots = int(get_flag("serving_max_slots")
+                        if max_slots is None else max_slots)
+        flag_seq = int(get_flag("serving_max_seq"))
+        max_seq = int(max_seq if max_seq is not None
+                      else (flag_seq or cfg.max_position_embeddings))
+        if max_seq > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_seq {max_seq} exceeds the model's position table "
+                f"({cfg.max_position_embeddings})")
+        prefill_max = int(get_flag("serving_prefill_max_batch")
+                          if prefill_max_batch is None else prefill_max_batch)
+        prefill_max = min(prefill_max, max_slots)
+        if seq_buckets is None:
+            seq_min = min(int(get_flag("serving_seq_bucket_min")), max_seq)
+            # clamp the top rung: the power-of-two ladder rounds UP past a
+            # non-power-of-two max_seq, but a slot can't hold more rows
+            seq_buckets = sorted({min(s, max_seq) for s in
+                                  powers_of_two_buckets(seq_min, max_seq)})
+        seq_buckets = sorted(int(s) for s in seq_buckets)
+        if seq_buckets[-1] > max_seq:
+            raise ValueError(f"seq bucket {seq_buckets[-1]} exceeds "
+                             f"max_seq {max_seq}")
+        self.kv_pool = KVSlotPool(
+            cfg.num_hidden_layers, max_slots, max_seq,
+            cfg.num_attention_heads, cfg.head_dim, dtype=kv_dtype)
+        self.programs = DecodePrograms(
+            model, self.kv_pool,
+            seq_ladder=seq_buckets,
+            prefill_batch_rungs=powers_of_two_buckets(1, prefill_max),
+            decode_rungs=powers_of_two_buckets(1, max_slots))
+        self.eos_id = eos_id
+        self._scheduler = DecodeScheduler(
+            self.queue, self.programs, self.kv_pool,
+            prefill_max_batch=prefill_max, eos_id=eos_id, stats=stats)
+
+    # ------------------------------------------------------------ lifecycle
+    def warmup(self) -> "DecodeEngine":
+        """Arm every prefill/decode rung (compile-cache restore or AOT
+        compile), freeze the KV pool footprint baseline, start the decode
+        loop."""
+        self.programs.warmup()
+        self.kv_pool.mark_warm()
+        self._start_serving()
+        return self
+
+    # ------------------------------------------------------------- serving
+    def submit(self, tenant: str, prompt,
+               max_new_tokens: int = 16) -> DecodeRequest:
+        """Enqueue one generation request; returns the future. The prompt
+        must fit the seq ladder; generation stops at ``max_new_tokens``,
+        the engine's ``eos_id``, or the slot's ``max_seq`` capacity —
+        whichever comes first."""
+        if not self._started:
+            raise RuntimeError("engine not started: call warmup() first")
+        req = DecodeRequest(tenant, prompt, max_new_tokens)
+        top = self.programs.seq_ladder[-1]
+        if req.prompt.size > top:
+            raise ValueError(
+                f"prompt of {req.prompt.size} tokens exceeds the largest "
+                f"seq bucket ({top}); raise FLAGS_serving_max_seq or the "
+                "seq ladder")
+        self.tenant(tenant)
+        return self.queue.submit(req)
+
+    def generate(self, tenant: str, prompt, max_new_tokens: int = 16,
+                 timeout: Optional[float] = 120.0) -> np.ndarray:
+        """submit + block: returns the generated token ids."""
+        return self.submit(tenant, prompt, max_new_tokens).result(timeout)
+
+    def active_requests(self) -> int:
+        """Sequences currently holding a slot (decoding or awaiting
+        prefill) — the JX333 slot-leak audit's liveness source."""
+        return self._scheduler.active_count()
+
+    # ---------------------------------------------------------- accounting
+    @property
+    def compile_count(self) -> int:
+        return self.programs.traces
+
+    def telemetry_health(self) -> dict:
+        health = super().telemetry_health()
+        health.update(
+            kv_slots_in_use=self.kv_pool.in_use(),
+            kv_slots=self.kv_pool.max_slots,
+            active_requests=self.active_requests(),
+        )
+        return health
+
+    def serving_report(self) -> dict:
+        """Stats summary + the decode tier's contractual proofs."""
+        report = self.stats.summary()
+        report.update(
+            n_tenants=len(self._tenants),
+            seq_buckets=list(self.programs.seq_ladder),
+            decode_rungs=list(self.programs.decode_rungs),
+            prefill_batch_rungs=list(self.programs.prefill_batch_rungs),
+            compiled_rungs=len(self.programs.warmed),
+            restored_rungs=len(self.programs.restored),
+            compiles_after_warmup=self.compiles_after_warmup,
+            kv_pool_bytes=self.kv_pool.device_bytes(),
+            kv_pool_bytes_constant=(
+                self.kv_pool.bytes_at_warmup is None
+                or self.kv_pool.device_bytes() == self.kv_pool.bytes_at_warmup),
+            kv_slots=self.kv_pool.max_slots,
+        )
+        return report

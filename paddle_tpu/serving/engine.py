@@ -6,7 +6,8 @@ One engine = one exported model serving many tenants:
   weights and the warm-compiled bucket ladder are shared process-wide,
   only the IO handles are per-tenant;
 - client threads ``submit()`` and block on ``Request.result()``;
-  admission control answers at the door (queue cap + tenant quota);
+  admission control answers at the door (queue cap + tenant quota +
+  priority tiers + request TTL);
 - one scheduler thread continuously assembles mixed-size requests into
   bucketed batches (``jit.bucketing`` ladder) and replays the shared
   compiled specialization for the rung — ZERO retraces after
@@ -14,6 +15,12 @@ One engine = one exported model serving many tenants:
   ``analysis`` JX330 serving audit gates;
 - per-request enqueue→admit→dispatch→complete latency and queue depth
   flow through ``profiler.pipeline.serving_stats``.
+
+:class:`EngineBase` factors the tier's shared lifecycle (queue +
+admission, tenant registry with mid-traffic churn, telemetry egress
+server, the zero-retrace accounting) so the decode tier
+(:class:`serving.decode.DecodeEngine` — device-resident KV cache,
+slot-based join/leave) serves through the same front door.
 """
 from __future__ import annotations
 
@@ -32,40 +39,29 @@ from .scheduler import (Scheduler, fetch_outputs, scatter_outputs,
                         stack_requests)
 
 
-class ServingEngine:
-    """Continuous bucketed batching over one warm-compiled model.
+class EngineBase:
+    """Shared serving-engine chassis: request queue + admission control,
+    per-tenant registry (live add/drop), the engine-owned telemetry
+    exporter, and the ``compiles_after_warmup`` zero-retrace accounting.
 
-    ``model``: a path prefix (as given to ``jit.save``) or a ready
-    :class:`inference.Predictor`. ``buckets`` overrides the batch ladder
-    (default: powers of two up to ``FLAGS_serving_max_batch``).
-    """
+    Subclasses provide: ``compile_count`` (their program's trace
+    counter), ``_scheduler`` (an object with ``start``/``alive``/``join``),
+    and their own ``warmup``/``submit`` shapes."""
 
-    def __init__(self, model: Union[str, Predictor], *,
-                 buckets: Optional[Sequence[int]] = None,
-                 max_queue: Optional[int] = None,
+    def __init__(self, *, max_queue: Optional[int] = None,
                  tenant_quota: Optional[int] = None,
-                 linger_ms: Optional[float] = None,
+                 request_ttl_ms: Optional[float] = None,
                  serve_telemetry_port: Optional[int] = None,
                  stats=serving_stats):
-        self.predictor = (model if isinstance(model, Predictor)
-                          else Predictor(Config(model)))
-        if buckets is not None:
-            self.predictor.set_batch_ladder(buckets)
         self.stats = stats
-        self._tenants: Dict[str, Predictor] = {}
+        self._tenants: Dict[str, object] = {}
         self._tenant_lock = threading.Lock()
         self.queue = RequestQueue(AdmissionController(
-            max_queue=max_queue, tenant_quota=tenant_quota), stats=stats)
-        linger = (float(get_flag("serving_batch_timeout_ms"))
-                  if linger_ms is None else float(linger_ms)) / 1e3
-        prog = self.predictor._ensure_batch_program()
-        self._n_inputs = len(self.predictor.get_input_names())
-        self._dynamic_axes = dict(prog.dynamic_axes)
-        self._scheduler = Scheduler(
-            self.queue, self._execute, lambda: prog.ladder,
-            linger_s=linger, on_batch=self._on_batch)
+            max_queue=max_queue, tenant_quota=tenant_quota,
+            request_ttl_ms=request_ttl_ms), stats=stats)
         self._compiles_at_warmup: Optional[int] = None
         self._started = False
+        self._scheduler = None
         # telemetry egress (ISSUE 8): the engine owns one exporter thread.
         # None defers to FLAGS_telemetry_port (0 there = disabled); an
         # EXPLICIT integer always serves (0 = pick an ephemeral port, the
@@ -79,12 +75,10 @@ class ServingEngine:
         self._telemetry_server = None
 
     # ------------------------------------------------------------ lifecycle
-    def warmup(self) -> "ServingEngine":
-        """AOT-compile the whole bucket ladder, snapshot the compile
-        counter (the steady-state zero-retrace baseline), start the
-        scheduler thread."""
-        self.predictor.warmup_ladder()
-        self._compiles_at_warmup = self.predictor.compile_count
+    def _start_serving(self) -> None:
+        """Snapshot the compile counter, bind the exporter, start the
+        scheduler thread — the tail of every subclass's ``warmup()``."""
+        self._compiles_at_warmup = self.compile_count
         # bind the exporter port BEFORE the scheduler thread: an explicit
         # serve_telemetry_port that fails to bind raises with no stray
         # worker running, instead of leaving a half-started engine nobody
@@ -109,7 +103,6 @@ class ServingEngine:
         if not self._started:
             self._scheduler.start()
             self._started = True
-        return self
 
     def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop admitting; with ``drain`` serve everything already
@@ -131,11 +124,132 @@ class ServingEngine:
                 self._telemetry_server.stop()
                 self._telemetry_server = None
 
-    def __enter__(self) -> "ServingEngine":
+    def __enter__(self):
         return self.warmup()
 
     def __exit__(self, *exc) -> None:
         self.shutdown(drain=exc == (None, None, None))
+
+    # ------------------------------------------------------------ tenants
+    def tenant(self, name: str):
+        """Register (or fetch) a tenant lane. The batch engine overrides
+        this to materialize a Predictor clone; the decode tier only needs
+        the stats lane and the admission identity."""
+        with self._tenant_lock:
+            if name not in self._tenants:
+                self._tenants[name] = None
+            return self._tenants[name]
+
+    @property
+    def tenants(self) -> List[str]:
+        with self._tenant_lock:
+            return sorted(self._tenants)
+
+    def drop_tenant(self, name: str) -> bool:
+        """Retire a tenant mid-traffic: its clone/lane is forgotten and
+        its stats ring retired. Requests already admitted still complete
+        (their futures are never dropped); only NEW identity is released.
+        Returns whether the tenant existed."""
+        with self._tenant_lock:
+            existed = name in self._tenants
+            self._tenants.pop(name, None)
+        if hasattr(self.stats, "retire_tenant"):
+            self.stats.retire_tenant(name)
+        return existed
+
+    def set_tenant_tier(self, name: str, tier) -> None:
+        """Pin a tenant's admission priority: ``"interactive"`` (default)
+        or ``"bulk"`` — bulk tenants yield queue headroom and scheduling
+        order to interactive ones (preemption at admission)."""
+        self.queue.admission.set_tier(name, tier)
+
+    # ------------------------------------------------------------ telemetry
+    @property
+    def telemetry_url(self) -> Optional[str]:
+        """The engine-owned exporter's base URL (None when not serving)."""
+        srv = self._telemetry_server
+        return srv.url if srv is not None else None
+
+    def telemetry_health(self) -> dict:
+        """The ``/healthz`` payload: scheduler-worker liveness (the one
+        thread whose death silently strands every queued request), queue
+        depth and the zero-retrace proof. ``ok`` follows worker liveness
+        while the engine is supposed to be serving."""
+        alive = self._scheduler.alive() if self._scheduler else False
+        return {
+            "ok": bool(alive) if self._started else True,
+            "worker_alive": bool(alive),
+            "started": self._started,
+            "queue_depth_requests": len(self.queue),
+            "queue_depth_samples": self.queue.depth_samples(),
+            "compiles_after_warmup": self.compiles_after_warmup,
+            "tenants": len(self._tenants),
+        }
+
+    # ------------------------------------------------------------ accounting
+    @property
+    def compile_count(self) -> int:  # subclass contract
+        raise NotImplementedError
+
+    @property
+    def compiles_after_warmup(self) -> Optional[int]:
+        """The zero-retrace proof: compiled specializations added SINCE
+        warmup (None before warmup). Steady state must hold this at 0;
+        the JX330 serving audit errors otherwise."""
+        if self._compiles_at_warmup is None:
+            return None
+        return self.compile_count - self._compiles_at_warmup
+
+
+class ServingEngine(EngineBase):
+    """Continuous bucketed batching over one warm-compiled model.
+
+    ``model``: a path prefix (as given to ``jit.save``) or a ready
+    :class:`inference.Predictor`. ``buckets`` overrides the batch ladder
+    (default: powers of two up to ``FLAGS_serving_max_batch``). Models
+    exported with a second (sequence) symbolic dim serve from the
+    two-axis (batch x seq) bucket grid: assembly pads both axes and the
+    warmed grid covers every pair."""
+
+    def __init__(self, model: Union[str, Predictor], *,
+                 buckets: Optional[Sequence[int]] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 max_queue: Optional[int] = None,
+                 tenant_quota: Optional[int] = None,
+                 request_ttl_ms: Optional[float] = None,
+                 linger_ms: Optional[float] = None,
+                 serve_telemetry_port: Optional[int] = None,
+                 stats=serving_stats):
+        super().__init__(max_queue=max_queue, tenant_quota=tenant_quota,
+                         request_ttl_ms=request_ttl_ms,
+                         serve_telemetry_port=serve_telemetry_port,
+                         stats=stats)
+        self.predictor = (model if isinstance(model, Predictor)
+                          else Predictor(Config(model)))
+        if buckets is not None:
+            self.predictor.set_batch_ladder(buckets)
+        if seq_buckets is not None:
+            self.predictor.set_seq_ladder(seq_buckets)
+        linger = (float(get_flag("serving_batch_timeout_ms"))
+                  if linger_ms is None else float(linger_ms)) / 1e3
+        prog = self.predictor._ensure_batch_program()
+        self._n_inputs = len(self.predictor.get_input_names())
+        self._dynamic_axes = dict(prog.dynamic_axes)
+        # the second bucket axis: {input_idx: seq_axis} of rank-1 dims
+        self._seq_axes = {i: ax for (i, ax), r in prog.dynamic_ranks.items()
+                          if r == 1}
+        self._scheduler = Scheduler(
+            self.queue, self._execute, lambda: prog.ladder,
+            linger_s=linger, on_batch=self._on_batch)
+
+    # ------------------------------------------------------------ lifecycle
+    def warmup(self) -> "ServingEngine":
+        """AOT-compile the whole bucket ladder (the full two-axis grid on
+        seq-dynamic exports), snapshot the compile counter (the
+        steady-state zero-retrace baseline), start the scheduler thread."""
+        self.predictor.warmup_ladder()
+        self._start_serving()
+        return self
 
     # ------------------------------------------------------------ tenants
     def tenant(self, name: str) -> Predictor:
@@ -147,11 +261,6 @@ class ServingEngine:
             if pred is None:
                 pred = self._tenants[name] = self.predictor.clone()
             return pred
-
-    @property
-    def tenants(self) -> List[str]:
-        with self._tenant_lock:
-            return sorted(self._tenants)
 
     # ------------------------------------------------------------ serving
     def submit(self, tenant: str, inputs, n: Optional[int] = None) -> Request:
@@ -173,8 +282,17 @@ class ServingEngine:
             raise ValueError(
                 f"request of {n} samples exceeds the largest bucket "
                 f"({max_batch}); split it or raise FLAGS_serving_max_batch")
+        seq = None
+        if self._seq_axes:
+            seq = max(int(arrays[i].shape[ax])
+                      for i, ax in self._seq_axes.items())
+            top = self.predictor.seq_ladder[-1]
+            if seq > top:
+                raise ValueError(
+                    f"request sequence length {seq} exceeds the largest "
+                    f"seq bucket ({top}); split it or raise the seq ladder")
         self.tenant(tenant)  # materialize the clone on first contact
-        return self.queue.submit(Request(tenant, arrays, n))
+        return self.queue.submit(Request(tenant, arrays, n, seq=seq))
 
     def run(self, tenant: str, inputs, n: Optional[int] = None,
             timeout: Optional[float] = 60.0) -> List[np.ndarray]:
@@ -184,15 +302,24 @@ class ServingEngine:
     def _execute(self, requests: List[Request], bucket: int) -> None:
         """One program call for one assembled batch (scheduler thread)."""
         prog = self.predictor._ensure_batch_program()
+        seq_bucket = None
+        if self._seq_axes:
+            from ..jit.bucketing import bucket_for
+
+            seq_bucket = bucket_for(max(r.seq or 1 for r in requests),
+                                    prog.seq_ladder)
         stacked = stack_requests(requests, bucket, self._dynamic_axes,
-                                 self._n_inputs)
+                                 self._n_inputs, seq_axes=self._seq_axes,
+                                 seq_bucket=seq_bucket)
         import jax
 
-        out = prog(stacked, bucket)
+        out = prog(stacked,
+                   (bucket, seq_bucket) if seq_bucket is not None else bucket)
         # one batched D2H round per assembled batch, not one per leaf
         leaves = fetch_outputs(jax.tree_util.tree_leaves(
             out, is_leaf=lambda x: hasattr(x, "shape")))
-        rows = scatter_outputs(leaves, requests)
+        rows = scatter_outputs(leaves, requests, seq_bucket=seq_bucket,
+                               out_seq_axes=prog.out_seq_axes)
         from ..observability.anomaly import monitor
 
         for r, outs in zip(requests, rows):
@@ -228,48 +355,17 @@ class ServingEngine:
         self.stats.record_batch(n_samples, bucket)
         self.stats.record_queue_depth(depth)
 
-    # ------------------------------------------------------------ telemetry
-    def telemetry_health(self) -> dict:
-        """The ``/healthz`` payload: scheduler-worker liveness (the one
-        thread whose death silently strands every queued request), queue
-        depth and the zero-retrace proof. ``ok`` follows worker liveness
-        while the engine is supposed to be serving."""
-        alive = self._scheduler.alive()
-        return {
-            "ok": bool(alive) if self._started else True,
-            "worker_alive": bool(alive),
-            "started": self._started,
-            "queue_depth_requests": len(self.queue),
-            "queue_depth_samples": self.queue.depth_samples(),
-            "compiles_after_warmup": self.compiles_after_warmup,
-            "tenants": len(self._tenants),
-        }
-
-    @property
-    def telemetry_url(self) -> Optional[str]:
-        """The engine-owned exporter's base URL (None when not serving)."""
-        srv = self._telemetry_server
-        return srv.url if srv is not None else None
-
     # ------------------------------------------------------------ accounting
     @property
     def compile_count(self) -> int:
         return self.predictor.compile_count
-
-    @property
-    def compiles_after_warmup(self) -> Optional[int]:
-        """The zero-retrace proof: compiled specializations added SINCE
-        warmup (None before warmup). Steady state must hold this at 0;
-        the JX330 serving audit errors otherwise."""
-        if self._compiles_at_warmup is None:
-            return None
-        return self.predictor.compile_count - self._compiles_at_warmup
 
     def serving_report(self) -> dict:
         """Stats summary + the recompile proof, one dict (bench payload)."""
         report = self.stats.summary()
         report.update(
             buckets=list(self.predictor.batch_ladder),
+            seq_buckets=self.predictor.seq_ladder,
             # count under its own key: summary()["tenants"] is the
             # per-tenant latency breakdown and must survive the merge
             n_tenants=len(self._tenants),

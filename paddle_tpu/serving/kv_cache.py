@@ -1,0 +1,175 @@
+"""Device-resident KV cache with slot-based alloc/release.
+
+The memory discipline of true continuous batching: decode state lives in
+ONE pair of device buffers shaped ``[layers, max_slots+1, max_seq, heads,
+head_dim]``, allocated once at engine construction and never resized —
+O(``FLAGS_serving_max_slots``) residency, not O(traffic) and not
+O(max_batch x max_seq) per request (the O(shard)-residency discipline of
+the redistribution work, PAPERS arxiv 2112.01075, applied to serving
+state). Requests borrow a slot from the free list at admission, their
+prompt/token K/V rows are written in place by the jitted prefill/decode
+programs (functional ``lax.dynamic_update_slice`` / scatter updates under
+buffer donation, so XLA aliases the output onto the input allocation —
+no per-step reallocation), and the slot returns to the free list at
+retirement for the next queued request.
+
+Slot ``max_slots`` (the last one) is the *pad slot*: batch lanes that
+only exist to fill a bucket rung write their garbage K/V there, so a
+padded program call can scatter unconditionally without touching any
+live sequence's state.
+
+Host-side bookkeeping (free list, per-slot lengths, occupancy gauge)
+stays in :class:`KVSlotPool`; the pure functions below run inside the
+jitted programs and carry no python state.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["KVSlotPool", "write_prompt", "write_prompt_batch",
+           "append_token"]
+
+
+# ------------------------------------------------------ functional updates
+def write_prompt(cache, slot, rows):
+    """Write one prompt's K (or V) rows into one slot — the interactive
+    single-request prefill path: ``rows`` is ``[layers, S, heads, dim]``,
+    ``slot`` a scalar; one ``lax.dynamic_update_slice`` at (0, slot, 0,
+    0, 0). Under donation XLA updates the pool buffer in place."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    return lax.dynamic_update_slice(
+        cache, rows[:, None].astype(cache.dtype),
+        (jnp.zeros((), jnp.int32), jnp.asarray(slot, jnp.int32),
+         jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+         jnp.zeros((), jnp.int32)))
+
+
+def write_prompt_batch(cache, slot_ids, rows):
+    """Batched prefill write: ``rows`` is ``[layers, B, S, heads, dim]``,
+    ``slot_ids`` ``[B]`` — one scatter over the slot axis covering every
+    layer. Rows past a lane's real prompt length carry garbage, which is
+    safe by construction: decode overwrites position ``len`` before any
+    step attends to it."""
+    S = rows.shape[2]
+    return cache.at[:, slot_ids, :S].set(rows.astype(cache.dtype))
+
+
+def append_token(cache, layer, slot_ids, positions, rows):
+    """One decode step's write for one layer: ``rows`` is ``[B, heads,
+    dim]`` landing at ``(layer, slot_ids[b], positions[b])``. Pad lanes
+    point at the pool's pad slot so the scatter needs no mask."""
+    return cache.at[layer, slot_ids, positions].set(
+        rows.astype(cache.dtype))
+
+
+# --------------------------------------------------------------- the pool
+class KVSlotPool:
+    """Free-list slot allocator over one device-resident K/V buffer pair.
+
+    ``alloc()``/``release()`` run on the scheduler thread (a lock keeps
+    them safe for engine shutdown paths); the arrays themselves are
+    replaced wholesale by :meth:`commit` after each program call — the
+    functional update idiom, with donation making it in-place on
+    accelerators. :meth:`device_bytes` must never change after
+    :meth:`mark_warm` (the JX332 audit and the bench's
+    ``kv_pool_bytes_constant`` proof)."""
+
+    def __init__(self, num_layers: int, max_slots: int, max_seq: int,
+                 num_heads: int, head_dim: int, dtype="float32"):
+        import jax.numpy as jnp
+
+        if max_slots < 1:
+            raise ValueError("KVSlotPool needs at least one slot")
+        self.num_layers = int(num_layers)
+        self.max_slots = int(max_slots)
+        self.max_seq = int(max_seq)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        # +1: the pad slot — garbage writes from bucket-padding lanes
+        shape = (self.num_layers, self.max_slots + 1, self.max_seq,
+                 self.num_heads, self.head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.lengths = np.zeros(self.max_slots, np.int32)  # host-side
+        self._free: List[int] = list(range(self.max_slots - 1, -1, -1))
+        self._lock = threading.Lock()
+        self.bytes_at_warmup: Optional[int] = None
+        self._gauge_occupancy()
+
+    # ------------------------------------------------------------ slots
+    @property
+    def pad_slot(self) -> int:
+        """The trash slot padded batch lanes write to (never allocated)."""
+        return self.max_slots
+
+    def alloc(self) -> int:
+        """Borrow a free slot (its length resets to 0); raises
+        ``RuntimeError`` when the pool is exhausted — the scheduler must
+        gate admission on :meth:`free_count`."""
+        with self._lock:
+            if not self._free:
+                raise RuntimeError(
+                    f"KV slot pool exhausted ({self.max_slots} slots in "
+                    "use); admission must wait for a retirement")
+            slot = self._free.pop()
+            self.lengths[slot] = 0
+        self._gauge_occupancy()
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Return a slot to the free list (idempotence guarded)."""
+        with self._lock:
+            slot = int(slot)
+            if not 0 <= slot < self.max_slots:
+                raise ValueError(f"slot {slot} out of range")
+            if slot in self._free:
+                raise ValueError(f"slot {slot} is already free")
+            self.lengths[slot] = 0
+            self._free.append(slot)
+        self._gauge_occupancy()
+
+    def in_use(self) -> int:
+        with self._lock:
+            return self.max_slots - len(self._free)
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    # ------------------------------------------------------------ buffers
+    def commit(self, new_k, new_v) -> None:
+        """Swap in the post-step buffers (the jitted program's functional
+        outputs). Shape and dtype are pinned — a program handing back a
+        different footprint is a bug the JX332 audit would otherwise
+        catch after the fact."""
+        if (new_k.shape != self.k.shape or new_v.shape != self.v.shape
+                or new_k.dtype != self.k.dtype):
+            raise ValueError(
+                f"KV commit changed the pool footprint: "
+                f"{self.k.shape}/{self.k.dtype} -> "
+                f"{new_k.shape}/{new_k.dtype}")
+        self.k = new_k
+        self.v = new_v
+
+    def device_bytes(self) -> int:
+        return int(self.k.nbytes) + int(self.v.nbytes)
+
+    def mark_warm(self) -> None:
+        """Freeze the footprint baseline (end of engine warmup): any
+        later :meth:`device_bytes` drift is a JX332 error."""
+        self.bytes_at_warmup = self.device_bytes()
+
+    # ------------------------------------------------------ observability
+    def _gauge_occupancy(self) -> None:
+        from ..observability.metrics import registry
+
+        registry.gauge(
+            "serving.kv_slots_in_use",
+            "KV cache slots currently allocated to live decode sequences "
+            "(capacity = FLAGS_serving_max_slots)").set(
+                self.max_slots - len(self._free))

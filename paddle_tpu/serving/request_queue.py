@@ -27,7 +27,9 @@ import numpy as np
 
 class AdmissionError(RuntimeError):
     """A submit the admission controller refused: ``reason`` is ``"queue"``
-    (global sample cap) or ``"tenant"`` (per-tenant in-flight quota)."""
+    (global sample cap), ``"tenant"`` (per-tenant in-flight quota),
+    ``"priority"`` (bulk tier refused to protect interactive headroom) or
+    ``"ttl"`` (the request expired in queue before it could be served)."""
 
     def __init__(self, reason: str, message: str):
         super().__init__(message)
@@ -47,14 +49,18 @@ class Request:
     axis. The submitting thread blocks in :meth:`result`; the scheduler
     thread completes it."""
 
-    __slots__ = ("id", "tenant", "inputs", "n", "t_enqueue", "t_admit",
+    __slots__ = ("id", "tenant", "inputs", "n", "seq", "t_enqueue", "t_admit",
                  "t_dispatch", "t_complete", "_event", "_outputs", "_error")
 
-    def __init__(self, tenant: str, inputs: Sequence[np.ndarray], n: int):
+    def __init__(self, tenant: str, inputs: Sequence[np.ndarray], n: int,
+                 seq: Optional[int] = None):
         self.id = next(_req_ids)
         self.tenant = tenant
         self.inputs = inputs
         self.n = int(n)
+        # real length on the sequence axis (two-axis exports only): the
+        # scheduler pads up to the seq rung and slices back to this
+        self.seq = None if seq is None else int(seq)
         self.t_enqueue = time.perf_counter()
         self.t_admit = None
         self.t_dispatch = None
@@ -86,22 +92,67 @@ class Request:
         self._event.set()
 
 
+class DecodeRequest(Request):
+    """One autoregressive generation request: a token prompt that will
+    occupy one KV slot from admission to retirement. The future resolves
+    to the generated token ids (``np.int32``, greedy decode, up to
+    ``max_new_tokens`` or the engine's EOS). ``n`` is 1 — admission is
+    denominated in slots for the decode tier."""
+
+    __slots__ = ("prompt", "max_new_tokens", "generated", "slot", "seq_rung")
+
+    def __init__(self, tenant: str, prompt, max_new_tokens: int):
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("decode request needs a non-empty prompt")
+        super().__init__(tenant, [prompt], 1, seq=int(prompt.size))
+        self.prompt = prompt
+        self.max_new_tokens = max(int(max_new_tokens), 1)
+        self.generated: List[int] = []
+        self.slot = None          # KV slot, assigned at admission-to-slot
+        self.seq_rung = None      # prefill seq-ladder rung (scheduler set)
+
+    @property
+    def position(self) -> int:
+        """The next KV write position: prompt rows 0..len-1 land at
+        prefill; generated token ``i`` (the input of decode step ``i+1``)
+        writes at ``len + i``."""
+        return int(self.prompt.size) + max(len(self.generated) - 1, 0)
+
+
 class AdmissionController:
-    """Two admission gates, both in samples: a global queued-sample cap
+    """Admission gates, all in samples: a global queued-sample cap
     (protects the scheduler's latency promise — a deeper queue than the
-    executor can clear inside the SLO is better refused than served late)
-    and a per-tenant in-flight cap (one chatty tenant cannot starve the
-    rest). In-flight = admitted and not yet completed, so quota releases
-    only at completion, covering execution occupancy too."""
+    executor can clear inside the SLO is better refused than served late),
+    a per-tenant in-flight cap (one chatty tenant cannot starve the
+    rest), and a PRIORITY gate: tenants marked ``bulk`` (:meth:`set_tier`)
+    may only fill ``FLAGS_serving_bulk_queue_share`` of the global cap, so
+    interactive tenants always find headroom at the door — bulk work is
+    preempted at admission, not mid-execution. In-flight = admitted and
+    not yet completed, so quota releases only at completion, covering
+    execution occupancy too.
+
+    The controller also owns the request TTL
+    (``FLAGS_serving_request_ttl_ms`` / ``request_ttl_ms``): the queue
+    expires requests whose wait exceeds it (:class:`AdmissionError`
+    reason ``"ttl"``, ``serving.expired`` counter) instead of executing
+    dead work whose client has long timed out."""
+
+    #: named priority tiers (lower = more urgent); ints also accepted
+    TIERS = {"interactive": 0, "bulk": 1}
 
     def __init__(self, max_queue: Optional[int] = None,
-                 tenant_quota: Optional[int] = None):
+                 tenant_quota: Optional[int] = None,
+                 request_ttl_ms: Optional[float] = None):
         from ..base.flags import get_flag
 
         self.max_queue = int(get_flag("serving_max_queue")
                              if max_queue is None else max_queue)
         self.tenant_quota = int(get_flag("serving_tenant_quota")
                                 if tenant_quota is None else tenant_quota)
+        # None defers to the flag at expiry time (live-tunable)
+        self._ttl_ms = request_ttl_ms
+        self._tiers: Dict[str, int] = {}
         self._queued = 0
         self._inflight: Dict[str, int] = {}
         # own lock: try_admit runs on client threads (under the queue's
@@ -110,11 +161,39 @@ class AdmissionController:
         # which outer lock the caller holds
         self._lock = threading.Lock()
 
+    # ------------------------------------------------------------ tiers
+    def set_tier(self, tenant: str, tier) -> None:
+        """Pin ``tenant`` to a priority tier: ``"interactive"`` (0, the
+        default) or ``"bulk"`` (1) — or any int, lower = more urgent."""
+        with self._lock:
+            self._tiers[tenant] = (self.TIERS[tier] if isinstance(tier, str)
+                                   else int(tier))
+
+    def tier_of(self, tenant: str) -> int:
+        with self._lock:
+            return self._tiers.get(tenant, 0)
+
+    def ttl_s(self) -> float:
+        """The live request TTL in seconds (<=0 disables)."""
+        ms = self._ttl_ms
+        if ms is None:
+            from ..base.flags import get_flag
+
+            ms = float(get_flag("serving_request_ttl_ms"))
+        return float(ms) / 1e3
+
     def try_admit(self, tenant: str, n: int) -> Optional[str]:
         """None = admitted (state charged); else the refusing gate."""
         with self._lock:
             if self.max_queue > 0 and self._queued + n > self.max_queue:
                 return "queue"
+            if self._tiers.get(tenant, 0) > 0 and self.max_queue > 0:
+                from ..base.flags import get_flag
+
+                cap = int(self.max_queue
+                          * float(get_flag("serving_bulk_queue_share")))
+                if self._queued + n > cap:
+                    return "priority"
             if (self.tenant_quota > 0
                     and self._inflight.get(tenant, 0) + n > self.tenant_quota):
                 return "tenant"
@@ -192,6 +271,79 @@ class RequestQueue:
             raise AdmissionError(gate, refusal)
         return request
 
+    def _expire_locked(self, now: float) -> None:
+        """Fail every request whose queue wait exceeded the TTL (caller
+        holds the condition). Requests enqueue in arrival order, so the
+        overdue set is always a prefix of the deque — dead work leaves
+        BEFORE batch assembly instead of occupying a program call whose
+        client already timed out."""
+        ttl = self.admission.ttl_s()
+        if ttl <= 0:
+            return
+        expired = []
+        while self._dq and (now - self._dq[0].t_enqueue) > ttl:
+            r = self._dq.popleft()
+            self.admission.on_dispatch(r.tenant, r.n)
+            self.admission.on_complete(r.tenant, r.n)
+            expired.append(r)
+        if not expired:
+            return
+        from ..observability.metrics import registry
+
+        counter = registry.counter(
+            "serving.expired",
+            "requests expired in queue past FLAGS_serving_request_ttl_ms "
+            "(failed with AdmissionError reason='ttl', never executed)")
+        for r in expired:
+            wait_ms = (now - r.t_enqueue) * 1e3
+            counter.inc(tenant=r.tenant)
+            if hasattr(self.stats, "record_expired"):
+                self.stats.record_expired(tenant=r.tenant)
+            r._fail(AdmissionError(
+                "ttl", f"request {r.id} expired after {wait_ms:.1f}ms in "
+                       f"queue (> FLAGS_serving_request_ttl_ms = "
+                       f"{self.admission.ttl_s() * 1e3:.1f}ms); dead work "
+                       "is refused, not executed"))
+
+    def take_slots(self, max_requests: int,
+                   timeout: Optional[float] = None) -> List[Request]:
+        """Decode-scheduler side: pop up to ``max_requests`` pending
+        requests in (priority tier, FIFO) order — the slot-admission path
+        of the continuous-batching loop. Interactive-tier requests go
+        first regardless of queue position (bulk work preempted at
+        admission); within a tier FIFO order holds. TTL-overdue requests
+        are expired first, never handed out. Returns ``[]`` on
+        timeout/closed-empty; with ``timeout`` of 0/None it never blocks
+        (the decode loop polls between steps)."""
+        if max_requests <= 0:
+            return []
+        with self._cond:
+            self._expire_locked(time.perf_counter())
+            if not self._dq and timeout:
+                deadline = time.perf_counter() + timeout
+                while not self._dq and not self.closed:
+                    rest = deadline - time.perf_counter()
+                    if rest <= 0:
+                        break
+                    self._cond.wait(rest)
+                self._expire_locked(time.perf_counter())
+            if not self._dq:
+                return []
+            order = sorted(
+                range(len(self._dq)),
+                key=lambda i: (self.admission.tier_of(self._dq[i].tenant), i))
+            chosen = order[:int(max_requests)]
+            # returned in PRIORITY order (interactive lanes anchor prefill
+            # grouping); the survivors keep their FIFO deque order
+            taken = [self._dq[i] for i in chosen]
+            chosen_set = set(chosen)
+            kept = [r for i, r in enumerate(self._dq) if i not in chosen_set]
+            self._dq.clear()
+            self._dq.extend(kept)
+            for r in taken:
+                self.admission.on_dispatch(r.tenant, r.n)
+            return taken
+
     def take_batch(self, buckets, max_total: Optional[int] = None,
                    timeout: Optional[float] = None,
                    linger: float = 0.0):
@@ -211,6 +363,7 @@ class RequestQueue:
 
         deadline = (time.perf_counter() + timeout) if timeout else None
         with self._cond:
+            self._expire_locked(time.perf_counter())
             while not self._dq:
                 if self.closed:
                     return [], None
@@ -218,6 +371,7 @@ class RequestQueue:
                 if rest is not None and rest <= 0:
                     return [], None
                 self._cond.wait(rest if rest is not None else 0.1)
+                self._expire_locked(time.perf_counter())
             ladder = list(buckets()) if callable(buckets) else list(buckets)
             cap = (min(int(max_total), int(ladder[-1])) if max_total
                    else int(ladder[-1]))
@@ -235,6 +389,9 @@ class RequestQueue:
                     ladder = list(buckets())
                     cap = (min(int(max_total), int(ladder[-1])) if max_total
                            else int(ladder[-1]))
+                self._expire_locked(time.perf_counter())
+                if not self._dq:
+                    return [], None
             try:
                 k, bucket = assemble_bucket([r.n for r in self._dq], ladder,
                                             cap)

@@ -26,15 +26,21 @@ from .request_queue import Request, RequestQueue
 
 def stack_requests(requests: Sequence[Request], bucket: int,
                    dynamic_axes: Dict[int, int],
-                   n_inputs: int) -> List[np.ndarray]:
+                   n_inputs: int,
+                   seq_axes: Optional[Dict[int, int]] = None,
+                   seq_bucket: Optional[int] = None) -> List[np.ndarray]:
     """Concatenate each input across requests along its batch axis and
-    zero-pad up to ``bucket``. Inputs without a dynamic axis (static side
-    inputs of a partially dynamic export) are per-BATCH, not per-sample —
-    every batched request must carry the same value, verified bit-wise
-    (serving request 1's rows with request 0's side input would be a
-    silent cross-tenant data leak; a loud batch failure is the contract)."""
+    zero-pad up to ``bucket``. On two-axis exports each request's
+    sequence axis (``seq_axes``: {input_idx: axis}) is first right-padded
+    up to ``seq_bucket`` so mixed-length requests stack into one (batch,
+    seq) rung. Inputs without a dynamic axis (static side inputs of a
+    partially dynamic export) are per-BATCH, not per-sample — every
+    batched request must carry the same value, verified bit-wise (serving
+    request 1's rows with request 0's side input would be a silent
+    cross-tenant data leak; a loud batch failure is the contract)."""
     stacked = []
     axes = dynamic_axes or {i: 0 for i in range(n_inputs)}
+    seq_axes = seq_axes or {}
     for i in range(n_inputs):
         if i not in axes:
             head = np.asarray(requests[0].inputs[i])
@@ -47,7 +53,16 @@ def stack_requests(requests: Sequence[Request], bucket: int,
             stacked.append(head)
             continue
         ax = axes[i]
-        parts = [np.asarray(r.inputs[i]) for r in requests]
+        parts = []
+        for r in requests:
+            a = np.asarray(r.inputs[i])
+            sax = seq_axes.get(i)
+            if (sax is not None and seq_bucket is not None
+                    and a.shape[sax] < seq_bucket):
+                widths = [(0, 0)] * a.ndim
+                widths[sax] = (0, seq_bucket - a.shape[sax])
+                a = np.pad(a, widths)
+            parts.append(a)
         cat = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=ax)
         short = bucket - cat.shape[ax]
         if short > 0:
@@ -81,20 +96,33 @@ def fetch_outputs(outputs: Sequence) -> List[np.ndarray]:
 
 
 def scatter_outputs(outputs: Sequence[np.ndarray],
-                    requests: Sequence[Request]) -> List[List[np.ndarray]]:
+                    requests: Sequence[Request],
+                    seq_bucket: Optional[int] = None,
+                    out_seq_axes: Optional[Dict[int, int]] = None
+                    ) -> List[List[np.ndarray]]:
     """Split each output's leading axis back into per-request row blocks
     (the padding tail is dropped). Output batch axis is 0 by the serving
-    export contract."""
+    export contract; on two-axis exports the seq pad is sliced back to
+    each request's real length (``Request.seq``) on exactly the axes the
+    export's out_avals mark symbolic (``out_seq_axes``: {leaf_idx: axis}
+    from ``_BatchProgram`` — never a runtime shape guess, so a static
+    axis that happens to equal the rung survives untouched)."""
     per_request: List[List[np.ndarray]] = [[] for _ in requests]
     offsets = []
     pos = 0
     for r in requests:
         offsets.append(pos)
         pos += r.n
-    for out in outputs:
+    for idx, out in enumerate(outputs):
         arr = np.asarray(out)
+        ax = (out_seq_axes or {}).get(idx)
         for j, r in enumerate(requests):
-            per_request[j].append(arr[offsets[j]: offsets[j] + r.n])
+            rows = arr[offsets[j]: offsets[j] + r.n]
+            if (ax is not None and seq_bucket is not None
+                    and r.seq is not None and r.seq < seq_bucket
+                    and rows.shape[ax] == seq_bucket):
+                rows = np.take(rows, range(r.seq), axis=ax)
+            per_request[j].append(rows)
     return per_request
 
 
@@ -177,3 +205,227 @@ class Scheduler:
             return True
         self._thread.join(timeout)
         return not self._thread.is_alive()
+
+
+class DecodeScheduler:
+    """The decode tier's one executor thread: true continuous batching
+    over a KV slot pool. Every loop iteration is ONE program call —
+    prefill OR decode — and between any two calls requests JOIN (queued →
+    freed slot, priority order) and LEAVE (finished → slot released,
+    future resolved). No full-batch re-assembly ever happens: running
+    sequences keep their device-resident KV rows and simply appear in the
+    next step's gathered lane set.
+
+    Step policy: prefill-first. A waiting prompt joins the batch at the
+    very next boundary (its compute also emits its first token), then
+    decode steps serve every active lane at once. Prefill groups share
+    one seq rung (anchored at the OLDEST waiting request, so rung
+    grouping never starves FIFO order across rungs) and are capped at
+    ``prefill_max_batch`` lanes.
+
+    Crashes in a program call fail only the lanes that rode it — their
+    slots release, the loop survives and keeps serving."""
+
+    def __init__(self, queue: RequestQueue, programs, pool, *,
+                 prefill_max_batch: int, eos_id: Optional[int] = None,
+                 stats=None, on_step: Optional[Callable] = None):
+        self.queue = queue
+        self.programs = programs
+        self.pool = pool
+        self.prefill_max_batch = max(int(prefill_max_batch), 1)
+        self.eos_id = eos_id
+        self.stats = stats
+        self.on_step = on_step           # (kind, lanes, rung, emitted) tap
+        self._active: Dict[int, object] = {}    # slot -> DecodeRequest
+        self._pending: List[object] = []        # slot held, prefill due
+        self._step_lanes: List[object] = []     # lanes riding the current call
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "DecodeScheduler":
+        if self._thread is not None:
+            raise RuntimeError("decode scheduler already started")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="paddle-serving-decode",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def active_count(self) -> int:
+        """Sequences holding a slot right now (active, awaiting prefill,
+        or riding the in-flight program call)."""
+        seen = {id(r) for r in self._active.values()}
+        seen.update(id(r) for r in self._pending)
+        seen.update(id(r) for r in self._step_lanes)
+        return len(seen)
+
+    # ------------------------------------------------------------ the loop
+    def _loop(self) -> None:
+        from ..observability.anomaly import monitor
+        from ..observability.memory import sampler
+
+        while True:
+            stepped = self._admit_and_step(monitor)
+            if not stepped:
+                if (self.queue.closed and len(self.queue) == 0
+                        and not self._active and not self._pending):
+                    break
+            else:
+                # step-boundary memory telemetry (sync-free by contract)
+                sampler.maybe_sample("batch")
+        self._stopped.set()
+
+    def _admit_and_step(self, monitor) -> bool:
+        """One scheduler beat: admit queued requests into free slots,
+        then run one prefill-or-decode call. Returns False when fully
+        idle (nothing admitted, nothing to step)."""
+        free = self.pool.free_count()
+        if free > 0:
+            idle = not self._active and not self._pending
+            taken = self.queue.take_slots(
+                free, timeout=0.05 if idle else 0.0)
+            now = time.perf_counter()
+            for r in taken:
+                r.slot = self.pool.alloc()
+                r.seq_rung = self._seq_rung(r)
+                r.t_dispatch = now
+                self._pending.append(r)
+        if self._pending:
+            self._guarded(self._prefill_step, monitor)
+            return True
+        if self._active:
+            self._guarded(self._decode_step, monitor)
+            return True
+        return False
+
+    def _seq_rung(self, r) -> int:
+        from ..jit.bucketing import bucket_for
+
+        return bucket_for(int(r.prompt.size), self.programs.seq_ladder)
+
+    def _guarded(self, step, monitor) -> None:
+        """Batch-scoped fault wall: a crashed program call fails exactly
+        the lanes it carried (``_step_lanes``, set by the step before its
+        program call) and frees their slots; pending prefills and active
+        lanes that did NOT ride the call keep serving."""
+        try:
+            step()
+        except BaseException as e:  # noqa: BLE001 — batch-scoped fault wall
+            if monitor.enabled:
+                monitor.on_exception("serving.decode_worker", e)
+            involved, self._step_lanes = self._step_lanes, []
+            for r in involved:
+                if r.slot is not None:
+                    self._active.pop(r.slot, None)
+                    self.pool.release(r.slot)
+                    r.slot = None
+                self.queue.admission.on_complete(r.tenant, r.n)
+                r._fail(e)
+
+    # ------------------------------------------------------------- steps
+    def _prefill_step(self) -> None:
+        from ..jit.bucketing import bucket_for
+        from ..observability.tracing import tracer
+
+        rung = self._pending[0].seq_rung  # oldest request anchors the rung
+        group = [r for r in self._pending
+                 if r.seq_rung == rung][: self.prefill_max_batch]
+        for r in group:
+            self._pending.remove(r)
+        self._step_lanes = list(group)  # the fault wall's blast radius
+        b_rung = bucket_for(len(group), self.programs.prefill_batch_rungs)
+        pad = self.pool.pad_slot
+        tokens = np.zeros((b_rung, rung), np.int32)
+        lengths = np.ones(b_rung, np.int32)
+        slots = np.full(b_rung, pad, np.int32)
+        for i, r in enumerate(group):
+            L = int(r.prompt.size)
+            tokens[i, :L] = r.prompt
+            lengths[i] = L
+            slots[i] = r.slot
+        t0 = time.perf_counter()
+        with tracer.span("serving.decode", track="serving.scheduler",
+                         kind="prefill", rung=(b_rung, rung),
+                         lanes=len(group)):
+            ck, cv, toks = self.programs.prefill(
+                self.pool.k, self.pool.v, tokens, lengths, slots)
+            self.pool.commit(ck, cv)
+            toks = np.asarray(toks)
+        self._absorb(group, toks, kind="prefill",
+                     seconds=time.perf_counter() - t0, rung=(b_rung, rung))
+
+    def _decode_step(self) -> None:
+        from ..jit.bucketing import bucket_for
+        from ..observability.tracing import tracer
+
+        lanes = sorted(self._active.values(), key=lambda r: r.id)
+        self._step_lanes = list(lanes)  # the fault wall's blast radius
+        b_rung = bucket_for(len(lanes), self.programs.decode_rungs)
+        pad = self.pool.pad_slot
+        tokens = np.zeros(b_rung, np.int32)
+        slots = np.full(b_rung, pad, np.int32)
+        positions = np.zeros(b_rung, np.int32)
+        for i, r in enumerate(lanes):
+            tokens[i] = r.generated[-1]
+            slots[i] = r.slot
+            positions[i] = r.position
+        t0 = time.perf_counter()
+        with tracer.span("serving.decode", track="serving.scheduler",
+                         kind="decode", rung=b_rung, lanes=len(lanes)):
+            ck, cv, toks = self.programs.decode(
+                self.pool.k, self.pool.v, tokens, slots, positions)
+            self.pool.commit(ck, cv)
+            toks = np.asarray(toks)
+        self._absorb(lanes, toks, kind="decode",
+                     seconds=time.perf_counter() - t0, rung=b_rung)
+
+    def _absorb(self, lanes, toks, *, kind: str, seconds: float,
+                rung) -> None:
+        """Scatter one step's emitted tokens back to their requests,
+        retire finished sequences (slot released, future resolved), keep
+        the rest active for the next step."""
+        self._step_lanes = []  # the call succeeded: nothing to fail
+        for i, r in enumerate(lanes):
+            tok = int(toks[i])
+            r.generated.append(tok)
+            self.pool.lengths[r.slot] = r.position
+            done = (len(r.generated) >= r.max_new_tokens
+                    or (self.eos_id is not None and tok == self.eos_id)
+                    or r.position >= self.pool.max_seq)
+            if done:
+                self._retire(r)
+            else:
+                self._active[r.slot] = r
+        if self.stats is not None:
+            self.stats.record_decode_step(kind, seconds, len(lanes),
+                                          len(lanes))
+            self.stats.record_slot_occupancy(self.pool.in_use(),
+                                             self.pool.max_slots)
+        if self.on_step is not None:
+            self.on_step(kind, len(lanes), rung, len(lanes))
+
+    def _retire(self, r) -> None:
+        from ..observability.anomaly import monitor
+
+        self._active.pop(r.slot, None)
+        self.pool.release(r.slot)
+        r.slot = None
+        self.queue.admission.on_complete(r.tenant, r.n)
+        r._complete(np.asarray(r.generated, np.int32))
+        if self.stats is not None:
+            self.stats.record_request(r.t_enqueue, r.t_admit, r.t_dispatch,
+                                      r.t_complete, r.n, tenant=r.tenant)
+        if monitor.enabled:
+            monitor.on_serving_request(
+                r.t_complete - r.t_enqueue, r.t_dispatch - r.t_admit,
+                tenant=r.tenant)

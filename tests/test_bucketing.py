@@ -8,6 +8,8 @@ from paddle_tpu.jit.bucketing import (
     BucketedFunction,
     bucket_collate,
     bucket_for,
+    bucket_grid,
+    bucket_pair_for,
     pad_to_bucket,
     powers_of_two_buckets,
 )
@@ -18,6 +20,18 @@ def test_bucket_ladder():
     assert powers_of_two_buckets(16, 100) == [16, 32, 64, 128]
     assert bucket_for(17, [16, 32, 64]) == 32
     assert bucket_for(16, [16, 32, 64]) == 16
+
+
+def test_two_axis_grid_and_pair():
+    """ISSUE 13: the second (sequence) bucket axis — rung pairs round up
+    each axis on its OWN ladder, the grid is their product."""
+    assert bucket_grid([1, 2], [8, 16]) == [(1, 8), (1, 16), (2, 8), (2, 16)]
+    assert bucket_pair_for(2, 9, [1, 2, 4], [8, 16]) == (2, 16)
+    assert bucket_pair_for(3, 8, [1, 2, 4], [8, 16]) == (4, 8)
+    import pytest
+
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_pair_for(1, 17, [1, 2], [8, 16])
 
 
 def test_pad_to_bucket_tensor():

@@ -124,6 +124,24 @@ def test_serving_audit_green_on_demo_engine(tmp_path):
     assert report["compiled_rungs"] == 3  # one per demo ladder rung
 
 
+def test_serving_audit_green_on_demo_decode_engine():
+    """ISSUE 13 satellite: the serving lint family audits the KV decode
+    path too — the demo decode engine holds the retrace-free AND
+    slot-residency contracts (JX330-JX333) under real joined/left
+    traffic."""
+    from paddle_tpu.analysis.jaxpr_audit import (audit_serving,
+                                                 record_demo_decode_engine)
+
+    engine = record_demo_decode_engine()
+    assert [str(f) for f in audit_serving(engine)] == []
+    assert engine.compiles_after_warmup == 0
+    report = engine.serving_report()
+    assert report["requests"] == 3
+    assert report["kv_pool_bytes_constant"] is True
+    assert report["decode"]["tokens"] > 0
+    assert engine.kv_pool.in_use() == 0  # every slot released
+
+
 def test_telemetry_contract_green_on_live_process():
     """ISSUE 7 + 8: the observability layer's own contract holds — the
     observability/ tree has no device sync inside a sampler (OB602), the

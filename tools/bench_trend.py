@@ -38,12 +38,15 @@ DEFAULT_METRIC = "gpt_tiny_train_tokens_per_sec_cpu"
 # extra dotted paths into the parsed payload tracked alongside the
 # headline — the persistent compile cache's cold-vs-warm start ratio
 # (bench extras.coldstart, ISSUE 9), the quantized dp-sync payload
-# saving over the fp32 ring (bench extras.comm, ISSUE 10) and the zero1
+# saving over the fp32 ring (bench extras.comm, ISSUE 10), the zero1
 # sharded-vs-replicated optimizer-state residency ratio (bench
-# extras.zero1, ISSUE 12); each gates only once two rounds carry it
+# extras.zero1, ISSUE 12) and the continuous-batched GPT decode
+# throughput (bench extras.serving, ISSUE 13); each gates only once two
+# rounds carry it
 DEFAULT_EXTRAS = ("coldstart.train_warm_speedup_x",
                   "comm.allreduce_bytes_saved_ratio",
-                  "zero1.opt_state_bytes_ratio")
+                  "zero1.opt_state_bytes_ratio",
+                  "serving.decode_tokens_per_sec")
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
 

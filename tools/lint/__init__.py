@@ -181,18 +181,22 @@ def _run_cost(_paths, include_tests=False):
 
 
 def _run_serving(_paths, include_tests=False):
-    """Build the representative serving engine (tiny exported MLP, warmed
-    3-rung ladder, two tenants' mixed-size traffic) and audit its
-    retrace-free contract (JX330/JX331, analysis/jaxpr_audit.py)."""
+    """Build the representative serving engines — the batch tier (tiny
+    exported MLP, warmed 3-rung ladder, two tenants' mixed-size traffic)
+    AND the decode tier (tiny GPT over a KV slot pool, mixed prompts
+    joining/leaving the running batch) — and audit the retrace-free +
+    slot-residency contracts (JX330-JX333, analysis/jaxpr_audit.py)."""
     import shutil
     import tempfile
 
-    from paddle_tpu.analysis.jaxpr_audit import audit_serving, record_demo_engine
+    from paddle_tpu.analysis.jaxpr_audit import (
+        audit_serving, record_demo_decode_engine, record_demo_engine)
 
     tmpdir = tempfile.mkdtemp(prefix="paddle_lint_serving_")
     try:
-        engine = record_demo_engine(tmpdir)
-        return audit_serving(engine)
+        findings = list(audit_serving(record_demo_engine(tmpdir)))
+        findings += audit_serving(record_demo_decode_engine())
+        return findings
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
