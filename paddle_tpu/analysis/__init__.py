@@ -38,6 +38,10 @@ analyzers that run at commit time:
   (QZ8xx): quantized-allreduce accuracy/determinism gates, portable
   reshard route engagement, no mixed gradient-sync wire dtypes on one
   mesh axis.
+- :mod:`fault_check` — the reliability layer's hygiene (FT9xx): no
+  FaultInjector left armed outside a chaos run, no RetryPolicy with a
+  dead deadline budget, no injection into a fault site whose
+  release/cleanup path is undeclared.
 
 One CLI drives them all: ``python -m tools.lint`` (exit 1 on any
 error-severity finding, 2 on an analyzer crash; ``--json`` for
@@ -50,10 +54,13 @@ from dataclasses import dataclass, field
 __all__ = [
     "Finding",
     "audit_compiled_function",
+    "audit_fault_injector",
     "audit_jaxpr",
     "audit_kernel_cache",
     "audit_telemetry",
     "check_cost",
+    "check_fault_paths",
+    "check_fault_source",
     "check_registry",
     "check_spmd_paths",
     "check_spmd_source",
@@ -212,3 +219,21 @@ def check_spmd_source(source, filename="<string>", **kwargs):
     from .spmd_check import check_source as _impl
 
     return _impl(source, filename, **kwargs)
+
+
+def check_fault_paths(paths):
+    from .fault_check import check_paths as _impl
+
+    return _impl(paths)
+
+
+def check_fault_source(source, filename="<string>"):
+    from .fault_check import check_source as _impl
+
+    return _impl(source, filename)
+
+
+def audit_fault_injector(injector="__live__"):
+    from .fault_check import audit_injector as _impl
+
+    return _impl(injector)

@@ -1,0 +1,148 @@
+"""Fault/reliability hygiene checker (FT9xx): the chaos layer's own gate.
+
+A fault injector is a loaded gun: left armed in a production process it
+fires real faults into real traffic; a retry loop without a deadline
+turns a transient outage into an unbounded stall on the calling thread;
+and an injection site nobody declared a cleanup path for is a chaos test
+that *creates* the leak it claims to hunt. This module gates all three,
+wired as the ``fault`` family of ``python -m tools.lint``:
+
+FT900  injector left armed       ``reliability.faults.active()`` is not
+                                 None in the audited process — a chaos
+                                 run (or a test) armed the process
+                                 FaultInjector and never disarmed it, so
+                                 ordinary traffic is being injected into
+                                 (error)
+FT901  retry without deadline    static AST rule: a ``RetryPolicy(...)``
+                                 construction passes ``deadline_s`` as a
+                                 literal ``None``/``0``/negative — the
+                                 runtime constructor rejects these too,
+                                 but the lint catches the dead config
+                                 before it ships (the flag-driven
+                                 default is always positive) (error)
+FT902  undeclared fault site     static AST rule: a ``fault_point("x")``
+                                 / ``fire("x")`` literal site that is
+                                 not declared in ``reliability.faults.
+                                 SITES`` — every injectable site must
+                                 document its release/cleanup path (what
+                                 frees the slots, fails the futures,
+                                 keeps the previous checkpoint) before
+                                 anything may inject into it (error)
+
+Shared ``# noqa: FT9xx`` grammar with the trace linter.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence
+
+from . import Finding
+
+_ANALYZER = "fault"
+
+
+def audit_injector(injector: Optional[object] = "__live__") -> List[Finding]:
+    """FT900 over the live (or a given) injector state."""
+    from ..reliability import faults
+
+    if injector == "__live__":
+        injector = faults.active()
+    findings: List[Finding] = []
+    if injector is not None:
+        armed = sorted(getattr(injector, "plans", {}) or {})
+        findings.append(Finding(
+            _ANALYZER, "FT900", "error",
+            "a reliability FaultInjector is ARMED in this process "
+            f"(seed={getattr(injector, 'seed', '?')}, sites={armed}) — "
+            "chaos schedules must disarm() when done; ordinary traffic "
+            "is currently being injected into", "reliability.faults"))
+    return findings
+
+
+class _FaultVisitor(ast.NodeVisitor):
+    def __init__(self, filename: str, declared_sites):
+        self.filename = filename
+        self.declared = declared_sites
+        self.findings: List[Finding] = []
+
+    def _flag(self, code: str, node, message: str) -> None:
+        self.findings.append(Finding(
+            _ANALYZER, code, "error", message,
+            f"{self.filename}:{getattr(node, 'lineno', 0)}"))
+
+    @staticmethod
+    def _callee_name(node: ast.Call) -> str:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        if isinstance(fn, ast.Name):
+            return fn.id
+        return ""
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = self._callee_name(node)
+        if name == "RetryPolicy":
+            self._check_retry(node)
+        elif name in ("fault_point", "fire"):
+            self._check_site(node)
+        self.generic_visit(node)
+
+    def _check_retry(self, node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg != "deadline_s":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and (
+                    v.value is None
+                    or (isinstance(v.value, (int, float))
+                        and not isinstance(v.value, bool)
+                        and v.value <= 0)):
+                self._flag(
+                    "FT901", node,
+                    f"RetryPolicy with deadline_s={v.value!r}: a retry "
+                    "loop needs a positive wall-clock budget — without "
+                    "one a transient outage becomes an unbounded stall "
+                    "on the calling thread")
+
+    def _check_site(self, node: ast.Call) -> None:
+        if not node.args:
+            return
+        arg = node.args[0]
+        if not isinstance(arg, ast.Constant) or not isinstance(arg.value, str):
+            return  # dynamic site names are the injector's own problem
+        site = arg.value
+        if site not in self.declared:
+            self._flag(
+                "FT902", node,
+                f"fault site {site!r} is not declared in reliability."
+                "faults.SITES — every injectable site must document its "
+                "release/cleanup path (slot release, future failure, "
+                "previous-checkpoint retention) before it may be "
+                "injected into")
+
+
+def check_source(source: str, filename: str = "<string>") -> List[Finding]:
+    from ..reliability.faults import SITES
+    from .trace_safety import _apply_noqa
+
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Finding(_ANALYZER, "FT999", "error",
+                        f"could not parse {filename}: {e}", filename)]
+    visitor = _FaultVisitor(filename, frozenset(SITES))
+    visitor.visit(tree)
+    return _apply_noqa(visitor.findings, source)
+
+
+def check_paths(paths: Sequence[str]) -> List[Finding]:
+    """FT901/FT902 over every ``.py`` file under ``paths`` + FT900 over
+    the live process."""
+    from . import iter_py_files
+
+    findings: List[Finding] = list(audit_injector())
+    for f in iter_py_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            findings.extend(check_source(fh.read(), f))
+    return findings

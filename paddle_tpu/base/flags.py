@@ -286,6 +286,45 @@ define_flag("sharding_stage", "",
             "compile key). The weight all-gather rides the int8 "
             "blockwise-scale wire when the comm quantized tier is engaged "
             "(FLAGS_comm_quantize_dp_grads / amp comm_dtype)")
+define_flag("fault_inject", "",
+            "reliability fault injection (paddle_tpu.reliability.faults): "
+            "'site:rate:kind[:delay_ms][,...]' arms the process "
+            "FaultInjector at that seeded schedule (kinds: raise, "
+            "latency, corrupt; seed from FLAGS_fault_seed); empty "
+            "disarms — the production default. FT900 errors on an "
+            "injector left armed outside a chaos/test run")
+define_flag("fault_seed", 0,
+            "reliability fault injection: seed of the per-site "
+            "deterministic RNG streams — the same (seed, spec) pair "
+            "replays the same fault schedule exactly")
+define_flag("retry_max_attempts", 3,
+            "reliability RetryPolicy default: bounded attempts per "
+            "wrapped call (transient failures only; fatal errors "
+            "propagate on the first attempt)")
+define_flag("retry_deadline_s", 30.0,
+            "reliability RetryPolicy default: wall-clock budget across "
+            "all attempts of one wrapped call — no retry starts past it "
+            "(FT901 errors on a policy without a deadline)")
+define_flag("retry_base_delay_ms", 20.0,
+            "reliability RetryPolicy default: first backoff delay; "
+            "doubles per attempt (deterministic, no jitter — chaos "
+            "schedules replay exactly)")
+define_flag("circuit_failure_threshold", 5,
+            "reliability CircuitBreaker default: consecutive failures "
+            "before a key (tenant/program) flips open and admission "
+            "sheds its load (AdmissionError reason='circuit')")
+define_flag("circuit_cooldown_s", 30.0,
+            "reliability CircuitBreaker default: how long an open "
+            "breaker sheds before half-opening for probe traffic")
+define_flag("train_snapshot_every", 0,
+            "hapi.Model.fit default for snapshot_every: land an atomic "
+            "rolling train-state snapshot (step, params, optimizer "
+            "shards, RNG, loader cursor) every N steps into "
+            "snapshot_dir; 0 disables the cadence (a preemption "
+            "SIGTERM still snapshots when snapshot_dir is set)")
+define_flag("train_snapshot_keep", 2,
+            "reliability TrainSnapshotter: rolling window — newest N "
+            "snapshots survive, older ones are pruned after each commit")
 define_flag("cost_max_guard_preds", 8,
             "cost-model lint (CM505): a speculative branch family "
             "verifying more guard predicates than this per call is "

@@ -91,6 +91,22 @@ def record(event: str, n: float = 1) -> None:
 # re-walk the directory per event ({"dir": ..., "bytes": ...})
 _disk_state = {"dir": None, "bytes": 0}
 
+# bounded-retry store I/O (ISSUE 14): a transient read/write fault (NFS
+# hiccup, injected chaos) costs a backoff instead of a cold compile or a
+# lost publish; a give-up STILL degrades to the legacy behavior (miss /
+# in-memory only) — the cache must never take down its caller
+_retry_policies: dict = {}
+
+
+def _io_retry(site: str):
+    policy = _retry_policies.get(site)
+    if policy is None:
+        from ..reliability.policy import RetryPolicy
+
+        policy = _retry_policies[site] = RetryPolicy(
+            site, max_delay_s=0.25, deadline_s=10.0)
+    return policy
+
 
 def stats(disk: bool = True) -> dict:
     """Counter snapshot + store size (when the tier is on). ``disk=True``
@@ -125,8 +141,20 @@ def load_executable(digest: Optional[str], site: str = "") -> Optional[Any]:
     if digest is None or not enabled():
         return None
     t0 = time.perf_counter()
-    payload, why = _store.read_entry(cache_dir(), digest,
-                                     expected_fp_digest=fingerprint_digest())
+    try:
+        payload, why = _io_retry("compile_cache.load").run(
+            _store.read_entry, cache_dir(), digest,
+            expected_fp_digest=fingerprint_digest())
+    except Exception as e:
+        # retries exhausted: a broken store is a miss, never a crash
+        record("load_error")
+        record("miss")
+        from ..base.log import get_logger
+
+        get_logger().warning(
+            "compile_cache: load of %s failed after retries (%s) — "
+            "compiling normally", digest[:12], e)
+        return None
     if payload is None:
         if why in ("corrupt", "fingerprint_mismatch"):
             record(why)
@@ -186,7 +214,14 @@ def store_executable(digest: Optional[str], compiled: Any,
             (key_meta or {}).get("site", digest[:12]), e)
         return False
     d = cache_dir()
-    if not _store.write_entry(d, digest, payload, key_meta=key_meta):
+    try:
+        written = _io_retry("compile_cache.store").run(
+            _store.write_entry, d, digest, payload, key_meta=key_meta)
+    except Exception:
+        # retries exhausted: the executable stays in-memory only — same
+        # degradation contract as a read-only store
+        written = False
+    if not written:
         record("store_error")
         return False
     dur = time.perf_counter() - t0

@@ -85,6 +85,14 @@ def write_entry(cache_dir: str, digest: str, payload: bytes,
     head = json.dumps(header, sort_keys=True).encode()
     final = entry_path(cache_dir, digest)
     tmp = final + _TMP_MARK + f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    # chaos hooks (reliability.faults): "raise" exercises the retry in
+    # compile_cache.store_executable, "corrupt" writes a payload whose
+    # sha256 no longer matches the header — the next load must detect
+    # it, unlink the entry and degrade to a normal compile
+    from ..reliability.faults import corrupt_bytes, fault_point
+
+    if fault_point("compile_cache.store") == "corrupt":
+        payload = corrupt_bytes(payload, "compile_cache.store")
     try:
         os.makedirs(cache_dir, exist_ok=True)
         with open(tmp, "wb") as f:
@@ -145,6 +153,9 @@ def read_entry(cache_dir: str, digest: str,
     """``(payload, why_not)`` for one digest. ``payload is None`` with
     ``why_not`` in {"miss", "corrupt", "fingerprint_mismatch"}; a corrupt
     entry is unlinked best-effort so it cannot poison every later start."""
+    from ..reliability.faults import fault_point
+
+    fault_point("compile_cache.load")  # chaos hook: transient read fault
     path = entry_path(cache_dir, digest)
     if not os.path.exists(path):
         return None, "miss"

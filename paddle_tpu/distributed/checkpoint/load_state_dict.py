@@ -48,6 +48,19 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
     for k, t in flat.items():
         entry = entries.get(k, {})
         if entry.get("chunks"):  # multi-host chunked entry: reassemble
+            # loud failure on a partial piece set (ISSUE 14 satellite):
+            # committed metadata references every chunk by key, so a key
+            # the shard files cannot serve means a shard file is missing
+            # or torn — name the gap instead of KeyError-ing on one chunk
+            absent = [ck["key"] for ck in entry["chunks"]
+                      if ck["key"] not in shards]
+            if absent:
+                raise RuntimeError(
+                    f"checkpoint at {path} is INCOMPLETE for {k!r}: "
+                    f"{len(absent)}/{len(entry['chunks'])} chunk(s) "
+                    f"missing from the shard files (first: {absent[:3]}). "
+                    "A rank's shard file is absent or torn — restore from "
+                    "a complete checkpoint; refusing a partial load")
             host = np.empty(entry["shape"], dtype=np.dtype(entry["dtype"]))
             for ck in entry["chunks"]:
                 idx = tuple(slice(a, b) for a, b in ck["index"])

@@ -33,6 +33,8 @@ from typing import Dict, Optional
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...reliability.faults import fault_point
+from ...reliability.snapshot import fsync_dir
 
 
 def _flatten_state(state_dict, prefix=""):
@@ -167,7 +169,16 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
             np.savez(f, **arrays)
             f.flush()
             os.fsync(f.fileno())
+        # the injected torn-write point (reliability chaos): a crash here
+        # leaves ONLY the fsynced .tmp file — metadata.json still points
+        # at the previous complete checkpoint
+        fault_point("ckpt.write")
         os.replace(shard_tmp, shard_final)
+        # fsync the DIRECTORY too (the compile_cache/store.py discipline
+        # completed): the rename itself must survive power loss, or a
+        # committed metadata.json can reference a shard the directory
+        # forgot (ISSUE 14 satellite)
+        fsync_dir(path)
         if chunked:
             # durable-shard ack for this save. No pre-write cleanup here:
             # deleting "stale" acks from save N while its coordinator is
@@ -198,6 +209,7 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(meta_tmp, meta_final)
+            fsync_dir(path)  # the commit rename must be durable too
             # GC: nonce-qualified shards/acks from superseded saves are
             # unreferenced now that this save's metadata is committed. Runs
             # for non-chunked commits too — a single-host save into a dir
@@ -224,6 +236,16 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
                     except OSError:
                         pass
 
+    def _write_retried():
+        # bounded retry (ISSUE 14): a transient disk fault mid-commit is
+        # replayed — safe because every piece of _write is idempotent
+        # (same tmp-then-rename names, same ack file, same metadata) —
+        # while a fatal error (or exhausted budget) propagates with the
+        # previous checkpoint still the committed one
+        from ...reliability.policy import RetryPolicy
+
+        RetryPolicy("ckpt.write", max_delay_s=0.5).run(_write)
+
     if async_save:
         # Writers for the SAME path are chained: save N+1's writer first
         # joins save N's, so overlapping async saves can never interleave
@@ -239,7 +261,7 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
                 if prev_th is not None:
                     prev_th.join()
                 try:
-                    _write()
+                    _write_retried()
                 except Exception as e:  # surfaced by wait_async_save
                     from ...base.log import get_logger
 
@@ -256,7 +278,7 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None, coordinator
         # the same path (same tmp names, and its GC would delete files an
         # uncommitted async save still references)
         _join_writers(path)
-        _write()
+        _write_retried()
 
 
 def _join_writers(path: str):

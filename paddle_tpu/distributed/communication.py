@@ -175,6 +175,9 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None, s
     policy. Non-SUM ops, non-float dtypes and tensors below
     ``FLAGS_comm_quantize_min_bytes`` always ride full precision.
     """
+    from ..reliability.faults import fault_point
+
+    fault_point("collective")  # chaos hook: a failed/slow collective entry
     if in_spmd_region():
         axes = _axes_of(group)
         from . import collective_opt as _copt
@@ -287,6 +290,9 @@ def all_gather_object(object_list: List, obj, group=None):
 
 def reduce_scatter(tensor: Tensor, tensor_or_list, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
     """reference communication/reduce_scatter.py — scatter dim 0."""
+    from ..reliability.faults import fault_point
+
+    fault_point("collective")  # chaos hook: a failed/slow collective entry
     src = tensor_or_list
     if isinstance(src, (list, tuple)):
         from ..ops import manipulation

@@ -29,6 +29,22 @@ class ElasticStatus(Enum):
     EXIT = 4
 
 
+class ElasticJoinTimeout(TimeoutError):
+    """The join barrier expired with ranks still missing. ``missing``
+    names them — the caller (launcher / operator) learns WHICH nodes
+    never registered instead of re-deriving it from a bare False."""
+
+    def __init__(self, missing, joined: int, world_size: int,
+                 timeout: float):
+        self.missing = list(missing)
+        self.joined = int(joined)
+        self.world_size = int(world_size)
+        super().__init__(
+            f"elastic join barrier: {joined}/{world_size} nodes joined "
+            f"within {timeout:.1f}s; missing ranks (no heartbeat): "
+            f"{self.missing}")
+
+
 class ElasticManager:
     def __init__(self, rank: Optional[int] = None, world_size: Optional[int] = None,
                  host: str = "127.0.0.1", port: int = 0, store=None,
@@ -73,15 +89,42 @@ class ElasticManager:
                 get_logger().warning("elastic heartbeat failed: %s", e)
             self._stop.wait(self.heartbeat_interval)
 
-    def wait_all_joined(self, timeout: float = 60.0):
-        """Barrier on node registration."""
+    def wait_all_joined(self, timeout: float = 60.0,
+                        raise_on_timeout: bool = True):
+        """Barrier on node registration. On timeout the partial roster is
+        caller-visible: :class:`ElasticJoinTimeout` names the ranks that
+        never heartbeat (``raise_on_timeout=False`` restores the legacy
+        bool and only logs them), and ``elastic.join_timeout`` ticks so
+        the scrape side sees stalled bring-ups (ISSUE 14 satellite)."""
         deadline = time.time() + timeout
+        joined = 0
         while time.time() < deadline:
             joined = int.from_bytes(self.store.get(f"elastic/{self.job_id}/joined")[:8],
                                     "little")
             if joined >= self.world_size:
                 return True
             time.sleep(0.1)
+        # name the missing ranks: a rank that registered has a heartbeat
+        # key, so the gap set is exactly the never-joined set (one
+        # survivors() sweep — its per-rank probe blocks up to 2s on an
+        # absent key, so re-evaluating per rank would be O(world²) waits)
+        live = set(self.survivors())
+        missing = [r for r in range(self.world_size) if r not in live]
+        try:
+            from ...observability.metrics import registry
+
+            registry.counter(
+                "elastic.join_timeout",
+                "elastic join barriers that expired with nodes missing "
+                "(the exception names the absent ranks)").inc()
+        except Exception:
+            pass
+        get_logger().error(
+            "elastic join barrier timed out: %d/%d joined, missing ranks %s",
+            joined, self.world_size, missing)
+        if raise_on_timeout:
+            raise ElasticJoinTimeout(missing, joined, self.world_size,
+                                     timeout)
         return False
 
     # ---------------------------------------------------------------- watch

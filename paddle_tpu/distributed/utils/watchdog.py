@@ -56,6 +56,20 @@ class CommTaskManager:
             self._seq += 1
             task = _Task(tag=tag, start=time.time(), seq=self._seq)
             self._tasks.append(task)
+        # chaos hook (reliability.faults, site "comm.watchdog"): an
+        # injected "raise" simulates a HUNG collective — the result
+        # buffer never becomes ready (no waiter marks the task done) and
+        # the task is backdated past the deadline, so the monitor thread
+        # exercises the real timeout path (log + handler + anomaly
+        # forensic bundle) on its next poll
+        hung = False
+        try:
+            from ...reliability.faults import FaultInjection, fault_point
+
+            fault_point("comm.watchdog")
+        except FaultInjection:
+            hung = True
+            task.start = time.time() - self.timeout - 1.0
 
         def waiter():
             try:
@@ -66,7 +80,8 @@ class CommTaskManager:
             finally:
                 task.done = True
 
-        threading.Thread(target=waiter, daemon=True).start()
+        if not hung:
+            threading.Thread(target=waiter, daemon=True).start()
 
     def _monitor_loop(self):
         while not self._stop.wait(self.poll_interval):
@@ -81,6 +96,25 @@ class CommTaskManager:
                     "comm watchdog: collective '%s' (seq %d) not complete after %.1fs",
                     t.tag, t.seq, age)
                 self.timeouts.append(t.tag)
+                # a hung collective produces a FORENSIC BUNDLE, not just a
+                # log line (ISSUE 14 satellite): the flight recorder grabs
+                # the span tail + metrics + step window while the stall is
+                # still observable
+                try:
+                    from ...observability.anomaly import monitor
+                    from ...observability.metrics import registry
+
+                    registry.counter(
+                        "comm.watchdog_timeout",
+                        "collectives the comm watchdog flagged as hung "
+                        "(exceeded the task deadline)").inc(tag=t.tag)
+                    if monitor.enabled:
+                        monitor.on_exception("comm.watchdog", TimeoutError(
+                            f"collective '{t.tag}' (seq {t.seq}) not "
+                            f"complete after {age:.1f}s (deadline "
+                            f"{self.timeout}s)"))
+                except Exception:
+                    pass
                 if self.on_timeout is not None:
                     self.on_timeout(t.tag, age)
                 t.done = True  # report once

@@ -33,6 +33,7 @@ class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
         self.stop_training = False
+        self._preempted = False  # SIGTERM seen mid-fit (snapshot + stop)
         self._optimizer = None
         self._loss = None
         self._metrics: List[Metric] = []
@@ -112,7 +113,8 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            device_prefetch=None, sync_every=None):
+            device_prefetch=None, sync_every=None, snapshot_dir=None,
+            snapshot_every=None, snapshot_keep=None, resume=None):
         """Train over ``train_data``. The loop is non-blocking by design:
         per-step losses stay device-resident in a :class:`MetricBuffer`
         and materialize only every ``sync_every`` steps (defaults to
@@ -122,7 +124,18 @@ class Model:
         keep the float-valued ``logs`` contract: between boundaries they
         receive the LAST materialized loss (fresh every ``sync_every``-th
         step) rather than a device handle — only an explicit
-        ``sync_every=0`` passes device values through."""
+        ``sync_every=0`` passes device values through.
+
+        Preemption safety (ISSUE 14): ``snapshot_dir`` arms atomic
+        rolling train-state snapshots (params, optimizer — zero1 shard
+        pieces included — RNG key, and the epoch/batch loader cursor)
+        every ``snapshot_every`` steps (``FLAGS_train_snapshot_every``)
+        and on SIGTERM (the preemption signal snapshots at the next step
+        boundary, then stops cleanly). ``resume=True`` (or a directory)
+        restores the newest snapshot and continues mid-epoch at the
+        EXACT next batch — with a deterministic loader the resumed loss
+        stream is bit-identical to the uninterrupted run's, and a zero1
+        job may resume onto a changed dp degree (shard re-slice)."""
         from ..base.flags import get_flag
         from ..observability.anomaly import monitor
 
@@ -138,6 +151,10 @@ class Model:
                 loader = DeviceLoader(loader, depth=int(device_prefetch))
         if sync_every is None:
             sync_every = int(get_flag("metric_sync_every")) or log_freq
+        snapshotter, cursor = self._arm_snapshots(snapshot_dir, snapshot_keep,
+                                                  resume)
+        if snapshot_every is None:
+            snapshot_every = int(get_flag("train_snapshot_every"))
         try:
             steps = len(loader)
         except TypeError:
@@ -146,12 +163,16 @@ class Model:
                                 verbose=verbose, save_freq=save_freq,
                                 save_dir=save_dir, metrics=self._metrics)
         self.stop_training = False
+        self._preempted = False
         cbks.on_train_begin()
         logs = {}
         buf = MetricBuffer(sync_every=sync_every)
+        restore_sig = self._install_sigterm(snapshotter)
         try:
             logs = self._fit_loop(loader, epochs, eval_data, eval_freq,
-                                  batch_size, num_workers, cbks, buf)
+                                  batch_size, num_workers, cbks, buf,
+                                  cursor=cursor, snapshotter=snapshotter,
+                                  snapshot_every=int(snapshot_every))
         except BaseException as e:
             if monitor.enabled:
                 # uncaught train-loop exception: capture the forensic
@@ -159,24 +180,101 @@ class Model:
                 # stack unwinds and the evidence is gone
                 monitor.on_exception("train.fit", e)
             raise
+        finally:
+            restore_sig()
         cbks.on_train_end(logs)
 
+    # -------------------------------------------------- preemption safety
+    def _arm_snapshots(self, snapshot_dir, snapshot_keep, resume):
+        """Resolve the snapshotter + the resume cursor. ``resume`` may be
+        True (use ``snapshot_dir``) or a directory; a resume target with
+        no complete snapshot starts fresh (first boot of an elastic job)
+        with a log line rather than failing the launch."""
+        if resume and not isinstance(resume, (str, bytes)) and not snapshot_dir:
+            raise ValueError("fit(resume=True) needs snapshot_dir=")
+        resume_dir = (resume if isinstance(resume, (str, bytes)) else None)
+        target = snapshot_dir or resume_dir
+        if target is None:
+            return None, None
+        from ..reliability.snapshot import TrainSnapshotter
+
+        snapshotter = TrainSnapshotter(str(resume_dir or target),
+                                       keep=snapshot_keep)
+        cursor = None
+        if resume:
+            from ..base.log import get_logger
+
+            if snapshotter.latest() is None:
+                get_logger().info(
+                    "fit(resume=...): no complete snapshot under %s — "
+                    "starting fresh", snapshotter.dir)
+            else:
+                cursor = snapshotter.restore(self.network, self._optimizer)
+                get_logger().info(
+                    "fit(resume=...): restored step %d (epoch %d, next "
+                    "batch %d) from %s", cursor["step"], cursor["epoch"],
+                    cursor["next_batch"], snapshotter.dir)
+        if snapshot_dir and resume_dir and str(snapshot_dir) != str(resume_dir):
+            # resume from one dir, keep snapshotting into another
+            snapshotter = TrainSnapshotter(str(snapshot_dir),
+                                           keep=snapshot_keep)
+        return snapshotter, cursor
+
+    def _install_sigterm(self, snapshotter):
+        """SIGTERM → snapshot-at-next-step-boundary + clean stop. Only on
+        the main thread (the interpreter's signal contract); returns the
+        zero-arg restore closure."""
+        if snapshotter is None:
+            return lambda: None
+        import signal
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            return lambda: None
+
+        def _on_sigterm(signum, frame):
+            # flag only: the snapshot (device sync + disk IO) runs at the
+            # step boundary, never inside the signal frame
+            self._preempted = True
+
+        try:
+            prev = signal.signal(signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):
+            return lambda: None
+        return lambda: signal.signal(signal.SIGTERM, prev)
+
     def _fit_loop(self, loader, epochs, eval_data, eval_freq, batch_size,
-                  num_workers, cbks, buf):
+                  num_workers, cbks, buf, cursor=None, snapshotter=None,
+                  snapshot_every=0):
         from ..observability.anomaly import monitor
         from ..observability.memory import sampler as mem_sampler
         from ..profiler.pipeline import pipeline_stats, timed
 
         logs = {}
-        for epoch in range(epochs):
+        start_epoch = int(cursor["epoch"]) if cursor else 0
+        resume_batch = int(cursor["next_batch"]) if cursor else 0
+        global_step = int(cursor["step"]) if cursor else 0
+        # epoch-pinned shuffle ONLY when the preemption-safe contract is
+        # armed (snapshots or resume): the original and resumed processes
+        # must draw the SAME index order for the cursor to land on the
+        # exact next batch. Plain fits keep their fresh-entropy shuffle —
+        # pinning every run to default_rng(epoch) would silently collapse
+        # seed-ensemble training into one run
+        pin_epochs = snapshotter is not None or cursor is not None
+        for epoch in range(start_epoch, epochs):
+            if pin_epochs and hasattr(loader, "set_epoch"):
+                loader.set_epoch(epoch)
             cbks.on_epoch_begin(epoch)
-            for step, batch in enumerate(loader):
+            skip = resume_batch if epoch == start_epoch else 0
+            for step, batch in enumerate(self._epoch_iter(loader, skip),
+                                         start=skip):
                 xs, ys = self._split_batch(batch)
                 cbks.on_train_batch_begin(step)
                 with timed(pipeline_stats.add_dispatch):
                     losses = self.train_batch(xs, ys, sync=False)
                 buf.append("loss", losses[0])
                 pipeline_stats.step()
+                global_step += 1
                 # boundary-only device-memory telemetry (sync-free: reads
                 # live-array metadata + allocator counters, never a D2H)
                 mem_sampler.maybe_sample("step")
@@ -199,19 +297,57 @@ class Model:
                     logs = {"loss": val if val is not None
                             else buf.latest("loss")}
                 cbks.on_train_batch_end(step, logs)
+                # ONE preemption point per step, after the callbacks: a
+                # SIGTERM landing anywhere inside this step (train_batch,
+                # flush, callbacks) is handled HERE with a snapshot at
+                # the exact boundary — never a silent epoch break that
+                # would skip the tail batches
+                if snapshotter is not None and (
+                        self._preempted
+                        or (snapshot_every > 0
+                            and global_step % snapshot_every == 0)):
+                    snapshotter.save(self.network, self._optimizer,
+                                     step=global_step, epoch=epoch,
+                                     next_batch=step + 1)
+                if self._preempted:
+                    from ..base.log import get_logger
+
+                    get_logger().warning(
+                        "fit: SIGTERM received — snapshot landed at step "
+                        "%d; stopping cleanly (resume with fit(resume=...))",
+                        global_step)
+                    self.stop_training = True
+                    # mid-epoch break is preemption-only: callback-driven
+                    # stop_training keeps its finish-the-epoch contract
+                    break
             report = buf.flush()
             if monitor.enabled:
                 monitor.on_flush()
             if "loss" in report:
                 logs = {"loss": report["loss"]["last"]}
             cbks.on_epoch_end(epoch, logs)
-            if eval_data is not None and (epoch % eval_freq == 0 or epoch == epochs - 1):
+            if eval_data is not None and not self._preempted and (
+                    epoch % eval_freq == 0 or epoch == epochs - 1):
                 eval_logs = self.evaluate(eval_data, batch_size=batch_size,
                                           verbose=0, num_workers=num_workers)
                 cbks.on_eval_end(eval_logs)
             if self.stop_training:
                 break
         return logs
+
+    @staticmethod
+    def _epoch_iter(loader, skip):
+        """One epoch's iterator, fast-forwarded ``skip`` batches: loaders
+        with a cursor (``DataLoader.iter_from`` — index-level, zero
+        replayed fetches) skip natively, anything else consumes."""
+        if not skip:
+            return iter(loader)
+        if hasattr(loader, "iter_from"):
+            return loader.iter_from(skip)
+        it = iter(loader)
+        for _ in range(int(skip)):
+            next(it)
+        return it
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):

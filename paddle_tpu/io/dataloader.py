@@ -73,11 +73,17 @@ def default_convert_fn(batch):
 
 
 class _MapIter:
-    """Iterator over a map dataset: optional thread workers + reorder buffer."""
+    """Iterator over a map dataset: optional thread workers + reorder buffer.
 
-    def __init__(self, loader: "DataLoader"):
+    ``skip`` drops the first N batches at the INDEX level — the batch
+    sampler is advanced before any worker fetches, so resuming mid-epoch
+    (reliability snapshot cursor) replays zero samples."""
+
+    def __init__(self, loader: "DataLoader", skip: int = 0):
         self.loader = loader
-        self.batch_iter = enumerate(iter(loader.batch_sampler))
+        self.batch_iter = enumerate(
+            itertools.islice(iter(loader.batch_sampler), skip, None)
+            if skip else iter(loader.batch_sampler))
         self.lock = threading.Lock()
         self.n_workers = max(loader.num_workers, 0)
         if self.n_workers:
@@ -368,13 +374,35 @@ class DataLoader:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
         self.collate_fn = collate_fn or default_collate_fn
 
+    def set_epoch(self, epoch: int):
+        """Propagate the epoch to a set_epoch-aware batch sampler
+        (DistributedBatchSampler; BatchSampler→RandomSampler) so the
+        shuffle order is a pure function of the epoch — the property
+        that lets a resumed process (``iter_from``) skip to the exact
+        batch its predecessor stopped at."""
+        hook = getattr(self.batch_sampler, "set_epoch", None)
+        if hook is not None:
+            hook(epoch)
+
     def __iter__(self):
+        return self.iter_from(0)
+
+    def iter_from(self, start_batch: int = 0):
+        """Iterate skipping the first ``start_batch`` batches — the
+        checkpointable-loader cursor (ISSUE 14). Map-style datasets skip
+        at the index level (no sample is fetched or collated for the
+        skipped prefix); iterable datasets and process-mode workers must
+        consume the stream to advance it."""
+        start = int(start_batch)
         if self._iterable:
             it = _IterableIter(self)
         elif self.worker_mode == "process" and self.num_workers > 0:
             it = _ProcessMapIter(self)
         else:
-            it = _MapIter(self)
+            it = _MapIter(self, skip=start)
+            start = 0
+        for _ in range(start):
+            next(it)
         if self.device_prefetch > 0:
             from .device_prefetch import _PrefetchIter
 

@@ -114,11 +114,17 @@ class _PrefetchIter:
         return False
 
     def _worker(self):
+        from ..reliability.faults import fault_point
+
         try:
             for batch in self._inner:
                 if self._stop.is_set():
                     return
                 t0 = time.perf_counter()
+                # injected h2d fault (site "io.h2d"): the raise rides the
+                # BaseException wall below into the queue, so the CONSUMER
+                # (Model.fit) gets the error instead of a hung q.get()
+                fault_point("io.h2d")
                 moved = _device_put_tree(batch, self._mesh, self._dp)
                 dt = time.perf_counter() - t0
                 self._stats.add_h2d_issue(dt)
@@ -188,7 +194,26 @@ class DeviceLoader:
         self.sharding = sharding or "none"
 
     def __iter__(self):
-        return _PrefetchIter(iter(self.loader), self.depth, self.sharding)
+        return self.iter_from(0)
+
+    def iter_from(self, start_batch: int = 0):
+        """Prefetching iterator skipping the first ``start_batch``
+        batches — delegates to the inner loader's index-level cursor
+        when it has one (``DataLoader.iter_from``), else consumes."""
+        start = int(start_batch)
+        if start and hasattr(self.loader, "iter_from"):
+            inner = self.loader.iter_from(start)
+        else:
+            inner = iter(self.loader)
+            for _ in range(start):
+                next(inner)
+        return _PrefetchIter(inner, self.depth, self.sharding)
+
+    def set_epoch(self, epoch: int):
+        """Propagate the epoch seed to a set_epoch-aware inner loader."""
+        hook = getattr(self.loader, "set_epoch", None)
+        if hook is not None:
+            hook(epoch)
 
     def __len__(self):
         return len(self.loader)

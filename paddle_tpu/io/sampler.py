@@ -39,8 +39,16 @@ class RandomSampler(Sampler):
         self.replacement = replacement
         self._num_samples = num_samples
         self.generator = generator
+        # set_epoch seed (preemption-safe loops, ISSUE 14): when no
+        # explicit generator was given, an epoch pinned here makes the
+        # shuffle a pure function of the epoch number — two processes
+        # (the original and its resumed successor) draw the SAME order
+        self._epoch = None
         if not replacement and num_samples is not None and num_samples > len(data_source):
             raise ValueError("num_samples cannot exceed dataset size when replacement=False")
+
+    def set_epoch(self, epoch: int):
+        self._epoch = int(epoch)
 
     @property
     def num_samples(self):
@@ -48,7 +56,10 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
-        rng = self.generator if isinstance(self.generator, np.random.Generator) else np.random.default_rng(self.generator)
+        seed = self.generator
+        if seed is None and self._epoch is not None:
+            seed = self._epoch
+        rng = seed if isinstance(seed, np.random.Generator) else np.random.default_rng(seed)
         if self.replacement:
             yield from rng.integers(0, n, size=self.num_samples).tolist()
         else:
@@ -113,6 +124,14 @@ class BatchSampler(Sampler):
             self.sampler = RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
         self.batch_size = batch_size
         self.drop_last = drop_last
+
+    def set_epoch(self, epoch: int):
+        """Pin the wrapped sampler's shuffle to ``epoch`` (no-op for
+        unshuffled samplers) — the resume-determinism hook the hapi fit
+        loop drives once per epoch."""
+        inner = getattr(self.sampler, "set_epoch", None)
+        if inner is not None:
+            inner(epoch)
 
     def __iter__(self) -> Iterator[List[int]]:
         batch = []

@@ -400,9 +400,13 @@ class DecodeEngine(EngineBase):
             prefill_batch_rungs=powers_of_two_buckets(1, prefill_max),
             decode_rungs=powers_of_two_buckets(1, max_slots))
         self.eos_id = eos_id
+        from ..reliability.policy import RetryPolicy
+
         self._scheduler = DecodeScheduler(
             self.queue, self.programs, self.kv_pool,
-            prefill_max_batch=prefill_max, eos_id=eos_id, stats=stats)
+            prefill_max_batch=prefill_max, eos_id=eos_id, stats=stats,
+            retry=RetryPolicy("serving.decode_step"),
+            breakers=self.breakers)
 
     # ------------------------------------------------------------ lifecycle
     def warmup(self) -> "DecodeEngine":

@@ -34,6 +34,8 @@ from ..base.flags import get_flag
 from ..inference import Config, Predictor
 from ..observability.tracing import tracer
 from ..profiler.pipeline import serving_stats
+from ..reliability.faults import fault_point
+from ..reliability.policy import BreakerBoard, RetryPolicy
 from .request_queue import AdmissionController, Request, RequestQueue
 from .scheduler import (Scheduler, fetch_outputs, scatter_outputs,
                         stack_requests)
@@ -56,9 +58,15 @@ class EngineBase:
         self.stats = stats
         self._tenants: Dict[str, object] = {}
         self._tenant_lock = threading.Lock()
+        # per-tenant circuit breakers (ISSUE 14): the scheduler feeds
+        # success/failure per served tenant; an open breaker flips the
+        # tenant to degraded — /healthz reflects it and admission sheds
+        # its load at the door (AdmissionError reason="circuit")
+        self.breakers = BreakerBoard()
         self.queue = RequestQueue(AdmissionController(
             max_queue=max_queue, tenant_quota=tenant_quota,
-            request_ttl_ms=request_ttl_ms), stats=stats)
+            request_ttl_ms=request_ttl_ms,
+            breaker_board=self.breakers), stats=stats)
         self._compiles_at_warmup: Optional[int] = None
         self._started = False
         self._scheduler = None
@@ -176,8 +184,13 @@ class EngineBase:
         depth and the zero-retrace proof. ``ok`` follows worker liveness
         while the engine is supposed to be serving."""
         alive = self._scheduler.alive() if self._scheduler else False
+        open_circuits = self.breakers.open_keys()
         return {
+            # degraded ≠ dead: open circuits shed their own tenants while
+            # the rest keep serving, so "ok" stays worker-liveness
             "ok": bool(alive) if self._started else True,
+            "health": "degraded" if open_circuits else "ok",
+            "open_circuits": open_circuits,
             "worker_alive": bool(alive),
             "started": self._started,
             "queue_depth_requests": len(self.queue),
@@ -238,9 +251,14 @@ class ServingEngine(EngineBase):
         # the second bucket axis: {input_idx: seq_axis} of rank-1 dims
         self._seq_axes = {i: ax for (i, ax), r in prog.dynamic_ranks.items()
                           if r == 1}
+        # bounded-retry program calls (ISSUE 14): a transiently failed
+        # batch replays through the SAME _execute before the fault wall
+        # gives it up — _complete/_fail are first-result-wins, so a
+        # replay can never double-resolve a future
         self._scheduler = Scheduler(
             self.queue, self._execute, lambda: prog.ladder,
-            linger_s=linger, on_batch=self._on_batch)
+            linger_s=linger, on_batch=self._on_batch,
+            retry=RetryPolicy("serving.execute"), breakers=self.breakers)
 
     # ------------------------------------------------------------ lifecycle
     def warmup(self) -> "ServingEngine":
@@ -313,6 +331,7 @@ class ServingEngine(EngineBase):
                                  seq_bucket=seq_bucket)
         import jax
 
+        fault_point("serving.execute")
         out = prog(stacked,
                    (bucket, seq_bucket) if seq_bucket is not None else bucket)
         # one batched D2H round per assembled batch, not one per leaf

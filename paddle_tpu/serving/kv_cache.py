@@ -146,7 +146,12 @@ class KVSlotPool:
         """Swap in the post-step buffers (the jitted program's functional
         outputs). Shape and dtype are pinned — a program handing back a
         different footprint is a bug the JX332 audit would otherwise
-        catch after the fact."""
+        catch after the fact. An injected ``kv.commit`` fault rejects
+        the swap BEFORE any assignment: the pool keeps the previous
+        buffers and the decode fault wall releases the step's slots."""
+        from ..reliability.faults import fault_point
+
+        fault_point("kv.commit")
         if (new_k.shape != self.k.shape or new_v.shape != self.v.shape
                 or new_k.dtype != self.k.dtype):
             raise ValueError(

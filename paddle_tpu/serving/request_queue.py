@@ -28,8 +28,11 @@ import numpy as np
 class AdmissionError(RuntimeError):
     """A submit the admission controller refused: ``reason`` is ``"queue"``
     (global sample cap), ``"tenant"`` (per-tenant in-flight quota),
-    ``"priority"`` (bulk tier refused to protect interactive headroom) or
-    ``"ttl"`` (the request expired in queue before it could be served)."""
+    ``"priority"`` (bulk tier refused to protect interactive headroom),
+    ``"ttl"`` (the request expired in queue before it could be served) or
+    ``"circuit"`` (the tenant's circuit breaker is open — its recent
+    batches kept failing, so load is shed at the door until the breaker's
+    cooldown probe succeeds)."""
 
     def __init__(self, reason: str, message: str):
         super().__init__(message)
@@ -81,12 +84,34 @@ class Request:
         return self._outputs
 
     # scheduler side ------------------------------------------------------
+    # Resolution is FIRST-RESULT-WINS: a retried batch replaying its
+    # completion loop (reliability.RetryPolicy around the program call)
+    # or a shutdown racing a drain must never overwrite a result a
+    # client thread may already be reading. A second resolution attempt
+    # is counted (`serving.duplicate_resolution` — the chaos harness
+    # asserts it stays 0) and dropped.
+    def _resolved_already(self) -> bool:
+        if not self._event.is_set():
+            return False
+        from ..observability.metrics import registry
+
+        registry.counter(
+            "serving.duplicate_resolution",
+            "attempts to complete/fail an already-resolved request "
+            "future (must stay 0: nonzero means a retry or shutdown "
+            "path double-delivered)").inc()
+        return True
+
     def _complete(self, outputs) -> None:
+        if self._resolved_already():
+            return
         self.t_complete = time.perf_counter()
         self._outputs = outputs
         self._event.set()
 
     def _fail(self, error: BaseException) -> None:
+        if self._resolved_already():
+            return
         self.t_complete = time.perf_counter()
         self._error = error
         self._event.set()
@@ -143,7 +168,8 @@ class AdmissionController:
 
     def __init__(self, max_queue: Optional[int] = None,
                  tenant_quota: Optional[int] = None,
-                 request_ttl_ms: Optional[float] = None):
+                 request_ttl_ms: Optional[float] = None,
+                 breaker_board=None):
         from ..base.flags import get_flag
 
         self.max_queue = int(get_flag("serving_max_queue")
@@ -152,6 +178,10 @@ class AdmissionController:
                                 if tenant_quota is None else tenant_quota)
         # None defers to the flag at expiry time (live-tunable)
         self._ttl_ms = request_ttl_ms
+        # per-tenant circuit breakers (reliability.BreakerBoard): a
+        # tenant whose batches keep failing is shed HERE, at the door,
+        # instead of queueing work a broken path will fail late
+        self.breaker_board = breaker_board
         self._tiers: Dict[str, int] = {}
         self._queued = 0
         self._inflight: Dict[str, int] = {}
@@ -184,6 +214,10 @@ class AdmissionController:
 
     def try_admit(self, tenant: str, n: int) -> Optional[str]:
         """None = admitted (state charged); else the refusing gate."""
+        # consulted OUTSIDE self._lock: the board has its own lock and an
+        # open breaker's cooldown probe must not serialize admissions
+        if self.breaker_board is not None and self.breaker_board.is_open(tenant):
+            return "circuit"
         with self._lock:
             if self.max_queue > 0 and self._queued + n > self.max_queue:
                 return "queue"

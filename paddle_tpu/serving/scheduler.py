@@ -130,11 +130,18 @@ class Scheduler:
     """The serving tier's one executor thread: continuously drains the
     queue into bucketed batches and hands them to ``execute`` (the
     engine's predictor call). Crashes in ``execute`` fail only the batch
-    that triggered them — the loop survives and keeps serving."""
+    that triggered them — the loop survives and keeps serving.
+
+    ``retry`` (a ``reliability.RetryPolicy``) replays a transiently
+    failed program call before the fault wall gives the batch up;
+    ``breakers`` (a ``reliability.BreakerBoard``) is fed per-tenant
+    success/failure so a tenant whose batches keep dying flips to
+    ``degraded`` and sheds at admission."""
 
     def __init__(self, queue: RequestQueue, execute: Callable,
                  buckets, *, max_batch: Optional[int] = None,
-                 linger_s: float = 0.0, on_batch: Optional[Callable] = None):
+                 linger_s: float = 0.0, on_batch: Optional[Callable] = None,
+                 retry=None, breakers=None):
         self.queue = queue
         self.execute = execute           # (requests, bucket) -> None
         # a list, or a zero-arg callable for a LIVE ladder view (the engine
@@ -144,8 +151,23 @@ class Scheduler:
         self.max_batch = max_batch
         self.linger_s = float(linger_s)
         self.on_batch = on_batch         # (n_samples, bucket, depth) tap
+        self.retry = retry
+        self.breakers = breakers
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
+
+    def _call(self, requests, bucket) -> None:
+        if self.retry is not None:
+            self.retry.run(self.execute, requests, bucket)
+        else:
+            self.execute(requests, bucket)
+
+    def _record(self, requests, ok: bool) -> None:
+        if self.breakers is None:
+            return
+        for tenant in {r.tenant for r in requests}:
+            (self.breakers.record_success if ok
+             else self.breakers.record_failure)(tenant)
 
     def start(self) -> "Scheduler":
         if self._thread is not None:
@@ -181,13 +203,15 @@ class Scheduler:
                 with tracer.span("serving.batch", track="serving.scheduler",
                                  bucket=bucket, n_samples=n_samples,
                                  n_requests=len(requests)):
-                    self.execute(requests, bucket)
+                    self._call(requests, bucket)
+                self._record(requests, ok=True)
             except BaseException as e:  # noqa: BLE001 — batch-scoped fault wall
                 if monitor.enabled:
                     # serving-worker exception hook: capture the forensic
                     # window BEFORE the batch is failed away (the flight
                     # recorder is the only record once result() re-raises)
                     monitor.on_exception("serving.worker", e)
+                self._record(requests, ok=False)
                 for r in requests:
                     self.queue.admission.on_complete(r.tenant, r.n)
                     r._fail(e)
@@ -228,7 +252,8 @@ class DecodeScheduler:
 
     def __init__(self, queue: RequestQueue, programs, pool, *,
                  prefill_max_batch: int, eos_id: Optional[int] = None,
-                 stats=None, on_step: Optional[Callable] = None):
+                 stats=None, on_step: Optional[Callable] = None,
+                 retry=None, breakers=None):
         self.queue = queue
         self.programs = programs
         self.pool = pool
@@ -236,6 +261,8 @@ class DecodeScheduler:
         self.eos_id = eos_id
         self.stats = stats
         self.on_step = on_step           # (kind, lanes, rung, emitted) tap
+        self.retry = retry               # replays a transient program call
+        self.breakers = breakers         # per-tenant degraded accounting
         self._active: Dict[int, object] = {}    # slot -> DecodeRequest
         self._pending: List[object] = []        # slot held, prefill due
         self._step_lanes: List[object] = []     # lanes riding the current call
@@ -317,13 +344,19 @@ class DecodeScheduler:
         """Batch-scoped fault wall: a crashed program call fails exactly
         the lanes it carried (``_step_lanes``, set by the step before its
         program call) and frees their slots; pending prefills and active
-        lanes that did NOT ride the call keep serving."""
+        lanes that did NOT ride the call keep serving. Transient program
+        faults are absorbed by the retry policy INSIDE the step (around
+        the program call only — admission/absorb bookkeeping never
+        replays); only a give-up reaches this wall."""
         try:
             step()
         except BaseException as e:  # noqa: BLE001 — batch-scoped fault wall
             if monitor.enabled:
                 monitor.on_exception("serving.decode_worker", e)
             involved, self._step_lanes = self._step_lanes, []
+            if self.breakers is not None:
+                for tenant in {r.tenant for r in involved}:
+                    self.breakers.record_failure(tenant)
             for r in involved:
                 if r.slot is not None:
                     self._active.pop(r.slot, None)
@@ -331,6 +364,30 @@ class DecodeScheduler:
                     r.slot = None
                 self.queue.admission.on_complete(r.tenant, r.n)
                 r._fail(e)
+
+    def _program_call(self, fn):
+        """One prefill/decode program call through the fault point and
+        (when armed) the retry policy — the only part of a step that is
+        safe to replay: it reads pool/request state and returns fresh
+        buffers, mutating nothing until ``commit``/``_absorb``.
+
+        EXCEPT under buffer donation (accelerators donate the KV pool
+        args so XLA aliases in place): a failed-after-dispatch attempt
+        may already have invalidated ``pool.k``/``pool.v``, and a replay
+        would read deleted arrays — worse, the pool would stay poisoned
+        for every later step. Donating programs therefore skip retry and
+        fail straight to the fault wall (lanes fail, slots release, the
+        pool keeps its last committed buffers)."""
+        from ..reliability.faults import fault_point
+
+        def attempt():
+            fault_point("serving.decode_step")
+            return fn()
+
+        donates = bool(getattr(self.programs, "_donate", ()))
+        if self.retry is not None and not donates:
+            return self.retry.run(attempt)
+        return attempt()
 
     # ------------------------------------------------------------- steps
     def _prefill_step(self) -> None:
@@ -357,8 +414,8 @@ class DecodeScheduler:
         with tracer.span("serving.decode", track="serving.scheduler",
                          kind="prefill", rung=(b_rung, rung),
                          lanes=len(group)):
-            ck, cv, toks = self.programs.prefill(
-                self.pool.k, self.pool.v, tokens, lengths, slots)
+            ck, cv, toks = self._program_call(lambda: self.programs.prefill(
+                self.pool.k, self.pool.v, tokens, lengths, slots))
             self.pool.commit(ck, cv)
             toks = np.asarray(toks)
         self._absorb(group, toks, kind="prefill",
@@ -382,8 +439,8 @@ class DecodeScheduler:
         t0 = time.perf_counter()
         with tracer.span("serving.decode", track="serving.scheduler",
                          kind="decode", rung=b_rung, lanes=len(lanes)):
-            ck, cv, toks = self.programs.decode(
-                self.pool.k, self.pool.v, tokens, slots, positions)
+            ck, cv, toks = self._program_call(lambda: self.programs.decode(
+                self.pool.k, self.pool.v, tokens, slots, positions))
             self.pool.commit(ck, cv)
             toks = np.asarray(toks)
         self._absorb(lanes, toks, kind="decode",
@@ -395,6 +452,9 @@ class DecodeScheduler:
         retire finished sequences (slot released, future resolved), keep
         the rest active for the next step."""
         self._step_lanes = []  # the call succeeded: nothing to fail
+        if self.breakers is not None:
+            for tenant in {r.tenant for r in lanes}:
+                self.breakers.record_success(tenant)
         for i, r in enumerate(lanes):
             tok = int(toks[i])
             r.generated.append(tok)

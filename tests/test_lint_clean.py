@@ -1,12 +1,13 @@
 """CI gate: the repo itself passes its own static analysis.
 
-Runs all ten ``paddle_tpu.analysis`` analyzer families over the live
+Runs all eleven ``paddle_tpu.analysis`` analyzer families over the live
 codebase and asserts ZERO error-severity findings, so a regression (a new
 jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug,
 a host callback in a compiled step, a typo'd mesh axis, a cost-model
 budget blowout, a serving-tier steady-state recompile, a leaked telemetry
-span, a sync inside a memory sampler or a non-hermetic persistent-cache
-entry) fails tier-1 instead of rotting until pod scale. The
+span, a sync inside a memory sampler, a non-hermetic persistent-cache
+entry or an armed fault injector / undeclared fault site) fails tier-1
+instead of rotting until pod scale. The
 ``python -m tools.lint`` CLI contract (exit 0, machine-readable JSON
 with per-family wall-time, ``--include-tests``) is gated here too.
 """
@@ -197,6 +198,18 @@ def test_comm_audit_green_on_demo_session():
     assert [str(f) for f in audit_comm(report)] == []
 
 
+def test_fault_hygiene_clean_over_source_tree():
+    """ISSUE 14: the reliability layer's own hygiene holds — no
+    FaultInjector armed in the CI process (FT900), no RetryPolicy with a
+    dead deadline budget (FT901), and every literal fault site injected
+    anywhere in paddle_tpu/ is declared (with its cleanup path) in
+    reliability.faults.SITES (FT902)."""
+    from paddle_tpu.analysis.fault_check import check_paths
+
+    findings = check_paths([os.path.join(_REPO, "paddle_tpu")])
+    assert _errors(findings) == []
+
+
 def test_cli_exits_zero_with_machine_readable_findings(capsys):
     """`tools.lint --json --include-tests` over the repo: exit 0,
     parseable. Run in-process (the tests above already paid the analyzer
@@ -212,7 +225,8 @@ def test_cli_exits_zero_with_machine_readable_findings(capsys):
     assert payload["crashed"] == []
     assert set(payload["analyzers"]) == {"trace", "registry", "program",
                                          "jaxpr", "spmd", "cost", "serving",
-                                         "telemetry", "cache", "comm"}
+                                         "telemetry", "cache", "comm",
+                                         "fault"}
     assert isinstance(payload["findings"], list)
     # per-family wall-time (CI satellite): one entry per analyzer run
     assert set(payload["timings_s"]) == set(payload["analyzers"])

@@ -1,0 +1,760 @@
+"""ISSUE 14: fault-injection harness + preemption-safe training +
+self-healing serving.
+
+Covers the reliability tentpole end to end: the deterministic seeded
+FaultInjector and its flag grammar, the RetryPolicy budget discipline,
+circuit breakers shedding open-circuit tenants at admission, atomic
+rolling train snapshots with bit-identical mid-epoch resume, the
+chaos regression scenarios the ISSUE names (decode crash → zero leaked
+KV slots; prefetch-thread kill → error propagates to fit, never a
+deadlock), the elastic join-timeout roster, the loud partial-checkpoint
+error, the FT9xx lint family's seeded negatives, and the
+``python -m tools.chaos`` CLI contract.
+
+Every test that arms the process injector disarms it in ``finally`` —
+FT900 (checked by test_lint_clean) would flag a leak.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import reliability as rel
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.hapi.model import Model
+
+
+class LossRec(Callback):
+    def __init__(self):
+        super().__init__()
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        self.losses.append(float(logs["loss"]))
+
+
+def _tiny_model(seed=7):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    m = Model(net)
+    m.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    return m
+
+
+def _tiny_data(n=10, seed=0):
+    rs = np.random.RandomState(seed)
+    return [(rs.randn(4, 4).astype(np.float32),
+             rs.randn(4, 1).astype(np.float32)) for _ in range(n)]
+
+
+# ---------------------------------------------------------------- injector
+class TestFaultInjector:
+    def test_deterministic_schedule_per_seed(self):
+        def run(seed):
+            inj = rel.FaultInjector(seed=seed).plan("io.h2d", rate=0.5)
+            fired = []
+            for i in range(32):
+                try:
+                    inj.fire("io.h2d")
+                    fired.append(0)
+                except rel.FaultInjection:
+                    fired.append(1)
+            return fired
+
+        assert run(0) == run(0)          # same seed → same schedule
+        assert run(0) != run(1)          # different seed → different one
+
+    def test_sites_roll_independent_streams(self):
+        """Arming site B must not shift site A's firing pattern."""
+        def pattern(extra_site):
+            inj = rel.FaultInjector(seed=3).plan("io.h2d", rate=0.5)
+            if extra_site:
+                inj.plan("kv.commit", rate=0.5)
+            out = []
+            for i in range(16):
+                if extra_site:
+                    try:
+                        inj.fire("kv.commit")
+                    except rel.FaultInjection:
+                        pass
+                try:
+                    inj.fire("io.h2d")
+                    out.append(0)
+                except rel.FaultInjection:
+                    out.append(1)
+            return out
+
+        assert pattern(False) == pattern(True)
+
+    def test_kinds_latency_and_corrupt_and_max_fires(self):
+        inj = rel.FaultInjector(seed=0)
+        inj.plan("io.h2d", rate=1.0, kind="latency", delay_s=0.01)
+        t0 = time.perf_counter()
+        assert inj.fire("io.h2d") == "latency"
+        assert time.perf_counter() - t0 >= 0.01
+        inj.plan("kv.commit", rate=1.0, kind="corrupt")
+        assert inj.fire("kv.commit") == "corrupt"
+        bounded = rel.FaultInjector(seed=0).plan("ckpt.write", rate=1.0,
+                                                 max_fires=1)
+        with pytest.raises(rel.FaultInjection):
+            bounded.fire("ckpt.write")
+        assert bounded.fire("ckpt.write") is None  # budget exhausted
+
+    def test_flag_spec_arms_and_disarms(self):
+        from paddle_tpu.base.flags import set_flags
+
+        set_flags({"fault_inject": "io.h2d:1:raise,kv.commit:0.5:latency:20"})
+        try:
+            inj = rel.active()
+            assert inj is not None
+            assert set(inj.plans) == {"io.h2d", "kv.commit"}
+            assert inj.plans["kv.commit"][0].kind == "latency"
+            assert inj.plans["kv.commit"][0].delay_s == pytest.approx(0.02)
+        finally:
+            set_flags({"fault_inject": ""})
+        assert rel.active() is None
+        assert rel.fault_point("io.h2d") is None  # dark = no-op
+
+    def test_corrupt_bytes_is_deterministic_and_changes_payload(self):
+        data = bytes(range(256)) * 8
+        a = rel.corrupt_bytes(data, "s", seed=1)
+        assert a == rel.corrupt_bytes(data, "s", seed=1)
+        assert a != data and len(a) == len(data)
+
+
+# ------------------------------------------------------------ retry policy
+class TestRetryPolicy:
+    def test_transient_retries_then_succeeds(self):
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        policy = rel.RetryPolicy("t", max_attempts=4, base_delay_s=0.001,
+                                 deadline_s=5.0)
+        assert policy.run(flaky) == "ok"
+        assert calls[0] == 3
+
+    def test_fatal_propagates_on_first_attempt(self):
+        calls = [0]
+
+        def buggy():
+            calls[0] += 1
+            raise ValueError("logic bug")
+
+        policy = rel.RetryPolicy("t", max_attempts=5, base_delay_s=0.001,
+                                 deadline_s=5.0)
+        with pytest.raises(ValueError):
+            policy.run(buggy)
+        assert calls[0] == 1  # a deterministic bug is never replayed
+
+    def test_attempts_exhausted_reraises(self):
+        policy = rel.RetryPolicy("t", max_attempts=2, base_delay_s=0.001,
+                                 deadline_s=5.0)
+        calls = [0]
+
+        def always():
+            calls[0] += 1
+            raise TimeoutError("down")
+
+        with pytest.raises(TimeoutError):
+            policy.run(always)
+        assert calls[0] == 2
+
+    def test_deadline_budget_bounds_the_loop(self):
+        policy = rel.RetryPolicy("t", max_attempts=1000, base_delay_s=0.05,
+                                 max_delay_s=0.05, deadline_s=0.12)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            policy.run(lambda: (_ for _ in ()).throw(OSError("x")))
+        assert time.monotonic() - t0 < 2.0  # budget, not 1000 attempts
+
+    def test_positive_deadline_required(self):
+        with pytest.raises(ValueError):
+            rel.RetryPolicy("t", deadline_s=0)  # noqa: FT901 — the seeded negative
+
+    def test_injected_fault_transient_flag_controls_classification(self):
+        assert rel.default_classify(rel.FaultInjection("s")) is True
+        assert rel.default_classify(
+            rel.FaultInjection("s", transient=False)) is False
+        assert rel.default_classify(KeyboardInterrupt()) is False
+
+
+# --------------------------------------------------------- circuit breaker
+class TestCircuitBreaker:
+    def test_consecutive_failures_open_then_cooldown_probe_closes(self):
+        b = rel.CircuitBreaker("k", failure_threshold=2, cooldown_s=0.05)
+        b.on_failure()
+        assert b.state == "closed" and b.allow()
+        b.on_failure()
+        assert b.state == "open" and not b.allow()
+        time.sleep(0.06)
+        assert b.allow()                  # half-open probe
+        assert b.state == "half_open"
+        b.on_success()
+        assert b.state == "closed" and b.health == "ok"
+
+    def test_success_resets_the_streak(self):
+        b = rel.CircuitBreaker("k", failure_threshold=3, cooldown_s=60)
+        for _ in range(2):
+            b.on_failure()
+        b.on_success()
+        for _ in range(2):
+            b.on_failure()
+        assert b.state == "closed"  # never 3 consecutive
+
+    def test_board_open_keys_and_health(self):
+        board = rel.BreakerBoard(failure_threshold=1, cooldown_s=60)
+        assert board.health() == "ok" and not board.is_open("t")
+        board.record_failure("t")
+        assert board.is_open("t")
+        assert board.open_keys() == ["t"] and board.health() == "degraded"
+
+    def test_admission_sheds_open_circuit_tenant(self):
+        from paddle_tpu.serving.request_queue import AdmissionController
+
+        board = rel.BreakerBoard(failure_threshold=1, cooldown_s=60)
+        adm = AdmissionController(max_queue=100, tenant_quota=100,
+                                  breaker_board=board)
+        assert adm.try_admit("good", 1) is None
+        board.record_failure("bad")
+        assert adm.try_admit("bad", 1) == "circuit"
+        assert adm.try_admit("good", 1) is None  # others unaffected
+
+
+# ------------------------------------------------------------ request dedup
+def test_request_resolution_is_first_result_wins():
+    from paddle_tpu.serving.request_queue import Request
+
+    r = Request("t", [np.zeros((1, 4), np.float32)], 1)
+    r._complete(["first"])
+    r._fail(RuntimeError("late failure must not clobber the result"))
+    r._complete(["second"])
+    assert r.result(1) == ["first"]
+
+
+# ---------------------------------------------------------------- snapshots
+class TestTrainSnapshotter:
+    def test_roundtrip_restores_cursor_params_and_rng(self, tmp_path):
+        from paddle_tpu.base import global_state
+        from paddle_tpu.reliability.snapshot import TrainSnapshotter
+
+        m = _tiny_model(seed=5)
+        _ = global_state.default_generator.split()  # advance the stream
+        key_before = np.asarray(global_state.default_generator._key)
+        snap = TrainSnapshotter(str(tmp_path), keep=2)
+        snap.save(m.network, m._optimizer, step=3, epoch=1, next_batch=2)
+
+        twin = _tiny_model(seed=6)  # different init on purpose
+        paddle.seed(9)              # and a different RNG stream
+        state = snap.restore(twin.network, twin._optimizer)
+        assert (state["step"], state["epoch"], state["next_batch"]) == (3, 1, 2)
+        for (ka, va), (kb, vb) in zip(
+                sorted(m.network.state_dict().items()),
+                sorted(twin.network.state_dict().items())):
+            assert ka == kb
+            assert np.array_equal(np.asarray(va._value),
+                                  np.asarray(vb._value))
+        assert np.array_equal(
+            np.asarray(global_state.default_generator._key), key_before)
+
+    def test_rolling_prune_keeps_newest(self, tmp_path):
+        from paddle_tpu.reliability.snapshot import TrainSnapshotter
+
+        snap = TrainSnapshotter(str(tmp_path), keep=2)
+        for step in (1, 2, 3, 4):
+            snap.save(step=step, epoch=0, next_batch=step)
+        steps = [s for s, _ in snap.snapshots()]
+        assert steps == [3, 4]
+
+    def test_torn_write_leaves_previous_snapshot_intact(self, tmp_path):
+        """The injected crash lands between tmp-write and rename; the
+        retry commits. With retries exhausted the previous snapshot
+        stays the committed latest and only tmp droppings remain."""
+        from paddle_tpu.reliability.snapshot import TrainSnapshotter
+
+        snap = TrainSnapshotter(str(tmp_path), keep=3)
+        first = snap.save(step=1, epoch=0, next_batch=1)
+        rel.arm(rel.FaultInjector(seed=0).plan("ckpt.write", rate=1.0,
+                                               max_fires=1))
+        try:
+            second = snap.save(step=2, epoch=0, next_batch=2)
+        finally:
+            rel.disarm()
+        assert snap.latest() == second  # retry landed it
+        rel.arm(rel.FaultInjector(seed=0).plan("ckpt.write", rate=1.0))
+        try:
+            with pytest.raises(rel.FaultInjection):
+                snap.save(step=3, epoch=0, next_batch=3)
+        finally:
+            rel.disarm()
+        assert snap.latest() == second  # previous stays committed
+        assert first != second
+
+    def test_restore_without_snapshot_raises(self, tmp_path):
+        from paddle_tpu.reliability.snapshot import TrainSnapshotter
+
+        with pytest.raises(FileNotFoundError):
+            TrainSnapshotter(str(tmp_path)).restore()
+
+
+# ------------------------------------------------------------ loader cursor
+class TestLoaderCursor:
+    def test_iter_from_skips_at_index_level(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        fetched = []
+
+        class Spy(Dataset):
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                fetched.append(i)
+                return np.float32(i)
+
+        loader = DataLoader(Spy(), batch_size=2, shuffle=False)
+        got = list(loader.iter_from(4))
+        assert len(got) == 2  # batches 4 and 5 of 6
+        assert fetched and not any(i < 8 for i in fetched)  # prefix skipped
+
+    def test_set_epoch_makes_shuffle_reproducible(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Ds(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        def order(epoch):
+            loader = DataLoader(Ds(), batch_size=4, shuffle=True)
+            loader.set_epoch(epoch)
+            return [tuple(np.asarray(b[0]._value).ravel().tolist())
+                    for b in loader]
+
+        assert order(1) == order(1)
+        assert order(1) != order(2)
+
+    def test_device_loader_delegates_cursor_and_epoch(self):
+        from paddle_tpu.io import DeviceLoader
+
+        data = [(np.full((2, 2), i, np.float32),) for i in range(6)]
+        dl = DeviceLoader(data, depth=2)
+        got = [float(np.asarray(b[0]._value)[0, 0]) for b in dl.iter_from(4)]
+        assert got == [4.0, 5.0]
+        dl.set_epoch(3)  # no-op on a list, must not raise
+
+
+# --------------------------------------------------- preemption-safe fit
+class TestFitResume:
+    def test_mid_epoch_crash_resume_bit_identical(self, tmp_path):
+        data = _tiny_data(10)
+        ref = LossRec()
+        _tiny_model().fit(data, epochs=2, sync_every=1, verbose=0,
+                          shuffle=False, callbacks=[ref])
+
+        first = LossRec()
+
+        class Crash(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if len(first.losses) == 7:
+                    raise RuntimeError("simulated crash")
+
+        with pytest.raises(RuntimeError):
+            _tiny_model().fit(data, epochs=2, sync_every=1, verbose=0,
+                              shuffle=False, callbacks=[first, Crash()],
+                              snapshot_dir=str(tmp_path), snapshot_every=3)
+        resumed = LossRec()
+        _tiny_model().fit(data, epochs=2, sync_every=1, verbose=0,
+                          shuffle=False, callbacks=[resumed],
+                          snapshot_dir=str(tmp_path), resume=True)
+        cut = len(ref.losses) - len(resumed.losses)
+        assert 0 < cut <= len(first.losses)
+        assert first.losses[:cut] + resumed.losses == ref.losses
+        # the replay distance is bounded by the snapshot cadence
+        assert len(first.losses) - cut <= 3
+
+    def test_sigterm_snapshots_at_boundary_and_stops_cleanly(self, tmp_path):
+        import signal
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            pytest.skip("signal delivery needs the main thread")
+        data = _tiny_data(10)
+        seen = LossRec()
+
+        class Preempt(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if len(seen.losses) == 4:
+                    signal.raise_signal(signal.SIGTERM)
+
+        prev_handler = signal.getsignal(signal.SIGTERM)
+        m = _tiny_model()
+        m.fit(data, epochs=2, sync_every=1, verbose=0, shuffle=False,
+              callbacks=[seen, Preempt()], snapshot_dir=str(tmp_path))
+        assert len(seen.losses) == 4  # stopped at the preempted boundary
+        from paddle_tpu.reliability.snapshot import TrainSnapshotter
+
+        snap = TrainSnapshotter(str(tmp_path))
+        state = json.load(open(os.path.join(snap.latest(), "state.json")))
+        assert state["step"] == 4 and state["next_batch"] == 4
+        # the handler was restored at fit exit
+        assert signal.getsignal(signal.SIGTERM) == prev_handler
+
+    def test_resume_into_empty_dir_starts_fresh(self, tmp_path):
+        rec = LossRec()
+        _tiny_model().fit(_tiny_data(4), epochs=1, sync_every=1, verbose=0,
+                          shuffle=False, callbacks=[rec],
+                          snapshot_dir=str(tmp_path), resume=True)
+        assert len(rec.losses) == 4
+
+    def test_resume_true_without_dir_raises(self):
+        with pytest.raises(ValueError):
+            _tiny_model().fit(_tiny_data(2), epochs=1, verbose=0,
+                              resume=True)
+
+
+# ------------------------------------------------- chaos regression (ISSUE)
+class TestChaosRegression:
+    def test_decode_step_crash_releases_every_kv_slot(self):
+        """ISSUE satellite: injected crash in a decode step → JX333 stays
+        clean (zero leaked slots), every future resolves, footprint
+        constant."""
+        from paddle_tpu.analysis.jaxpr_audit import audit_serving
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+        from paddle_tpu.profiler.pipeline import ServingStats
+        from paddle_tpu.serving import DecodeEngine
+
+        paddle.seed(0)
+        model = GPTForCausalLM(gpt_tiny(
+            num_hidden_layers=1, hidden_size=32, num_attention_heads=2,
+            max_position_embeddings=32))
+        model.eval()
+        engine = DecodeEngine(model, max_slots=2, max_seq=16,
+                              seq_buckets=[8], prefill_max_batch=2,
+                              stats=ServingStats())
+        engine.warmup()
+        rs = np.random.RandomState(0)
+        # transient=False → the retry policy does NOT absorb these: they
+        # hit the fault wall, which must release the slots
+        inj = rel.FaultInjector(seed=0)
+        inj.plan("serving.decode_step", rate=0.3, transient=False)
+        rel.arm(inj)
+        resolved = failed = 0
+        try:
+            reqs = [engine.submit(t, rs.randint(0, 512, size=n), 3)
+                    for t, n in (("a", 4), ("b", 6), ("a", 3), ("b", 5))]
+            for r in reqs:
+                try:
+                    r.result(60)
+                    resolved += 1
+                except rel.FaultInjection:
+                    failed += 1
+        finally:
+            rel.disarm()
+        engine.shutdown(drain=True)
+        assert inj.summary()["total_injected"] > 0
+        assert failed > 0  # the wall actually exercised
+        assert resolved + failed == 4  # nothing lost
+        assert engine.kv_pool.in_use() == 0  # ZERO leaked slots
+        assert [str(f) for f in audit_serving(engine)] == []  # JX333 clean
+        assert engine.compiles_after_warmup == 0
+
+    def test_prefetch_thread_kill_propagates_to_fit(self):
+        """ISSUE satellite: killing the DeviceLoader staging thread must
+        fail fit promptly — never deadlock the bounded queue."""
+        from paddle_tpu.io import DeviceLoader
+
+        m = _tiny_model()
+        rel.arm(rel.FaultInjector(seed=0).plan("io.h2d", rate=1.0))
+        t0 = time.perf_counter()
+        try:
+            with pytest.raises(rel.FaultInjection):
+                m.fit(DeviceLoader(_tiny_data(6), depth=2), epochs=1,
+                      verbose=0, sync_every=1)
+        finally:
+            rel.disarm()
+        assert time.perf_counter() - t0 < 30.0
+
+    def test_serving_retry_absorbs_transient_program_faults(self):
+        """Transient faults on the batch program call recover invisibly:
+        all requests served, bit-exact, nothing duplicated, nothing
+        recompiled."""
+        from paddle_tpu.profiler.pipeline import ServingStats
+        from paddle_tpu.serving import ServingEngine
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                nn.Linear(16, 4))
+            net.eval()
+            prefix = os.path.join(tmp, "m")
+            paddle.jit.save(net, prefix, input_spec=[
+                paddle.static.InputSpec([None, 8], "float32")])
+            from paddle_tpu.base.flags import set_flags
+
+            # a deeper attempt budget than the 40% injection rate can
+            # realistically exhaust (the schedule is seeded, so this is
+            # deterministic either way)
+            set_flags({"retry_max_attempts": 6})
+            try:
+                engine = ServingEngine(prefix, buckets=[1, 2, 4],
+                                       stats=ServingStats())
+                engine.warmup()
+            finally:
+                set_flags({"retry_max_attempts": 3})
+            rs = np.random.RandomState(0)
+            xs = [rs.randn(n, 8).astype(np.float32)
+                  for n in (1, 3, 2, 4, 2, 1)]
+            expect = [np.asarray(engine.predictor.run([x])[0]) for x in xs]
+            inj = rel.arm(rel.FaultInjector(seed=1).plan(
+                "serving.execute", rate=0.4))
+            try:
+                outs = [engine.run("t", x) for x in xs]
+            finally:
+                rel.disarm()
+            engine.shutdown(drain=True)
+            assert inj.summary()["total_injected"] > 0
+            for out, want in zip(outs, expect):
+                assert np.array_equal(np.asarray(out[0]), want)
+            assert engine.compiles_after_warmup == 0
+
+    def test_breaker_degrades_healthz_and_sheds_admission(self):
+        """A tenant whose batches keep dying (fatal faults, retries
+        exhausted) flips its breaker: /healthz reads degraded and the
+        door refuses with reason='circuit'."""
+        from paddle_tpu.profiler.pipeline import ServingStats
+        from paddle_tpu.serving import ServingEngine
+        from paddle_tpu.serving.request_queue import AdmissionError
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(),
+                                nn.Linear(8, 2))
+            net.eval()
+            prefix = os.path.join(tmp, "m")
+            paddle.jit.save(net, prefix, input_spec=[
+                paddle.static.InputSpec([None, 8], "float32")])
+            engine = ServingEngine(prefix, buckets=[1, 2],
+                                   stats=ServingStats())
+            # small breaker so the test trips it fast
+            engine.breakers._failure_threshold = 2
+            engine.breakers._cooldown_s = 60.0
+            engine.warmup()
+            rs = np.random.RandomState(0)
+            inj = rel.FaultInjector(seed=0)
+            inj.plan("serving.execute", rate=1.0, transient=False)
+            rel.arm(inj)
+            try:
+                for _ in range(2):
+                    with pytest.raises(rel.FaultInjection):
+                        engine.run("victim", rs.randn(1, 8).astype(np.float32))
+            finally:
+                rel.disarm()
+            health = engine.telemetry_health()
+            assert health["health"] == "degraded"
+            assert health["open_circuits"] == ["victim"]
+            with pytest.raises(AdmissionError) as exc:
+                engine.submit("victim", rs.randn(1, 8).astype(np.float32))
+            assert exc.value.reason == "circuit"
+            # a healthy tenant still serves while the victim sheds
+            out = engine.run("healthy", rs.randn(1, 8).astype(np.float32))
+            assert np.asarray(out[0]).shape == (1, 2)
+            engine.shutdown(drain=True)
+
+
+# -------------------------------------------------------- elastic + ckpt IO
+def test_elastic_join_timeout_names_missing_ranks():
+    """ISSUE satellite: wait_all_joined surfaces the partial roster —
+    the exception names the never-joined ranks and the counter ticks."""
+    from paddle_tpu.distributed.fleet.elastic import (ElasticJoinTimeout,
+                                                      ElasticManager)
+
+    class FakeStore:
+        def __init__(self):
+            self.values = {}
+
+        def set(self, k, v):
+            self.values[k] = str(v).encode()
+
+        def add(self, k, n):
+            cur = int(self.values.get(k, b"0"))
+            cur += int(n)
+            self.values[k] = cur.to_bytes(8, "little")
+            return cur
+
+        def get(self, k, timeout=None):
+            if k not in self.values:
+                raise KeyError(k)
+            v = self.values[k]
+            return v if isinstance(v, bytes) else str(v).encode()
+
+    mgr = ElasticManager(rank=0, world_size=3, store=FakeStore(),
+                         node_timeout=1.0)
+    mgr._beat()
+    mgr.store.add("elastic/default/joined", 1)  # only rank 0 joined
+    with pytest.raises(ElasticJoinTimeout) as exc:
+        mgr.wait_all_joined(timeout=0.5)
+    assert exc.value.missing == [1, 2]
+    assert exc.value.joined == 1 and exc.value.world_size == 3
+    assert mgr.wait_all_joined(timeout=0.3, raise_on_timeout=False) is False
+
+
+def test_partial_chunked_checkpoint_fails_loudly(tmp_path):
+    """ISSUE satellite: committed metadata referencing chunks no shard
+    file can serve must name the gap, never KeyError on one chunk."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.checkpoint.load_state_dict import (
+        load_state_dict)
+
+    meta = {"format": "paddle_tpu_dist_ckpt_v1", "world_size": 2,
+            "entries": {"w": {"shape": [4], "dtype": "float32",
+                              "chunks": [{"key": "w__r0c0_x",
+                                          "index": [[0, 2]]},
+                                         {"key": "w__r1c0_x",
+                                          "index": [[2, 4]]}]}}}
+    with open(os.path.join(str(tmp_path), "metadata.json"), "w") as f:
+        json.dump(meta, f)
+    np.savez(os.path.join(str(tmp_path), "shard_0_x.npz"),
+             **{"w__r0c0_x": np.zeros(2, np.float32)})  # rank 1's is MISSING
+    state = {"w": Tensor(np.zeros(4, np.float32))}
+    with pytest.raises(RuntimeError, match="INCOMPLETE.*w__r1c0_x"):
+        load_state_dict(state, str(tmp_path))
+
+
+def test_watchdog_timeout_ticks_counter_and_fires_handler():
+    """ISSUE satellite: a hung collective (simulated via the
+    comm.watchdog fault site) produces the timeout handler call + the
+    scrape-visible counter, not just a log line."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.utils.watchdog import (
+        disable_comm_watchdog, enable_comm_watchdog)
+    from paddle_tpu.observability.metrics import registry
+
+    def total():
+        inst = registry.snapshot()["metrics"].get("comm.watchdog_timeout")
+        if not inst:
+            return 0.0
+        return float(sum(cell.get("value", 0)
+                         for cell in inst.get("values", [])))
+
+    before = total()
+    fired = []
+    manager = enable_comm_watchdog(timeout=30.0,
+                                   on_timeout=lambda t, a: fired.append(t))
+    rel.arm(rel.FaultInjector(seed=0).plan("comm.watchdog", rate=1.0))
+    try:
+        manager.watch("test.collective", jnp.ones(3))
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        rel.disarm()
+        disable_comm_watchdog()
+    assert fired == ["test.collective"]
+    assert total() == before + 1
+
+
+# -------------------------------------------------------------- FT9xx lint
+class TestFaultLint:
+    def test_ft900_flags_armed_injector(self):
+        from paddle_tpu.analysis.fault_check import audit_injector
+
+        rel.arm(rel.FaultInjector(seed=0).plan("io.h2d", rate=1.0))
+        try:
+            findings = audit_injector()
+        finally:
+            rel.disarm()
+        assert [f.code for f in findings] == ["FT900"]
+        assert "io.h2d" in findings[0].message
+        assert audit_injector() == []  # disarmed process audits clean
+
+    def test_ft901_flags_dead_deadline_literals(self):
+        from paddle_tpu.analysis.fault_check import check_source
+
+        src = ("from paddle_tpu.reliability import RetryPolicy\n"
+               "p = RetryPolicy('s', deadline_s=0)\n"
+               "q = RetryPolicy('s', deadline_s=None)\n"
+               "ok = RetryPolicy('s', deadline_s=5.0)\n")
+        codes = [f.code for f in check_source(src)]
+        assert codes == ["FT901", "FT901"]
+
+    def test_ft901_respects_noqa(self):
+        from paddle_tpu.analysis.fault_check import check_source
+
+        src = ("from paddle_tpu.reliability import RetryPolicy\n"
+               "p = RetryPolicy('s', deadline_s=0)  # noqa: FT901\n")
+        assert check_source(src) == []
+
+    def test_ft902_flags_undeclared_fault_site(self):
+        from paddle_tpu.analysis.fault_check import check_source
+
+        src = ("from paddle_tpu.reliability.faults import fault_point\n"
+               "fault_point('totally.made.up.site')\n"
+               "fault_point('io.h2d')\n")
+        findings = check_source(src)
+        assert [f.code for f in findings] == ["FT902"]
+        assert "totally.made.up.site" in findings[0].message
+
+    def test_every_declared_site_documents_cleanup(self):
+        for site, cleanup in rel.SITES.items():
+            assert isinstance(cleanup, str) and len(cleanup) > 20, site
+
+
+# ------------------------------------------------------------- chaos CLI
+class TestChaosCLI:
+    def test_cheap_scenarios_pass_and_exit_zero(self, capsys):
+        import tools.chaos as chaos_cli
+
+        rc = chaos_cli.main(["--seed", "0", "--json", "--only",
+                             "ckpt_torn_write", "--only", "watchdog_hang"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0, payload
+        assert payload["ok"] is True
+        assert payload["scenarios"]["ckpt_torn_write"]["ok"] is True
+        assert payload["scenarios"]["watchdog_hang"]["ok"] is True
+
+    def test_schedule_reports_breach_with_exit_one(self, capsys,
+                                                   monkeypatch):
+        import tools.chaos as chaos_cli
+
+        def broken(seed):
+            return {"ok": False, "error": "synthetic breach"}
+
+        monkeypatch.setattr(
+            chaos_cli, "_SCENARIOS",
+            (("synthetic", broken),) + tuple(
+                s for s in chaos_cli._SCENARIOS if s[0] == "ckpt_torn_write"))
+        rc = chaos_cli.main(["--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert payload["ok"] is False
+
+    @pytest.mark.slow
+    def test_full_schedule_holds_every_invariant(self, capsys):
+        """The acceptance run: the whole seeded schedule, ≥5 distinct
+        injected sites, every invariant green."""
+        import tools.chaos as chaos_cli
+
+        rc = chaos_cli.main(["--seed", "0", "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0, payload
+        assert len(payload["distinct_sites_injected"]) >= 5
+        train = payload["scenarios"]["train_resume"]
+        assert train["bit_identical"] and train["recovery_steps"] <= 4
+        assert payload["scenarios"]["decode_faults"]["kv_slots_leaked"] == 0
+        assert payload["scenarios"]["serving_retry"]["requests_lost"] == 0

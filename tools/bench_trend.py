@@ -40,13 +40,16 @@ DEFAULT_METRIC = "gpt_tiny_train_tokens_per_sec_cpu"
 # (bench extras.coldstart, ISSUE 9), the quantized dp-sync payload
 # saving over the fp32 ring (bench extras.comm, ISSUE 10), the zero1
 # sharded-vs-replicated optimizer-state residency ratio (bench
-# extras.zero1, ISSUE 12) and the continuous-batched GPT decode
-# throughput (bench extras.serving, ISSUE 13); each gates only once two
-# rounds carry it
+# extras.zero1, ISSUE 12), the continuous-batched GPT decode
+# throughput (bench extras.serving, ISSUE 13) and the crash-resume
+# replay distance (bench extras.resilience, ISSUE 14 — deterministic:
+# crash step and snapshot cadence are seeded, so any move means the
+# snapshot path changed); each gates only once two rounds carry it
 DEFAULT_EXTRAS = ("coldstart.train_warm_speedup_x",
                   "comm.allreduce_bytes_saved_ratio",
                   "zero1.opt_state_bytes_ratio",
-                  "serving.decode_tokens_per_sec")
+                  "serving.decode_tokens_per_sec",
+                  "resilience.recovery_steps")
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
 

@@ -1,6 +1,6 @@
 """``python -m tools.lint`` — the repo's static-analysis driver.
 
-Runs the ten ``paddle_tpu.analysis`` analyzers and reports findings:
+Runs the eleven ``paddle_tpu.analysis`` analyzers and reports findings:
 
 - **trace**:    the trace-safety AST linter over ``paddle_tpu/`` (or the
                 paths given on the command line),
@@ -42,6 +42,11 @@ Runs the ten ``paddle_tpu.analysis`` analyzers and reports findings:
                 replica identity of the wire path, the portable reshard
                 route engaging for s_to_s, and no mesh axis mixing
                 gradient-sync wire dtypes.
+- **fault**:    the reliability layer's hygiene (FT9xx) over the same
+                paths as the trace linter plus the live process: no
+                FaultInjector left armed outside a chaos run, no
+                RetryPolicy with a dead deadline budget, no injection
+                into an undeclared fault site.
 
 Exit-code contract (stable, CI-gateable):
   0 = no error-severity findings (warnings never gate)
@@ -64,7 +69,7 @@ import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd", "cost",
-              "serving", "telemetry", "cache", "comm")
+              "serving", "telemetry", "cache", "comm", "fault")
 
 
 def _source_paths(paths, include_tests=False):
@@ -251,18 +256,28 @@ def _run_comm(_paths, include_tests=False):
     return audit_comm()
 
 
+def _run_fault(paths, include_tests=False):
+    """FT9xx over the same source paths as the trace linter (reliability
+    hygiene: armed injectors, dead retry deadlines, undeclared fault
+    sites). Never scans tests/ — chaos tests arm injectors on purpose
+    and carry their own disarm discipline."""
+    from paddle_tpu.analysis.fault_check import check_paths
+
+    return check_paths(_source_paths(paths, include_tests=False))
+
+
 _RUNNERS = {"trace": _run_trace, "registry": _run_registry,
             "program": _run_program, "jaxpr": _run_jaxpr,
             "spmd": _run_spmd, "cost": _run_cost,
             "serving": _run_serving, "telemetry": _run_telemetry,
-            "cache": _run_cache, "comm": _run_comm}
+            "cache": _run_cache, "comm": _run_comm, "fault": _run_fault}
 
 # analyzer -> its finding-code family prefix, so a crash finding
 # (<PREFIX>999) stays visible under --select filters for that family
 _FAMILY_PREFIX = {"trace": "TS", "registry": "RC", "program": "PV",
                   "jaxpr": "JX", "spmd": "SP", "cost": "CM",
                   "serving": "JX", "telemetry": "OB", "cache": "CC",
-                  "comm": "QZ"}
+                  "comm": "QZ", "fault": "FT"}
 
 
 def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
