@@ -1,2 +1,5 @@
 from .save_state_dict import save_state_dict, wait_async_save  # noqa: F401
 from .load_state_dict import load_state_dict  # noqa: F401
+from .sharded import (convert_sharded, is_sharded_checkpoint,  # noqa: F401
+                      load_sharded, load_sharded_into, load_sharded_like,
+                      save_sharded)

@@ -22,7 +22,18 @@ from .save_state_dict import _flatten_state
 
 def load_state_dict(state_dict: Dict, path: str, process_group=None, coordinator_rank: int = 0) -> None:
     """In-place: fills `state_dict`'s tensors with values from `path`,
-    resharding to each tensor's current placement."""
+    resharding to each tensor's current placement.
+
+    Format auto-detection (ISSUE 15): a directory carrying a sharded
+    manifest (``distributed.checkpoint.sharded`` — one piece file per
+    (tensor, shard), sha256 per piece, O(shard) load) restores through
+    the sharded engine; the legacy metadata.json + npz layout keeps its
+    chunk-reassembly path below."""
+    from .sharded import is_sharded_checkpoint, load_sharded_into
+
+    if is_sharded_checkpoint(path):
+        load_sharded_into(state_dict, path)
+        return
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
     # Resolution is metadata-driven: chunk keys are save-nonce-qualified

@@ -1,0 +1,15 @@
+"""Sharded-native checkpoint format + engine (ISSUE 15 tentpole).
+
+``save_sharded(state, dir)`` writes one piece file per (tensor, shard)
+straight from each device's shard — no host-side full-tensor gather —
+under an atomic tmp+rename+fsync publish; ``load_sharded(dir, ...)``
+restores via per-shard ``device_put`` + ``make_array_from_single_device_
+arrays`` with cross-topology re-slice and optional dtype-converting
+load. ``manifest.verify_dir`` / ``tools.ckpt`` / the ``ckpt`` lint
+family audit the same on-disk index.
+"""
+from .engine import (convert_sharded, is_sharded_checkpoint,  # noqa: F401
+                     load_sharded, load_sharded_into, load_sharded_like,
+                     save_sharded)
+from .manifest import (FORMAT, MANIFEST_NAME, read_manifest,  # noqa: F401
+                       verify_dir)

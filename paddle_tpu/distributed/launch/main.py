@@ -10,6 +10,12 @@ PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM) that
 `paddle_tpu.distributed.init_parallel_env` feeds to
 `jax.distributed.initialize`. `--max_restarts` gives launch-level fault
 recovery (the reference's elastic relaunch loop, minus etcd).
+
+Every relaunch (worker restart or elastic re-form) exports
+`PADDLE_RESTART_GEN` with the bumped generation; `Model.fit` reads it
+(ISSUE 15) so a restarted worker with `snapshot_dir=` armed resumes
+from its snapshot cursor automatically — the relaunch path passes
+`resume=` through without the training script changing.
 """
 from __future__ import annotations
 

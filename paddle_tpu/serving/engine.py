@@ -374,6 +374,27 @@ class ServingEngine(EngineBase):
         self.stats.record_batch(n_samples, bucket)
         self.stats.record_queue_depth(depth)
 
+    # ------------------------------------------------------------ hot swap
+    def swap_weights(self, source) -> dict:
+        """Roll a new checkpoint into this live engine under traffic —
+        zero dropped requests, zero retraces (ISSUE 15). ``source`` is a
+        sharded checkpoint directory or a ``{name: array}`` dict; the
+        new weights load device-side next to the old ones (dtype- and
+        placement-converting per tensor), then the shared batch
+        program's parameter reference flips between two program calls.
+        In-flight batches finish on the weights they started with; the
+        next assembled batch serves the new ones. Every tenant clone
+        shares the flip (one weight set process-wide by construction).
+
+        Returns the :meth:`inference.Predictor.swap_weights` report plus
+        ``compiles_after_warmup`` — which a swap can never move (same
+        shapes + dtypes ⇒ same ladder executables)."""
+        with tracer.span("serving.swap_weights", track="serving.scheduler",
+                         source=str(source)[:120]):
+            report = self.predictor.swap_weights(source)
+        report["compiles_after_warmup"] = self.compiles_after_warmup
+        return report
+
     # ------------------------------------------------------------ accounting
     @property
     def compile_count(self) -> int:

@@ -1,12 +1,13 @@
 """CI gate: the repo itself passes its own static analysis.
 
-Runs all eleven ``paddle_tpu.analysis`` analyzer families over the live
+Runs all twelve ``paddle_tpu.analysis`` analyzer families over the live
 codebase and asserts ZERO error-severity findings, so a regression (a new
 jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug,
 a host callback in a compiled step, a typo'd mesh axis, a cost-model
 budget blowout, a serving-tier steady-state recompile, a leaked telemetry
 span, a sync inside a memory sampler, a non-hermetic persistent-cache
-entry or an armed fault injector / undeclared fault site) fails tier-1
+entry, an armed fault injector / undeclared fault site or a sharded
+checkpoint whose manifest stopped holding its pieces) fails tier-1
 instead of rotting until pod scale. The
 ``python -m tools.lint`` CLI contract (exit 0, machine-readable JSON
 with per-family wall-time, ``--include-tests``) is gated here too.
@@ -178,6 +179,22 @@ def test_cache_audit_green_on_demo_store(tmp_path):
     assert cache_cli.main(["verify", "--dir", store_dir]) == 0
 
 
+def test_ckpt_audit_green_on_demo_checkpoint(tmp_path):
+    """ISSUE 15: the sharded-checkpoint manifest contract holds on the
+    representative checkpoint — two tensors saved through the public
+    ``save_sharded`` path and round-tripped, every piece present and
+    sha256-exact, bounds covering each tensor, no orphans — and
+    ``tools.ckpt verify`` agrees with exit 0."""
+    from paddle_tpu.analysis.ckpt_check import (audit_ckpt_dir,
+                                                record_demo_checkpoint)
+
+    ck = record_demo_checkpoint(str(tmp_path))
+    assert [str(f) for f in audit_ckpt_dir(ck)] == []
+    import tools.ckpt as ckpt_cli
+
+    assert ckpt_cli.main(["verify", ck]) == 0
+
+
 def test_comm_audit_green_on_demo_session():
     """ISSUE 10 + 12: the comm-efficient collective tier's contract
     holds — the quantized allreduce passes its accuracy gate against the
@@ -226,7 +243,7 @@ def test_cli_exits_zero_with_machine_readable_findings(capsys):
     assert set(payload["analyzers"]) == {"trace", "registry", "program",
                                          "jaxpr", "spmd", "cost", "serving",
                                          "telemetry", "cache", "comm",
-                                         "fault"}
+                                         "fault", "ckpt"}
     assert isinstance(payload["findings"], list)
     # per-family wall-time (CI satellite): one entry per analyzer run
     assert set(payload["timings_s"]) == set(payload["analyzers"])

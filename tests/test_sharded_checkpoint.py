@@ -1,0 +1,741 @@
+"""ISSUE 15 — sharded checkpoint I/O + zero-downtime weight hot-swap
+(distributed/checkpoint/sharded/, Predictor/ServingEngine/DecodeEngine
+.swap_weights, tools.ckpt, the ckpt lint family).
+
+Covers the manifest round-trip (bit-identical fp32→fp32), the
+dtype-converting load vs an eager bf16-cast oracle, the changed-topology
+load (dp=8 pieces onto dp=4 and dp=1, bit-identical, O(shard) peak host
+bytes via tracemalloc), every loud failure mode (torn/corrupt/truncated/
+missing piece, incomplete set, existing target), the atomic publish
+under an injected ckpt.write fault, the mid-traffic hot swap (zero
+dropped requests, zero retraces, bit-exact vs a cold engine on the new
+checkpoint), the decode-tier swap between steps with KV slots intact,
+the snapshotter/state_dict/Model rewiring, the elastic-relaunch resume
+wiring, the tools.ckpt CLI exit-code contract and the CK95x seeded
+negatives. conftest forces 8 CPU devices, so every sharded layout here
+is real.
+"""
+import glob
+import json
+import os
+import threading
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.checkpoint import sharded as sc
+from paddle_tpu.static import InputSpec
+
+N_DEV = len(jax.devices())
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]).reshape(n), ("dp",))
+
+
+def _sharded_state(mesh, rows=64, cols=16, dtype=jnp.float32):
+    x = jnp.arange(rows * cols, dtype=dtype).reshape(rows, cols) / 7.0
+    return {
+        "w": jax.device_put(x, NamedSharding(mesh, P("dp"))),
+        "ids": jnp.arange(11, dtype=jnp.int32),
+        "nested": {"b": jnp.ones((5,), dtype) * 0.25},
+    }
+
+
+def _mlp(seed, d_in=16, hidden=32, d_out=8):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(d_in, hidden), nn.ReLU(),
+                        nn.Linear(hidden, d_out))
+    net.eval()
+    return net
+
+
+# ----------------------------------------------------------- round trips
+class TestSaveLoadRoundTrip:
+    def test_fp32_roundtrip_bit_identical_same_grid(self, tmp_path):
+        mesh = _mesh(8)
+        state = _sharded_state(mesh)
+        rep = sc.save_sharded(state, str(tmp_path / "ck"))
+        assert rep["n_tensors"] == 3
+        # one piece per unique shard of w + one each for ids / nested.b
+        assert rep["n_pieces"] == 10
+        out = sc.load_sharded(str(tmp_path / "ck"), mesh=mesh)
+        for name, want in (("w", state["w"]), ("ids", state["ids"]),
+                           ("nested.b", state["nested"]["b"])):
+            assert np.array_equal(np.asarray(out[name]), np.asarray(want))
+        assert out["w"].dtype == jnp.float32
+        # the manifest remembers the partition spec and the loader
+        # restores onto it by default
+        assert out["w"].sharding.spec == P("dp")
+
+    def test_manifest_records_spec_shape_dtype_sha(self, tmp_path):
+        mesh = _mesh(8)
+        sc.save_sharded(_sharded_state(mesh), str(tmp_path / "ck"))
+        man = sc.read_manifest(str(tmp_path / "ck"))
+        w = man["entries"]["w"]
+        assert w["shape"] == [64, 16] and w["dtype"] == "float32"
+        assert w["spec"] == ["dp"]
+        assert len(w["pieces"]) == 8
+        for piece in w["pieces"]:
+            assert len(piece["sha256"]) == 64
+            assert piece["bytes"] == 8 * 16 * 4
+        assert sc.verify_dir(str(tmp_path / "ck")) == []
+
+    def test_dtype_converting_load_matches_eager_cast_oracle(self, tmp_path):
+        """ISSUE 15 satellite: fp32 checkpoint → bf16 values equal the
+        eager bf16 cast of the saved fp32 tensors; int tensors pass
+        through untouched; and the fp32→fp32 round trip is bit-identical
+        (covered above and re-asserted here on the same checkpoint)."""
+        mesh = _mesh(8)
+        state = _sharded_state(mesh)
+        sc.save_sharded(state, str(tmp_path / "ck"))
+        out = sc.load_sharded(str(tmp_path / "ck"), dtype="bfloat16")
+        oracle = np.asarray(state["w"]).astype(jnp.bfloat16)
+        assert out["w"].dtype == jnp.bfloat16
+        assert np.array_equal(np.asarray(out["w"]), oracle)
+        assert out["ids"].dtype == jnp.int32  # never "converted"
+        again = sc.load_sharded(str(tmp_path / "ck"))
+        assert again["w"].dtype == jnp.float32
+        assert np.array_equal(np.asarray(again["w"]),
+                              np.asarray(state["w"]))
+
+    @pytest.mark.skipif(N_DEV < 8, reason="needs 8 devices")
+    def test_changed_topology_dp8_to_dp4_and_dp1_bit_identical(
+            self, tmp_path):
+        """ISSUE 15 satellite: a checkpoint saved on dp=8 restores onto
+        dp=4 and dp=1 meshes bit-identically — the N-d re-slice assembles
+        each target shard from only the overlapping saved pieces."""
+        mesh8 = _mesh(8)
+        state = _sharded_state(mesh8, rows=128, cols=32)
+        sc.save_sharded(state, str(tmp_path / "ck"))
+        want = np.asarray(state["w"])
+        out4 = sc.load_sharded(str(tmp_path / "ck"), mesh=_mesh(4),
+                               specs={"w": P("dp")})
+        assert np.array_equal(np.asarray(out4["w"]), want)
+        assert len(out4["w"].sharding.device_set) == 4
+        out1 = sc.load_sharded(str(tmp_path / "ck"))
+        assert np.array_equal(np.asarray(out1["w"]), want)
+
+    @pytest.mark.skipif(N_DEV < 8, reason="needs 8 devices")
+    def test_o_shard_peak_host_bytes(self, tmp_path):
+        """The acceptance gate: neither save nor a changed-topology load
+        materializes the full tensor on host. A 4 MiB dp=8-sharded
+        tensor (512 KiB shards) saves within a small multiple of one
+        shard, and the dp=4 re-slice load (1 MiB target slices) stays
+        well under the full-tensor bytes — measured with tracemalloc
+        (numpy/host allocations; device buffers are XLA's)."""
+        mesh8 = _mesh(8)
+        full_bytes = 2048 * 512 * 4  # 4 MiB
+        x = jax.device_put(
+            jnp.arange(2048 * 512, dtype=jnp.float32).reshape(2048, 512),
+            NamedSharding(mesh8, P("dp")))
+        tracemalloc.start()
+        try:
+            tracemalloc.reset_peak()
+            rep = sc.save_sharded({"w": x}, str(tmp_path / "ck"))
+            _, save_peak = tracemalloc.get_traced_memory()
+            assert rep["max_piece_bytes"] == full_bytes // 8
+            # one shard (512 KiB) at a time + manifest/json overhead
+            assert save_peak < full_bytes // 2, save_peak
+            tracemalloc.reset_peak()
+            out4 = sc.load_sharded(str(tmp_path / "ck"), mesh=_mesh(4),
+                                   specs={"w": P("dp")})
+            load_current, load_peak = tracemalloc.get_traced_memory()
+            # the CPU backend's device_put keeps each assembled slice
+            # alive as the device buffer's zero-copy backing (that IS
+            # the target layout's residency); the O(shard) law bounds
+            # the TRANSIENT overhead above it — at most one extra
+            # target slice + one saved piece in flight, never another
+            # full tensor
+            transient = load_peak - load_current
+            assert transient < full_bytes // 2, (load_peak, load_current)
+        finally:
+            tracemalloc.stop()
+        assert np.array_equal(np.asarray(out4["w"]), np.asarray(x))
+
+    def test_load_sharded_like_restores_onto_target_dtype_and_raises_on_gap(
+            self, tmp_path):
+        mesh = _mesh(8)
+        state = _sharded_state(mesh)
+        sc.save_sharded(state, str(tmp_path / "ck"))
+        targets = {"w": jnp.zeros((64, 16), jnp.bfloat16)}
+        new = sc.load_sharded_like(str(tmp_path / "ck"), targets)
+        assert new["w"].dtype == jnp.bfloat16
+        with pytest.raises(KeyError, match="missing"):
+            sc.load_sharded_like(str(tmp_path / "ck"),
+                                 {"not_there": jnp.zeros((1,))})
+        with pytest.raises(ValueError, match="shape"):
+            sc.load_sharded_like(str(tmp_path / "ck"),
+                                 {"w": jnp.zeros((2, 2))})
+
+
+# ----------------------------------------------------------- failure modes
+class TestFailureModes:
+    def _one(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        sc.save_sharded({"w": jnp.arange(32, dtype=jnp.float32)}, ck)
+        piece = sorted(glob.glob(os.path.join(ck, "*.bin")))[0]
+        return ck, piece
+
+    def test_corrupt_piece_fails_loudly_naming_it(self, tmp_path):
+        ck, piece = self._one(tmp_path)
+        data = open(piece, "rb").read()
+        open(piece, "wb").write(data[:-4] + b"\x00\x00\x00\x00")
+        with pytest.raises(RuntimeError, match="CORRUPT"):
+            sc.load_sharded(ck)
+        with pytest.raises(RuntimeError,
+                           match=os.path.basename(piece).replace(".", r"\.")):
+            sc.load_sharded(ck)
+
+    def test_truncated_piece_fails_loudly(self, tmp_path):
+        ck, piece = self._one(tmp_path)
+        data = open(piece, "rb").read()
+        open(piece, "wb").write(data[:-8])
+        with pytest.raises(RuntimeError, match="truncated|CORRUPT"):
+            sc.load_sharded(ck)
+
+    def test_missing_piece_fails_loudly_as_incomplete(self, tmp_path):
+        ck, piece = self._one(tmp_path)
+        os.remove(piece)
+        with pytest.raises(RuntimeError, match="INCOMPLETE"):
+            sc.load_sharded(ck)
+
+    def test_uncommitted_tmp_dir_is_not_loadable(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="manifest"):
+            sc.load_sharded(str(tmp_path / "never_saved"))
+
+    def test_existing_target_requires_overwrite(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        sc.save_sharded({"w": jnp.ones((4,))}, ck)
+        with pytest.raises(FileExistsError):
+            sc.save_sharded({"w": jnp.ones((4,))}, ck)
+        sc.save_sharded({"w": jnp.ones((4,)) * 2}, ck, overwrite=True)
+        assert float(np.asarray(sc.load_sharded(ck)["w"])[0]) == 2.0
+
+    def test_torn_write_leaves_no_readable_checkpoint(self, tmp_path):
+        """The injected ckpt.write fault lands between the piece writes
+        and the publish rename: only an unloadable tmp dir remains, and
+        a previously committed checkpoint stays the valid one."""
+        from paddle_tpu import reliability as rel
+
+        ck = str(tmp_path / "ck")
+        sc.save_sharded({"w": jnp.ones((8,))}, ck)
+        rel.arm(rel.FaultInjector(seed=0).plan("ckpt.write", rate=1.0))
+        try:
+            with pytest.raises(rel.FaultInjection):
+                sc.save_sharded({"w": jnp.ones((8,)) * 9}, ck,
+                                overwrite=True)
+        finally:
+            rel.disarm()
+        # previous checkpoint intact, new values never became visible
+        assert float(np.asarray(sc.load_sharded(ck)["w"])[0]) == 1.0
+
+    def test_non_float_conversion_refused_on_target_path(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        sc.save_sharded({"ids": jnp.arange(4, dtype=jnp.int32)}, ck)
+        with pytest.raises(ValueError, match="refusing to convert"):
+            sc.load_sharded_like(ck, {"ids": jnp.zeros((4,), jnp.float32)})
+
+    def test_interrupted_overwrite_strands_recoverable_previous(
+            self, tmp_path):
+        """The overwrite publish needs two renames; a crash between them
+        leaves the PREVIOUS checkpoint complete under a ``.tmp_old_*``
+        sibling and read_manifest's error points at it by name."""
+        ck = str(tmp_path / "ck")
+        sc.save_sharded({"w": jnp.ones((4,))}, ck)
+        stranded = str(tmp_path / ".tmp_old_ck_deadbeef")
+        os.rename(ck, stranded)  # simulate the crash window
+        with pytest.raises(FileNotFoundError,
+                           match="tmp_old_ck_deadbeef.*recover"):
+            sc.load_sharded(ck)
+        os.rename(stranded, ck)  # the advertised recovery works
+        assert float(np.asarray(sc.load_sharded(ck)["w"])[0]) == 1.0
+
+    def test_save_state_dict_sharded_refuses_multi_rank_race(
+            self, tmp_path, monkeypatch):
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed import env as env_mod
+        from paddle_tpu.distributed.checkpoint import save_state_dict
+
+        monkeypatch.setattr(env_mod, "get_world_size", lambda: 4)
+        with pytest.raises(ValueError, match="single-writer"):
+            save_state_dict({"w": Tensor(np.ones(2, np.float32))},
+                            str(tmp_path / "ck"), format="sharded")
+
+
+# --------------------------------------------------------------- hot swap
+class TestPredictorSwap:
+    def _export(self, tmp_path, seed, name="model"):
+        net = _mlp(seed)
+        prefix = str(tmp_path / f"m{seed}" / name)
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([None, 16], "float32")])
+        return net, prefix
+
+    def test_swap_is_bit_exact_with_cold_engine_and_zero_retrace(
+            self, tmp_path):
+        from paddle_tpu.inference import Config, Predictor
+
+        _net_a, prefix_a = self._export(tmp_path, 0)
+        net_b, prefix_b = self._export(tmp_path, 1)
+        ck_b = str(tmp_path / "ck_b")
+        sc.save_sharded(net_b.state_dict(), ck_b)
+
+        pred = Predictor(Config(prefix_a))
+        pred.warmup_ladder()
+        compiles = pred.compile_count
+        x = np.random.RandomState(0).randn(3, 16).astype(np.float32)
+        out_a, = pred.run_many([x], n=3)
+        report = pred.swap_weights(ck_b)
+        assert report["n_tensors"] == 4
+        out_b, = pred.run_many([x], n=3)
+        cold = Predictor(Config(prefix_b))
+        want, = cold.run_many([x], n=3)
+        assert not np.array_equal(out_a, out_b)
+        assert np.array_equal(out_b, want)
+        assert pred.compile_count == compiles  # zero retraces
+        # the single-request run() path serves the new weights too
+        got, = pred.run([x])
+        ref, = cold.run([x])
+        assert np.array_equal(got, ref)
+
+    def test_swap_refuses_shape_mismatch_and_missing_tensors(self, tmp_path):
+        _net_a, prefix_a = self._export(tmp_path, 0)
+        from paddle_tpu.inference import Config, Predictor
+
+        pred = Predictor(Config(prefix_a))
+        wrong = _mlp(3, d_in=16, hidden=64)  # different hidden width
+        ck = str(tmp_path / "ck_wrong")
+        sc.save_sharded(wrong.state_dict(), ck)
+        with pytest.raises(ValueError, match="shape|expects"):
+            pred.swap_weights(ck)
+        partial = {k: v for k, v in _mlp(1).state_dict().items()
+                   if not k.endswith("bias")}
+        ck2 = str(tmp_path / "ck_partial")
+        sc.save_sharded(partial, ck2)
+        with pytest.raises(KeyError, match="missing"):
+            pred.swap_weights(ck2)
+
+    def test_fp32_checkpoint_swaps_into_bf16_predictor(self, tmp_path):
+        """ISSUE 15 satellite: an fp32 training checkpoint rolls into a
+        bf16-serving predictor through the dtype-converting load, and
+        the outputs match a predictor exported from the eagerly
+        bf16-cast network (the oracle)."""
+        from paddle_tpu.inference import Config, Predictor
+
+        net_b = _mlp(1)
+        ck_b = str(tmp_path / "ck_fp32")
+        sc.save_sharded(net_b.state_dict(), ck_b)  # fp32 checkpoint
+
+        bf16_spec = [InputSpec([None, 16], "bfloat16")]
+        serving_net = _mlp(0).bfloat16()
+        prefix = str(tmp_path / "bf16" / "model")
+        paddle.jit.save(serving_net, prefix, input_spec=bf16_spec)
+        pred = Predictor(Config(prefix))
+        pred.warmup_ladder()
+        compiles = pred.compile_count
+        pred.swap_weights(ck_b)  # fp32 → bf16 per tensor
+        oracle_net = _mlp(1).bfloat16()  # the eager bf16-cast oracle
+        oracle_prefix = str(tmp_path / "bf16_oracle" / "model")
+        paddle.jit.save(oracle_net, oracle_prefix, input_spec=bf16_spec)
+        oracle = Predictor(Config(oracle_prefix))
+        x = np.random.RandomState(1).randn(2, 16).astype(jnp.bfloat16)
+        got, = pred.run_many([x], n=2)
+        want, = oracle.run_many([x], n=2)
+        assert got.dtype == want.dtype
+        assert np.array_equal(got, want)
+        assert pred.compile_count == compiles
+
+
+class TestServingEngineSwap:
+    def test_mid_traffic_swap_zero_drops_zero_retrace_bit_exact(
+            self, tmp_path):
+        """The acceptance criterion, in miniature: swap under live
+        multi-tenant traffic — no request fails, no retrace happens,
+        post-swap outputs equal a cold engine on the new checkpoint."""
+        from paddle_tpu import serving
+        from paddle_tpu.inference import Config, Predictor
+        from paddle_tpu.profiler.pipeline import ServingStats
+
+        net_a = _mlp(0)
+        prefix_a = str(tmp_path / "A" / "model")
+        paddle.jit.save(net_a, prefix_a,
+                        input_spec=[InputSpec([None, 16], "float32")])
+        net_b, prefix_b = _mlp(1), str(tmp_path / "B" / "model")
+        paddle.jit.save(net_b, prefix_b,
+                        input_spec=[InputSpec([None, 16], "float32")])
+        ck_b = str(tmp_path / "ck_b")
+        sc.save_sharded(net_b.state_dict(), ck_b)
+
+        engine = serving.ServingEngine(prefix_a, buckets=[1, 2, 4],
+                                       stats=ServingStats())
+        engine.warmup()
+        failures = []
+        served = [0]
+        stop = threading.Event()
+
+        def client(t_idx):
+            rs = np.random.RandomState(t_idx)
+            while not stop.is_set():
+                x = rs.randn(1 + t_idx % 2, 16).astype(np.float32)
+                try:
+                    engine.run(f"t{t_idx}", x, timeout=10.0)
+                    served[0] += 1
+                except Exception as e:  # zero-drop gate
+                    failures.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        report = engine.swap_weights(ck_b)
+        time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join()
+        x = np.random.RandomState(9).randn(2, 16).astype(np.float32)
+        got, = engine.run("t0", x)
+        engine.shutdown(drain=True)
+        cold = Predictor(Config(prefix_b))
+        want, = cold.run_many([x], n=2)
+        assert failures == []
+        assert served[0] > 10
+        assert report["compiles_after_warmup"] == 0
+        assert engine.compiles_after_warmup == 0
+        assert np.array_equal(got, want)
+
+
+class TestDecodeEngineSwap:
+    def _models(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+        cfg = gpt_tiny()
+        cfg.num_hidden_layers = 2
+        cfg.max_position_embeddings = 64
+        paddle.seed(0)
+        m_a = GPTForCausalLM(cfg)
+        m_a.eval()
+        paddle.seed(11)
+        m_b = GPTForCausalLM(cfg)
+        m_b.eval()
+        return cfg, m_a, m_b
+
+    def test_swap_between_decode_steps_keeps_slots_and_requests(
+            self, tmp_path):
+        from paddle_tpu.serving.decode import DecodeEngine
+
+        cfg, m_a, m_b = self._models()
+        ck_b = str(tmp_path / "ck_b")
+        sc.save_sharded(m_b.state_dict(), ck_b)
+        engine = DecodeEngine(m_a, max_slots=2, max_seq=32)
+        engine.warmup()
+        prompt = (np.arange(6) % cfg.vocab_size).astype(np.int32)
+        # a long request rides ACROSS the swap: it must complete, its
+        # slot must release, and the engine must never retrace
+        long_req = engine.submit("t", prompt, max_new_tokens=24)
+        time.sleep(0.05)
+        report = engine.swap_weights(ck_b)
+        out_long = long_req.result(60.0)
+        assert out_long.shape == (24,)
+        # post-swap generations equal a cold engine serving B's weights
+        got = engine.generate("t", prompt, max_new_tokens=8)
+        cold = DecodeEngine(m_b, max_slots=2, max_seq=32)
+        cold.warmup()
+        want = cold.generate("t", prompt, max_new_tokens=8)
+        assert np.array_equal(got, want)
+        assert engine.compiles_after_warmup == 0
+        assert report["compiles_after_warmup"] == 0
+        assert engine.kv_pool.in_use() == 0  # every slot released
+        engine.shutdown(drain=True)
+        cold.shutdown(drain=True)
+
+    def test_swap_from_live_twin_model(self):
+        from paddle_tpu.serving.decode import DecodeEngine
+
+        cfg, m_a, m_b = self._models()
+        engine = DecodeEngine(m_a, max_slots=2, max_seq=32)
+        engine.warmup()
+        n = engine.programs.swap_params(m_b)
+        assert n == len(jax.tree_util.tree_leaves(engine.programs.params))
+        assert engine.compiles_after_warmup in (None, 0)
+        engine.shutdown(drain=True)
+
+    def test_dir_swap_never_mutates_the_callers_model(self, tmp_path):
+        """A checkpoint swap must not silently rewrite the weights of
+        the model object the engine's owner handed to the constructor —
+        they may keep training or exporting it."""
+        from paddle_tpu.serving.decode import DecodeEngine
+
+        cfg, m_a, m_b = self._models()
+        before = {k: np.asarray(v._value).copy()
+                  for k, v in m_a.state_dict().items()}
+        ck_b = str(tmp_path / "ck_b")
+        sc.save_sharded(m_b.state_dict(), ck_b)
+        engine = DecodeEngine(m_a, max_slots=2, max_seq=32)
+        engine.warmup()
+        engine.swap_weights(ck_b)
+        for k, v in m_a.state_dict().items():
+            assert np.array_equal(np.asarray(v._value), before[k]), k
+        # ...while the engine itself serves B's weights
+        prompt = (np.arange(4) % cfg.vocab_size).astype(np.int32)
+        got = engine.generate("t", prompt, max_new_tokens=6)
+        cold = DecodeEngine(m_b, max_slots=2, max_seq=32)
+        cold.warmup()
+        want = cold.generate("t", prompt, max_new_tokens=6)
+        assert np.array_equal(got, want)
+        engine.shutdown(drain=True)
+        cold.shutdown(drain=True)
+
+
+# ----------------------------------------------------- rewired state paths
+class TestRewiredStatePaths:
+    def test_save_state_dict_sharded_format_and_autodetecting_load(
+            self, tmp_path):
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                       save_state_dict)
+
+        src = {"w": Tensor(np.arange(12, dtype=np.float32).reshape(3, 4)),
+               "b": Tensor(np.ones(3, np.float32))}
+        ck = str(tmp_path / "ck")
+        save_state_dict(src, ck, format="sharded")
+        assert sc.is_sharded_checkpoint(ck)
+        dst = {"w": Tensor(np.zeros((3, 4), np.float32)),
+               "b": Tensor(np.zeros(3, np.float32))}
+        load_state_dict(dst, ck)  # auto-detects the manifest format
+        assert np.array_equal(dst["w"].numpy(), src["w"].numpy())
+        assert np.array_equal(dst["b"].numpy(), src["b"].numpy())
+        with pytest.raises(ValueError, match="format"):
+            save_state_dict(src, ck, format="nope")
+
+    def test_snapshotter_params_ride_the_sharded_writer(self, tmp_path):
+        from paddle_tpu.reliability.snapshot import TrainSnapshotter
+
+        net = _mlp(5)
+        snap = TrainSnapshotter(str(tmp_path), keep=2)
+        path = snap.save(net, None, step=1, epoch=0, next_batch=1)
+        params_dir = os.path.join(path, "params")
+        assert sc.is_sharded_checkpoint(params_dir)
+        assert sc.verify_dir(params_dir) == []
+        twin = _mlp(6)  # different init on purpose
+        snap.restore(twin, None)
+        for (ka, va), (kb, vb) in zip(sorted(net.state_dict().items()),
+                                      sorted(twin.state_dict().items())):
+            assert ka == kb
+            assert np.array_equal(np.asarray(va._value),
+                                  np.asarray(vb._value))
+        # the snapshot's params dir is itself directly servable
+        from paddle_tpu.inference import Config, Predictor
+
+        prefix = str(tmp_path / "serve" / "model")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([None, 16], "float32")])
+        pred = Predictor(Config(prefix))
+        pred.swap_weights(params_dir)
+
+    def test_model_save_sharded_emits_servable_checkpoint(self, tmp_path):
+        from paddle_tpu.hapi.model import Model
+        from paddle_tpu.inference import Config, Predictor
+
+        net = _mlp(2)
+        m = Model(net)
+        rep = m.save_sharded(str(tmp_path / "ck"))
+        assert rep["n_tensors"] == 4
+        assert sc.verify_dir(str(tmp_path / "ck")) == []
+        prefix = str(tmp_path / "export" / "model")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([None, 16], "float32")])
+        pred = Predictor(Config(prefix))
+        out = pred.swap_weights(str(tmp_path / "ck"))
+        assert out["n_tensors"] == 4
+
+    def test_elastic_relaunch_resumes_from_snapshot_cursor(
+            self, tmp_path, monkeypatch):
+        """ISSUE 15 satellite (ROADMAP leftover from PR 14): a worker
+        the launcher restarted (PADDLE_RESTART_GEN > 0) passes resume=
+        through to Model.fit automatically — the restarted generation
+        continues from the snapshot cursor instead of replaying the
+        epoch from step 0."""
+        from paddle_tpu.hapi.callbacks import Callback
+        from paddle_tpu.hapi.model import Model
+
+        class Rec(Callback):
+            def __init__(self):
+                self.losses = []
+
+            def on_train_batch_end(self, step, logs=None):
+                self.losses.append(float(logs["loss"]))
+
+        def model():
+            paddle.seed(7)
+            net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                                nn.Linear(8, 1))
+            m = Model(net)
+            m.prepare(optimizer=paddle.optimizer.Adam(
+                learning_rate=0.01, parameters=net.parameters()),
+                loss=nn.MSELoss())
+            return m
+
+        rs = np.random.RandomState(0)
+        data = [(rs.randn(4, 4).astype(np.float32),
+                 rs.randn(4, 1).astype(np.float32)) for _ in range(6)]
+        ref, first = Rec(), Rec()
+        monkeypatch.delenv("PADDLE_RESTART_GEN", raising=False)
+        model().fit(data, epochs=1, sync_every=1, verbose=0, shuffle=False,
+                    callbacks=[ref])
+
+        class Crash(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if step == 3:
+                    raise RuntimeError("simulated preemption")
+
+        with pytest.raises(RuntimeError):
+            model().fit(data, epochs=1, sync_every=1, verbose=0,
+                        shuffle=False, callbacks=[first, Crash()],
+                        snapshot_dir=str(tmp_path), snapshot_every=2)
+        # the relaunched generation: resume is NOT passed — the env
+        # marker the launcher exports flips it on
+        monkeypatch.setenv("PADDLE_RESTART_GEN", "1")
+        resumed = Rec()
+        model().fit(data, epochs=1, sync_every=1, verbose=0, shuffle=False,
+                    callbacks=[resumed], snapshot_dir=str(tmp_path))
+        cut = len(ref.losses) - len(resumed.losses)
+        assert 0 < cut <= len(first.losses)
+        assert first.losses[:cut] + resumed.losses == ref.losses
+
+    def test_first_boot_generation_zero_starts_fresh(self, tmp_path,
+                                                     monkeypatch):
+        from paddle_tpu.hapi.model import Model
+
+        monkeypatch.setenv("PADDLE_RESTART_GEN", "0")
+        paddle.seed(1)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        m = Model(net)
+        m.prepare(optimizer=paddle.optimizer.Adam(
+            learning_rate=0.01, parameters=net.parameters()),
+            loss=nn.MSELoss())
+        rs = np.random.RandomState(0)
+        data = [(rs.randn(4, 4).astype(np.float32),
+                 rs.randn(4, 1).astype(np.float32)) for _ in range(3)]
+        m.fit(data, epochs=1, verbose=0, shuffle=False,
+              snapshot_dir=str(tmp_path))  # must not try to resume
+
+
+# ------------------------------------------------------------ CLI contract
+class TestCkptCli:
+    def _ck(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        sc.save_sharded(_sharded_state(_mesh(min(N_DEV, 8))), ck)
+        return ck
+
+    def test_verify_green_then_exit_1_on_corruption(self, tmp_path, capsys):
+        import tools.ckpt as cli
+
+        ck = self._ck(tmp_path)
+        assert cli.main(["verify", ck, "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["ok"] and payload["problems"] == []
+        piece = sorted(glob.glob(os.path.join(ck, "*.bin")))[0]
+        data = open(piece, "rb").read()
+        open(piece, "wb").write(data[:-2])  # truncate
+        assert cli.main(["verify", ck]) == 1
+        open(piece, "wb").write(b"\x00" * len(data))  # corrupt
+        assert cli.main(["verify", ck]) == 1
+        os.remove(piece)  # missing
+        assert cli.main(["verify", ck]) == 1
+        assert cli.main(["verify", str(tmp_path / "nope")]) == 1
+
+    def test_ls_lists_tensors_and_orphans(self, tmp_path, capsys):
+        import tools.ckpt as cli
+
+        ck = self._ck(tmp_path)
+        open(os.path.join(ck, "zzzz_orphan.p9.bin"), "wb").write(b"x")
+        assert cli.main(["ls", ck, "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["n_tensors"] == 3
+        assert payload["orphans"] == ["zzzz_orphan.p9.bin"]
+
+    def test_convert_emits_verified_bf16_checkpoint(self, tmp_path, capsys):
+        import tools.ckpt as cli
+
+        ck = self._ck(tmp_path)
+        dst = str(tmp_path / "bf16")
+        assert cli.main(["convert", ck, dst, "--dtype", "bfloat16",
+                         "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["n_cast"] == 2  # w and nested.b; ids stays int32
+        assert cli.main(["verify", dst]) == 0
+        out = sc.load_sharded(dst)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["ids"].dtype == jnp.int32
+        want = np.asarray(
+            _sharded_state(_mesh(min(N_DEV, 8)))["w"]).astype(jnp.bfloat16)
+        assert np.array_equal(np.asarray(out["w"]), want)
+
+    def test_convert_refuses_existing_destination(self, tmp_path):
+        import tools.ckpt as cli
+
+        ck = self._ck(tmp_path)
+        dst = str(tmp_path / "dst")
+        assert cli.main(["convert", ck, dst]) == 0
+        assert cli.main(["convert", ck, dst]) == 2
+        assert cli.main(["convert", ck, dst, "--overwrite"]) == 0
+
+
+# --------------------------------------------------------- lint family
+class TestCkptLintFamily:
+    def test_demo_checkpoint_audits_green(self, tmp_path):
+        from paddle_tpu.analysis.ckpt_check import (audit_ckpt_dir,
+                                                    record_demo_checkpoint)
+
+        ck = record_demo_checkpoint(str(tmp_path))
+        assert audit_ckpt_dir(ck) == []
+
+    def test_seeded_negatives_per_code(self, tmp_path):
+        from paddle_tpu.analysis.ckpt_check import (audit_ckpt_dir,
+                                                    record_demo_checkpoint)
+
+        ck = record_demo_checkpoint(str(tmp_path))
+        piece = sorted(glob.glob(os.path.join(ck, "*.bin")))[0]
+        data = open(piece, "rb").read()
+
+        # CK950: corrupt (same size, rotted bytes)
+        open(piece, "wb").write(b"\x00" * len(data))
+        codes = [f.code for f in audit_ckpt_dir(ck)]
+        assert "CK950" in codes
+        # CK951: missing piece
+        os.remove(piece)
+        codes = [f.code for f in audit_ckpt_dir(ck)]
+        assert "CK951" in codes
+        open(piece, "wb").write(data)  # heal
+
+        # CK952: manifest index lies (bounds past the tensor)
+        man_path = os.path.join(ck, "manifest.json")
+        man = json.load(open(man_path))
+        name = next(iter(man["entries"]))
+        man["entries"][name]["pieces"][0]["index"][0][1] += 4
+        json.dump(man, open(man_path, "w"))
+        codes = [f.code for f in audit_ckpt_dir(ck)]
+        assert "CK952" in codes
+
+        # CK953: orphan piece file (fresh healthy checkpoint)
+        ck2 = record_demo_checkpoint(str(tmp_path / "two"))
+        open(os.path.join(ck2, "zzzz_orphan.p0.bin"), "wb").write(b"x")
+        findings = audit_ckpt_dir(ck2)
+        assert [f.code for f in findings] == ["CK953"]
+        assert findings[0].severity == "warning"
+
+    def test_lint_family_registered(self):
+        import tools.lint as lint
+
+        assert "ckpt" in lint._ANALYZERS
+        assert lint._FAMILY_PREFIX["ckpt"] == "CK"

@@ -41,15 +41,24 @@ DEFAULT_METRIC = "gpt_tiny_train_tokens_per_sec_cpu"
 # saving over the fp32 ring (bench extras.comm, ISSUE 10), the zero1
 # sharded-vs-replicated optimizer-state residency ratio (bench
 # extras.zero1, ISSUE 12), the continuous-batched GPT decode
-# throughput (bench extras.serving, ISSUE 13) and the crash-resume
+# throughput (bench extras.serving, ISSUE 13), the crash-resume
 # replay distance (bench extras.resilience, ISSUE 14 — deterministic:
 # crash step and snapshot cadence are seeded, so any move means the
-# snapshot path changed); each gates only once two rounds carry it
+# snapshot path changed) and the mid-traffic weight-hot-swap latency
+# spike (bench extras.swap, ISSUE 15); each gates only once two rounds
+# carry it
 DEFAULT_EXTRAS = ("coldstart.train_warm_speedup_x",
                   "comm.allreduce_bytes_saved_ratio",
                   "zero1.opt_state_bytes_ratio",
                   "serving.decode_tokens_per_sec",
-                  "resilience.recovery_steps")
+                  "resilience.recovery_steps",
+                  "swap.pause_ms_p99")
+
+# metrics where LOWER is better (latencies, replay distances): the
+# judge inverts its direction for these — the gate fires when the
+# latest run RISES more than the threshold above the best (lowest)
+# prior, and an improvement can never fail CI
+LOWER_IS_BETTER = ("resilience.recovery_steps", "swap.pause_ms_p99")
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -116,13 +125,17 @@ def load_trajectory(bench_dir: str, metric: str = DEFAULT_METRIC,
     return rows
 
 
-def judge(rows: List[dict], threshold: float) -> dict:
+def judge(rows: List[dict], threshold: float,
+          lower_is_better: bool = False) -> dict:
     """The regression verdict over a loaded trajectory: latest parsed
     value vs the best PRIOR parsed value. Fewer than two parsed runs →
-    nothing to judge (ok=True, reason says why)."""
+    nothing to judge (ok=True, reason says why). ``lower_is_better``
+    inverts the direction (latency-style metrics): best prior = the
+    LOWEST, and the gate fires on a rise past the threshold."""
     parsed = [r for r in rows if r["value"] is not None]
     verdict = {"ok": True, "threshold": threshold, "latest": None,
-               "best_prior": None, "delta_vs_best": None, "reason": None}
+               "best_prior": None, "delta_vs_best": None, "reason": None,
+               "lower_is_better": bool(lower_is_better)}
     if not parsed:
         verdict["reason"] = "no parsed runs"
         return verdict
@@ -132,16 +145,36 @@ def judge(rows: List[dict], threshold: float) -> dict:
     if not prior:
         verdict["reason"] = "single parsed run — no prior to compare"
         return verdict
-    best = max(prior, key=lambda r: r["value"])
-    delta = latest["value"] / best["value"] - 1.0
+    if lower_is_better:
+        best = min(prior, key=lambda r: r["value"])
+        # normalized so "regressed past the gate" is delta < -threshold
+        # in BOTH directions: a rise of a lower-is-better metric reads
+        # as a negative delta here
+        delta = (best["value"] / latest["value"] - 1.0
+                 if latest["value"] else 0.0)
+    else:
+        best = max(prior, key=lambda r: r["value"])
+        delta = latest["value"] / best["value"] - 1.0
     verdict["best_prior"] = {"run": best["run"], "value": best["value"]}
     verdict["delta_vs_best"] = round(delta, 4)
     if delta < -threshold:
         verdict["ok"] = False
-        verdict["reason"] = (
-            f"run {latest['run']} is {-delta:.1%} below the best prior run "
-            f"{best['run']} ({latest['value']:.1f} vs {best['value']:.1f}) "
-            f"— past the {threshold:.0%} regression gate")
+        if lower_is_better:
+            # report the actual rise (latest/best - 1), not the
+            # normalized gating delta — 10ms → 20ms must read as
+            # "100% above", not "50%"
+            rise = latest["value"] / best["value"] - 1.0
+            verdict["reason"] = (
+                f"run {latest['run']} is {rise:.1%} above the best prior "
+                f"run {best['run']} ({latest['value']:.1f} vs "
+                f"{best['value']:.1f}) — past the {threshold:.0%} "
+                "regression gate")
+        else:
+            verdict["reason"] = (
+                f"run {latest['run']} is {-delta:.1%} below the best prior "
+                f"run {best['run']} ({latest['value']:.1f} vs "
+                f"{best['value']:.1f}) — past the {threshold:.0%} "
+                "regression gate")
     else:
         verdict["reason"] = (
             f"run {latest['run']} within {threshold:.0%} of best prior "
@@ -195,7 +228,9 @@ def main(argv=None) -> int:
     for dotted in extras:
         erows = load_trajectory(args.dir, extract=dotted)
         extra_out[dotted] = {"runs": erows,
-                             "verdict": judge(erows, args.threshold)}
+                             "verdict": judge(
+                                 erows, args.threshold,
+                                 lower_is_better=dotted in LOWER_IS_BETTER)}
     ok = verdict["ok"] and all(e["verdict"]["ok"] for e in extra_out.values())
     if args.as_json:
         print(json.dumps({"metric": args.metric, "runs": rows,

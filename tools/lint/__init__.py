@@ -1,6 +1,6 @@
 """``python -m tools.lint`` — the repo's static-analysis driver.
 
-Runs the eleven ``paddle_tpu.analysis`` analyzers and reports findings:
+Runs the twelve ``paddle_tpu.analysis`` analyzers and reports findings:
 
 - **trace**:    the trace-safety AST linter over ``paddle_tpu/`` (or the
                 paths given on the command line),
@@ -47,6 +47,12 @@ Runs the eleven ``paddle_tpu.analysis`` analyzers and reports findings:
                 FaultInjector left armed outside a chaos run, no
                 RetryPolicy with a dead deadline budget, no injection
                 into an undeclared fault site.
+- **ckpt**:     the sharded-checkpoint manifest contract (CK95x) over a
+                freshly recorded demo checkpoint (two tensors saved
+                through the public ``save_sharded`` path, round-tripped
+                through ``load_sharded``): every piece present, byte-
+                and sha256-exact, bounds covering each tensor exactly,
+                no orphan pieces or stale writer tmp dirs.
 
 Exit-code contract (stable, CI-gateable):
   0 = no error-severity findings (warnings never gate)
@@ -69,7 +75,7 @@ import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd", "cost",
-              "serving", "telemetry", "cache", "comm", "fault")
+              "serving", "telemetry", "cache", "comm", "fault", "ckpt")
 
 
 def _source_paths(paths, include_tests=False):
@@ -266,18 +272,37 @@ def _run_fault(paths, include_tests=False):
     return check_paths(_source_paths(paths, include_tests=False))
 
 
+def _run_ckpt(_paths, include_tests=False):
+    """Record the representative sharded checkpoint (two tensors saved
+    and round-tripped through the public save/load path into a temp
+    dir) and audit its manifest contract (CK95x,
+    analysis/ckpt_check.py)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.analysis.ckpt_check import (audit_ckpt_dir,
+                                                record_demo_checkpoint)
+
+    tmpdir = tempfile.mkdtemp(prefix="paddle_lint_ckpt_")
+    try:
+        return audit_ckpt_dir(record_demo_checkpoint(tmpdir))
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 _RUNNERS = {"trace": _run_trace, "registry": _run_registry,
             "program": _run_program, "jaxpr": _run_jaxpr,
             "spmd": _run_spmd, "cost": _run_cost,
             "serving": _run_serving, "telemetry": _run_telemetry,
-            "cache": _run_cache, "comm": _run_comm, "fault": _run_fault}
+            "cache": _run_cache, "comm": _run_comm, "fault": _run_fault,
+            "ckpt": _run_ckpt}
 
 # analyzer -> its finding-code family prefix, so a crash finding
 # (<PREFIX>999) stays visible under --select filters for that family
 _FAMILY_PREFIX = {"trace": "TS", "registry": "RC", "program": "PV",
                   "jaxpr": "JX", "spmd": "SP", "cost": "CM",
                   "serving": "JX", "telemetry": "OB", "cache": "CC",
-                  "comm": "QZ", "fault": "FT"}
+                  "comm": "QZ", "fault": "FT", "ckpt": "CK"}
 
 
 def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
