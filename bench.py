@@ -234,25 +234,76 @@ def _lint_bench(step):
     excluded here because they compile a fresh model, which would tax a
     TPU bench's budget), plus proof the audit tier is strictly on-demand:
     ``audit_report()`` on the live bench TrainStep must read counters in
-    microseconds and build nothing new."""
+    microseconds and build nothing new. ISSUE 16 adds the concurrency
+    family's static-scan cost and the lock witness's per-acquire
+    overhead, lit vs dark (interleaved best-of-2, the same protocol as
+    extras.telemetry — the dark number is the tax EVERY runtime lock
+    pays after the named_lock migration, so it must stay at one bool
+    read)."""
     from tools.lint import run_analyzers
 
     t0 = time.perf_counter()
     findings, crashed, timings = run_analyzers(("trace", "registry", "spmd"))
     lint_s = time.perf_counter() - t0
+    from paddle_tpu.analysis.concurrency_check import check_paths
+
+    t0 = time.perf_counter()
+    cx_findings = check_paths(
+        [os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "paddle_tpu")])
+    cx_s = time.perf_counter() - t0
     builds_before = sum(step._compiled._compile_counts.values())
     t0 = time.perf_counter()
     report = step.audit_report()
     report_us = (time.perf_counter() - t0) * 1e6
-    return {
+    out = {
         "lint_wall_s": round(lint_s, 3),
         "lint_family_wall_s": timings,
         "lint_findings": len(findings),
         "lint_crashed": crashed,
+        "concurrency_family_seconds": round(cx_s, 3),
+        "concurrency_findings": len(cx_findings),
         "audit_report_us": round(report_us, 1),
         "audit_builds_delta": (sum(step._compiled._compile_counts.values())
                                - builds_before),
         "cache_keys": report["n_cache_keys"],
+    }
+    out.update(_witness_overhead_bench())
+    return out
+
+
+def _witness_overhead_bench(n=20000, reps=2):
+    """Per-acquire cost of a named lock, witness dark vs lit.
+
+    Interleaved dark/lit (best-of-``reps`` per mode, alternating) so a
+    background frequency drift taxes both modes equally — the same
+    protocol as the telemetry span bench. Restores the witness's
+    previous state."""
+    from paddle_tpu.observability import locks
+
+    lk = locks.named_lock("bench.witness_probe")
+
+    def drive():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            lk.acquire()
+            lk.release()
+        return (time.perf_counter() - t0) / n * 1e9
+
+    was = locks.set_witness(False)
+    try:
+        dark = lit = float("inf")
+        for _ in range(reps):
+            locks.set_witness(False)
+            dark = min(dark, drive())
+            locks.set_witness(True)
+            lit = min(lit, drive())
+    finally:
+        locks.set_witness(was)
+    return {
+        "witness_overhead_ns_per_acquire": round(lit - dark, 1),
+        "witness_dark_ns_per_acquire": round(dark, 1),
+        "witness_lit_ns_per_acquire": round(lit, 1),
     }
 
 
@@ -1805,11 +1856,14 @@ def main():
         return deadline - time.monotonic()
 
     def bail(note):
-        print(json.dumps({
+        payload = {
             "metric": os.environ.get("BENCH_MODE", "gpt") + "_bench_failed",
             "value": None, "unit": "n/a", "vs_baseline": None,
             "note": note, "errors": errors[-4:],
-        }))
+        }
+        if probe_timed_out is not None:
+            payload["backend_probe_timeout"] = probe_timed_out
+        print(json.dumps(payload))
         sys.exit(0)
 
     cpu_env = dict(os.environ)
@@ -1829,6 +1883,8 @@ def main():
     probe_env = dict(os.environ)
     probe_env["BENCH_PROBE"] = "1"
     platform = None
+    probe_timed_out = None  # seconds granted to a probe that hung (ROADMAP:
+    #                         the hang is timeout-boxed AND visible in the JSON)
     # Two attempts spread across the budget (VERDICT r3 #1): a transiently
     # wedged tunnel gets a second chance after a cool-down instead of
     # costing the whole round. Each attempt's failure records rc/stderr so
@@ -1847,6 +1903,7 @@ def main():
             errors.append(f"probe{attempt}: rc={rc} stderr_tail={err.strip()[-300:]!r}")
         except subprocess.TimeoutExpired as e:
             tail = (e.stderr or "").strip()[-200:]
+            probe_timed_out = round(probe_timeout, 1)
             errors.append(f"probe{attempt}: backend init hung >{probe_timeout:.0f}s"
                           + (f" stderr_tail={tail!r}" if tail else ""))
         if attempt == 1 and remaining() - CPU_RESERVE > 150:
@@ -1881,6 +1938,8 @@ def main():
             if errors:  # only real failures land here; a cpu-only host is clean
                 parsed["note"] = "cpu_fallback"
                 parsed["tpu_errors"] = errors[-3:]
+            if probe_timed_out is not None:
+                parsed["backend_probe_timeout"] = probe_timed_out
             print(json.dumps(parsed))
             return
         errors.append(f"cpu run: rc={rc} stderr_tail={err.strip()[-300:]!r}")

@@ -42,6 +42,12 @@ analyzers that run at commit time:
   FaultInjector left armed outside a chaos run, no RetryPolicy with a
   dead deadline budget, no injection into a fault site whose
   release/cleanup path is undeclared.
+- :mod:`concurrency_check` — the threaded runtime's lock discipline
+  (CX10xx): no shared attribute mutated from two thread entry points
+  without a lock, no static lock-order cycle, no blocking call under a
+  held lock, no bare ``threading.Lock()`` outside the named-lock
+  registry; plus the runtime lock-order witness
+  (``observability/locks.py``, CX1004 inversions / CX1005 hold budget).
 
 One CLI drives them all: ``python -m tools.lint`` (exit 1 on any
 error-severity finding, 2 on an analyzer crash; ``--json`` for
@@ -58,6 +64,9 @@ __all__ = [
     "audit_jaxpr",
     "audit_kernel_cache",
     "audit_telemetry",
+    "audit_witness",
+    "check_concurrency_paths",
+    "check_concurrency_source",
     "check_cost",
     "check_fault_paths",
     "check_fault_source",
@@ -237,3 +246,21 @@ def audit_fault_injector(injector="__live__"):
     from .fault_check import audit_injector as _impl
 
     return _impl(injector)
+
+
+def check_concurrency_paths(paths):
+    from .concurrency_check import check_paths as _impl
+
+    return _impl(paths)
+
+
+def check_concurrency_source(source, filename="<string>"):
+    from .concurrency_check import check_source as _impl
+
+    return _impl(source, filename)
+
+
+def audit_witness():
+    from .concurrency_check import audit_witness as _impl
+
+    return _impl()

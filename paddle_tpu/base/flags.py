@@ -11,7 +11,9 @@ import os
 import threading
 from typing import Any, Dict, Iterable, Mapping
 
-_lock = threading.Lock()
+# bootstrap layer: this module is imported before (and BY)
+# observability.locks, so its guard stays a bare primitive
+_lock = threading.Lock()  # noqa: CX1003 — flags bootstrap precedes the registry
 _registry: Dict[str, "_Flag"] = {}
 # flag name -> callbacks fired (outside the lock) after set_flags changes
 # it — for subsystems that mirror a flag into a hot-path attribute (the
@@ -325,6 +327,18 @@ define_flag("train_snapshot_every", 0,
 define_flag("train_snapshot_keep", 2,
             "reliability TrainSnapshotter: rolling window — newest N "
             "snapshots survive, older ones are pruned after each commit")
+define_flag("concurrency_witness", False,
+            "concurrency lint family (observability/locks.py): record "
+            "every named-lock acquire into the process lock-order witness "
+            "— per-thread held stacks, acquire/contended/hold-time "
+            "counters, order-graph edges; a cycle-closing edge is a "
+            "CX1004 inversion fed to the anomaly flight recorder. Off "
+            "(the default) = one bool read per acquire, zero recording")
+define_flag("concurrency_max_hold_ms", 0.0,
+            "concurrency witness: a lit-mode lock hold longer than this "
+            "records a CX1005 violation (blocking work is living under a "
+            "lock); <=0 disables the hold-time watcher — compile/warmup "
+            "phases legitimately hold program locks for seconds")
 define_flag("cost_max_guard_preds", 8,
             "cost-model lint (CM505): a speculative branch family "
             "verifying more guard predicates than this per call is "

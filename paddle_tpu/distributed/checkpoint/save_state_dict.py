@@ -33,6 +33,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...observability.locks import named_lock
 from ...reliability.faults import fault_point
 from ...reliability.snapshot import fsync_dir
 
@@ -63,7 +64,7 @@ def _gather_object(obj):
 # Pending async writers, keyed by checkpoint path so overlapping saves into
 # different directories never join (or interleave with) each other. Failed
 # async commits are recorded per path and re-raised by wait_async_save.
-_pending_lock = threading.Lock()
+_pending_lock = named_lock("distributed.ckpt.pending")
 _pending_writers: Dict[str, list] = {}
 _pending_errors: Dict[str, Exception] = {}
 

@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ...base.log import get_logger
+from ...observability.locks import named_lock
 
 
 class TableOptimizer:
@@ -81,7 +82,7 @@ class SparseTable:
         self._rs = np.random.RandomState(seed)
         self.rows: Dict[int, np.ndarray] = {}
         self.slots: Dict[int, Dict[str, np.ndarray]] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("distributed.ps")
 
     def _row(self, i: int) -> np.ndarray:
         r = self.rows.get(i)

@@ -22,6 +22,7 @@ from typing import Callable, List, Optional
 import jax
 
 from ...base.log import get_logger
+from ...observability.locks import named_lock
 
 
 @dataclass
@@ -40,7 +41,7 @@ class CommTaskManager:
         self.on_timeout = on_timeout
         self.poll_interval = poll_interval
         self._tasks: List[_Task] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("distributed.watchdog")
         self._stop = threading.Event()
         self._seq = 0
         self.timeouts: List[str] = []  # tags that exceeded the deadline

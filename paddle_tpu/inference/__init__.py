@@ -13,10 +13,11 @@ arrays (copy_from_cpu = host→HBM transfer, copy_to_cpu = fetch).
 from __future__ import annotations
 
 import os
-import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+
+from ..observability.locks import named_lock
 
 
 def _warn(msg: str) -> None:
@@ -216,7 +217,7 @@ class _BatchProgram:
         self._aot: Dict[int, object] = {}
         self.restored: List[int] = []   # rungs restored from disk this process
         self._content_hash = getattr(layer, "_content_hash", None)
-        self._lock = threading.Lock()
+        self._lock = named_lock("inference.batch_program")
 
         def _fwd(params, *args):
             # runs under trace only: one tick per (re)compile, zero per replay

@@ -20,6 +20,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..observability.locks import named_lock
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
@@ -84,7 +85,7 @@ class _MapIter:
         self.batch_iter = enumerate(
             itertools.islice(iter(loader.batch_sampler), skip, None)
             if skip else iter(loader.batch_sampler))
-        self.lock = threading.Lock()
+        self.lock = named_lock("io.dataloader.batch_iter")
         self.n_workers = max(loader.num_workers, 0)
         if self.n_workers:
             depth = loader.prefetch_factor * self.n_workers
@@ -115,8 +116,13 @@ class _MapIter:
                 try:
                     seq, indices = next(self.batch_iter)
                 except StopIteration:
-                    self.out_q.put((None, None))
-                    return
+                    seq = None
+            if seq is None:
+                # the end-of-epoch sentinel goes out AFTER the iterator
+                # lock drops (CX1002: a .put() on an unbounded-wait queue
+                # must not park this thread while it owns the lock)
+                self.out_q.put((None, None))
+                return
             try:
                 self.out_q.put((seq, self._fetch(indices)))
             except BaseException as e:  # surface worker errors to the consumer

@@ -35,6 +35,11 @@ Egress + forensics (ISSUE 8) sit on top:
 - ``SpanTracer.capture_device`` — ``jax.profiler`` windows fused into
   the SAME chrome-trace export as the host spans (``device.*`` tracks,
   clock-aligned at capture boundaries).
+- :mod:`locks` — the named-lock registry + runtime lock-order witness
+  (concurrency lint family, CX10xx): every runtime lock/condition is a
+  ``named_lock``/``named_condition``; ``FLAGS_concurrency_witness``
+  records acquisition order, contention and hold times, flags order
+  inversions (CX1004) into the anomaly flight recorder.
 
 The OB6xx telemetry lint family (``analysis/telemetry_check.py``, run by
 ``python -m tools.lint``) gates the contract: no unclosed span at
@@ -47,16 +52,20 @@ from __future__ import annotations
 
 from .adapters import register_default_collectors
 from .anomaly import AnomalyMonitor, monitor
+from .locks import (NamedCondition, NamedLock, named_condition, named_lock,
+                    set_witness, witness_enabled, witness_report)
 from .memory import DeviceMemorySampler, device_memory_stats, sampler
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, registry
 from .tracing import SpanTracer, tracer
 
 __all__ = [
     "AnomalyMonitor", "Counter", "DeviceMemorySampler", "Gauge",
-    "Histogram", "MetricsRegistry", "SpanTracer", "TelemetryServer",
+    "Histogram", "MetricsRegistry", "NamedCondition", "NamedLock",
+    "SpanTracer", "TelemetryServer",
     "counter", "device_memory_stats", "export_trace", "gauge", "histogram",
-    "monitor", "prometheus_text", "registry",
-    "register_default_collectors", "sampler", "snapshot", "span", "tracer",
+    "monitor", "named_condition", "named_lock", "prometheus_text",
+    "registry", "register_default_collectors", "sampler", "set_witness",
+    "snapshot", "span", "tracer", "witness_enabled", "witness_report",
 ]
 
 register_default_collectors(registry)
@@ -85,6 +94,10 @@ try:
                     lambda v: setattr(tracer, "enabled", bool(v)))
     _on_flag_change("telemetry_anomaly",
                     lambda v: setattr(monitor, "enabled", bool(v)))
+    from .locks import set_witness as _set_witness
+
+    _on_flag_change("concurrency_witness",
+                    lambda v: _set_witness(bool(v)))
 except Exception:
     pass
 

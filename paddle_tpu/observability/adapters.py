@@ -22,6 +22,9 @@ jit.compile            process-wide program-build counters: whole-step
 compile_cache          ``compile_cache.stats()`` (persistent AOT store:
                        hit/miss/store/corrupt/vjp_skip/key_skip counters,
                        load/store wall seconds, disk bytes when enabled)
+concurrency            ``observability.locks.witness_stats()`` (named-lock
+                       registry size, witness acquires/contended/hold_ms,
+                       order-graph edges, CX1004/CX1005 violation counts)
 ====================== ====================================================
 
 Registered once at ``paddle_tpu.observability`` import; every import in
@@ -66,6 +69,14 @@ def _collect_compile() -> dict:
     return out
 
 
+def _collect_concurrency() -> dict:
+    # pull-time by design: a per-acquire instrument update would recurse
+    # (the instruments' own guards are named locks)
+    from .locks import witness_stats
+
+    return witness_stats()
+
+
 def _collect_compile_cache() -> dict:
     from ..compile_cache import stats
 
@@ -80,3 +91,4 @@ def register_default_collectors(reg: MetricsRegistry = registry) -> None:
     reg.register_collector("serving", _collect_serving)
     reg.register_collector("jit.compile", _collect_compile)
     reg.register_collector("compile_cache", _collect_compile_cache)
+    reg.register_collector("concurrency", _collect_concurrency)

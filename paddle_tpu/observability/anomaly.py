@@ -46,17 +46,18 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
+
+from .locks import named_lock
 
 __all__ = ["AnomalyMonitor", "Detector", "MemoryWatermarkDetector",
            "RejectBurstDetector", "ServingSLODetector",
            "StepTimeRegressionDetector", "monitor"]
 
 _MONITOR_COUNT = [0]
-_MONITOR_COUNT_LOCK = threading.Lock()
+_MONITOR_COUNT_LOCK = named_lock("anomaly.monitor_count")
 
 
 def _get_flag(name, default):
@@ -94,7 +95,7 @@ class StepTimeRegressionDetector(Detector):
         # the ring is appended from the train thread but snapshotted by
         # step_window() from whichever thread dumps a bundle (e.g. the
         # serving scheduler) — iterating a deque during an append raises
-        self._obs_lock = threading.Lock()
+        self._obs_lock = named_lock("anomaly.step_window")
 
     @staticmethod
     def _median(sorted_vals: List[float]) -> float:
@@ -105,10 +106,12 @@ class StepTimeRegressionDetector(Detector):
         return 0.5 * (sorted_vals[mid - 1] + sorted_vals[mid])
 
     def observe(self, step_s: float) -> Optional[dict]:
-        self.observed += 1
         threshold = (self._mad_threshold if self._mad_threshold is not None
                      else float(_get_flag("anomaly_step_mad", 0.0)))
         with self._obs_lock:
+            # observed moves with the ring (CX1000: the counter is read
+            # by whichever thread dumps a bundle, not just the feeder)
+            self.observed += 1
             history = list(self._ring)
             self._ring.append(float(step_s))
         if threshold <= 0 or len(history) < self._min_history:
@@ -167,7 +170,7 @@ class RejectBurstDetector(Detector):
         # unlike the step/serving detectors (fed from one loop thread),
         # rejections arrive from arbitrary submitter threads OUTSIDE the
         # queue's condition lock, so the window needs its own lock
-        self._obs_lock = threading.Lock()
+        self._obs_lock = named_lock("anomaly.reject_window")
 
     def observe(self, tenant: Optional[str] = None) -> Optional[dict]:
         burst = int(self._burst if self._burst is not None
@@ -243,7 +246,7 @@ class AnomalyMonitor:
         self.span_tail = int(span_tail)
         self._tracer = tracer
         self._registry = registry
-        self._lock = threading.Lock()
+        self._lock = named_lock("anomaly.monitor")
         self._last_dump: Dict[str, float] = {}   # kind -> perf_counter stamp
         self._last_note: Dict[str, float] = {}   # counted-not-dumped log stamp
         self._seq = 0
@@ -330,6 +333,16 @@ class AnomalyMonitor:
 
         verdict = det.observe(sampler.last)
         return self._trigger(verdict, det) if verdict else None
+
+    def on_lock_inversion(self, verdict: dict) -> Optional[str]:
+        """Lock-order inversion from the concurrency witness
+        (observability/locks.py, CX1004): always a trigger — the witness
+        being lit is the opt-in, so this feed does not also gate on
+        ``enabled``. Rate-limited per kind like every other feed, which
+        is what bounds an inversion storm to one bundle per cooldown."""
+        v = dict(verdict)
+        v["kind"] = "lock_inversion"
+        return self._trigger(v, None)
 
     def on_exception(self, where: str, exc: BaseException) -> Optional[str]:
         """Uncaught train-loop / serving-worker exception: always a

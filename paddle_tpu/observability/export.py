@@ -45,6 +45,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional
 
+from .locks import named_lock
+
 __all__ = ["TelemetryServer", "active_servers", "process_metadata",
            "prometheus_text"]
 
@@ -53,7 +55,7 @@ _PROC_T0_UNIX = time.time()
 # servers currently serving, for the OB604 audit (start appends,
 # stop removes; the list is tiny — one per engine plus the CLI's)
 _active_servers: List["TelemetryServer"] = []
-_active_lock = threading.Lock()
+_active_lock = named_lock("export.servers")
 
 
 def active_servers() -> List["TelemetryServer"]:

@@ -28,8 +28,9 @@ timeline phases.
 """
 from __future__ import annotations
 
-import threading
 from typing import Optional
+
+from .locks import named_lock
 
 __all__ = ["DeviceMemorySampler", "device_memory_stats", "sampler"]
 
@@ -72,7 +73,7 @@ class DeviceMemorySampler:
     every step of every loop."""
 
     def __init__(self, sample_every: Optional[int] = None):
-        self._lock = threading.Lock()
+        self._lock = named_lock("memory.sampler")
         self._calls = 0
         self.samples = 0
         self._sample_every = sample_every

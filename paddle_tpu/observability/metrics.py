@@ -27,9 +27,10 @@ on this) and returns a detached instrument so the caller still works.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Dict, List, Optional
+
+from .locks import named_lock
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry"]
 
@@ -46,7 +47,7 @@ class _Instrument:
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.instrument")
         self._cells: Dict[tuple, object] = {}
 
     def _values(self) -> list:
@@ -173,7 +174,7 @@ class MetricsRegistry:
     every subsystem's telemetry lands in."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.registry")
         self._instruments: Dict[str, _Instrument] = {}
         self._collectors: Dict[str, Callable[[], dict]] = {}
         # (name, requested_kind, existing_kind) schema collisions — the

@@ -45,9 +45,10 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import List, Optional
+
+from .locks import named_lock
 
 __all__ = ["SpanTracer", "tracer"]
 
@@ -206,7 +207,7 @@ class SpanTracer:
 
     def __init__(self, enabled: Optional[bool] = None,
                  max_events: Optional[int] = None):
-        self._lock = threading.Lock()
+        self._lock = named_lock("tracing.spans")
         self._events: List[tuple] = []   # (ph, name, track, ts_us, dur_us, args)
         self._device_events: List[tuple] = []  # same tuples, device.* tracks
         self._open: dict = {}            # id(_Span) -> _Span

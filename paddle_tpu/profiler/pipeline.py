@@ -27,15 +27,16 @@ leave on; ``reset()`` starts a fresh window.
 """
 from __future__ import annotations
 
-import threading
 import time
+
+from ..observability.locks import named_lock
 
 
 class PipelineStats:
     """Thread-safe accumulator for the per-step pipeline breakdown."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("profiler.pipeline_stats")
         self.reset()
 
     def reset(self):
@@ -112,7 +113,7 @@ class ServingStats:
     """
 
     def __init__(self, max_samples: int = 8192):
-        self._lock = threading.Lock()
+        self._lock = named_lock("profiler.serving_stats")
         self._max_samples = int(max_samples)
         self.reset()
 

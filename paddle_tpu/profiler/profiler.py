@@ -19,6 +19,7 @@ from enum import Enum
 from typing import Callable, Iterable, Optional
 
 from ..base.log import get_logger
+from ..observability.locks import named_lock
 
 
 class ProfilerState(Enum):
@@ -51,7 +52,7 @@ class _EventStore(threading.local):
 
 _store = _EventStore()
 _global_events = []
-_global_lock = threading.Lock()
+_global_lock = named_lock("profiler.global")
 
 
 class RecordEvent:

@@ -37,9 +37,10 @@ chaos/test run.
 from __future__ import annotations
 
 import random
-import threading
 import time
 from typing import Dict, List, Optional
+
+from ..observability.locks import named_lock
 
 __all__ = ["FaultInjection", "FaultInjector", "FaultPlan", "SITES",
            "active", "arm", "corrupt_bytes", "disarm", "fault_point"]
@@ -126,7 +127,7 @@ class FaultInjector:
         self.injected: List[tuple] = []      # (site, kind) log, in order
         self.seen_sites: set = set()         # every site that consulted us
         self._rngs: Dict[str, random.Random] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("reliability.faults")
 
     # ------------------------------------------------------------ config
     def plan(self, site: str, rate: float = 1.0, kind: str = "raise",

@@ -33,10 +33,10 @@ success closes it, failure re-opens.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..observability.locks import named_lock
 from .faults import FaultInjection
 
 __all__ = ["BreakerBoard", "CircuitBreaker", "RetryPolicy",
@@ -177,7 +177,7 @@ class CircuitBreaker:
         self.state = "closed"            # closed | open | half_open
         self._failures = 0
         self._opened_at = 0.0
-        self._lock = threading.Lock()
+        self._lock = named_lock("reliability.breaker")
 
     def on_success(self) -> None:
         with self._lock:
@@ -227,7 +227,7 @@ class BreakerBoard:
         self._failure_threshold = failure_threshold
         self._cooldown_s = cooldown_s
         self._breakers: Dict[str, CircuitBreaker] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("reliability.breaker_board")
 
     def breaker(self, key: str) -> CircuitBreaker:
         with self._lock:

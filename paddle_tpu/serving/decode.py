@@ -28,12 +28,12 @@ lane's own slot; masked pad columns contribute exact zeros).
 from __future__ import annotations
 
 import math
-import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..base.flags import get_flag
+from ..observability.locks import named_lock
 from ..profiler.pipeline import serving_stats
 from . import kv_cache as kvc
 from .engine import EngineBase
@@ -134,7 +134,7 @@ class DecodePrograms:
         self.warmed: List[tuple] = []
         self.restored: List[tuple] = []
         self._aot: Dict[tuple, object] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.decode.programs")
         try:
             backend = jax.devices()[0].platform
         except Exception:
@@ -346,8 +346,13 @@ class DecodePrograms:
                 raise ValueError(
                     f"swap_params: leaf {i} is {tuple(n.shape)}/{n.dtype}, "
                     f"decode executables expect {tuple(o.shape)}/{o.dtype}")
+        # stage the transfer BEFORE taking the lock (CX1002: a device
+        # transfer under a held lock serializes every other swapper
+        # behind device latency); the flip itself is one reference
+        # assignment under the lock
+        staged = jax.device_put(new_params)
         with self._lock:
-            self.params = jax.device_put(new_params)
+            self.params = staged
         return len(new_leaves)
 
     # -------------------------------------------------------------- calls

@@ -24,7 +24,6 @@ slot-based join/leave) serves through the same front door.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, List, Optional, Sequence, Union
 
@@ -32,6 +31,7 @@ import numpy as np
 
 from ..base.flags import get_flag
 from ..inference import Config, Predictor
+from ..observability.locks import named_lock
 from ..observability.tracing import tracer
 from ..profiler.pipeline import serving_stats
 from ..reliability.faults import fault_point
@@ -57,7 +57,7 @@ class EngineBase:
                  stats=serving_stats):
         self.stats = stats
         self._tenants: Dict[str, object] = {}
-        self._tenant_lock = threading.Lock()
+        self._tenant_lock = named_lock("serving.engine.tenants")
         # per-tenant circuit breakers (ISSUE 14): the scheduler feeds
         # success/failure per served tenant; an open breaker flips the
         # tenant to degraded — /healthz reflects it and admission sheds

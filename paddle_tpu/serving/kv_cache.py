@@ -24,10 +24,11 @@ jitted programs and carry no python state.
 """
 from __future__ import annotations
 
-import threading
 from typing import List, Optional
 
 import numpy as np
+
+from ..observability.locks import named_lock
 
 __all__ = ["KVSlotPool", "write_prompt", "write_prompt_batch",
            "append_token"]
@@ -97,7 +98,7 @@ class KVSlotPool:
         self.v = jnp.zeros(shape, dtype)
         self.lengths = np.zeros(self.max_slots, np.int32)  # host-side
         self._free: List[int] = list(range(self.max_slots - 1, -1, -1))
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.kv_pool")
         self.bytes_at_warmup: Optional[int] = None
         self._gauge_occupancy()
 

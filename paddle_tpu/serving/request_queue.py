@@ -24,6 +24,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability.locks import named_condition, named_lock
+
 
 class AdmissionError(RuntimeError):
     """A submit the admission controller refused: ``reason`` is ``"queue"``
@@ -189,7 +191,7 @@ class AdmissionController:
         # condition), on_complete on the scheduler thread (no queue lock) —
         # the read-modify-writes of _inflight must serialize regardless of
         # which outer lock the caller holds
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.admission")
 
     # ------------------------------------------------------------ tiers
     def set_tier(self, tenant: str, tier) -> None:
@@ -261,7 +263,7 @@ class RequestQueue:
     def __init__(self, admission: Optional[AdmissionController] = None,
                  stats=None):
         self._dq: deque = deque()
-        self._cond = threading.Condition()
+        self._cond = named_condition("serving.queue")
         self.admission = admission or AdmissionController()
         self.closed = False
         if stats is None:

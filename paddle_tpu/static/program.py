@@ -59,7 +59,7 @@ class Program:
         self.feeds: Dict[str, int] = {}        # feed name -> placeholder id
         self.feed_specs: Dict[str, tuple] = {} # feed name -> (shape, dtype)
         self._version = 0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # noqa: CX1003 — static-graph bootstrap: imported before observability exists
 
     # -- recording (installed as hooks.static_capture) ----------------------
     def record(self, name, fn, tensor_args, attrs, outs):

@@ -5,7 +5,7 @@ from __future__ import annotations
 import contextlib
 import threading
 
-_lock = threading.Lock()
+_lock = threading.Lock()  # noqa: CX1003 — name-gen bootstrap: imported before observability exists
 _counters = {}
 _prefix = [""]
 

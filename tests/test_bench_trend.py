@@ -248,3 +248,46 @@ class TestZero1Track:
         verdict = judge(load_trajectory(str(tmp_path), extract=self.PATH),
                         0.20)
         assert verdict["ok"] is True and "single parsed" in verdict["reason"]
+
+
+class TestConcurrencyLintKeys:
+    """ISSUE 16 satellite: extras.lint gains the concurrency family's
+    static-scan wall time and the witness's per-acquire overhead. They
+    are informational (nanosecond noise would flap a 20% gate), NOT in
+    DEFAULT_EXTRAS — the trajectory machinery must extract them when
+    present and tolerate every pre-ISSUE-16 round that lacks them."""
+
+    def _run_with_lint(self, dirpath, n, lint):
+        _write_run(dirpath, n, 20000.0, parsed_override={
+            "metric": DEFAULT_METRIC, "value": 20000.0,
+            "unit": "tokens/sec", "note": "cpu_fallback", "lint": lint})
+
+    def test_new_lint_keys_not_gated_by_default(self):
+        assert "lint.concurrency_family_seconds" not in DEFAULT_EXTRAS
+        assert "lint.witness_overhead_ns_per_acquire" not in DEFAULT_EXTRAS
+
+    def test_keys_extract_as_dotted_paths(self, tmp_path):
+        self._run_with_lint(str(tmp_path), 1, {
+            "concurrency_family_seconds": 1.2,
+            "witness_overhead_ns_per_acquire": 3200.0})
+        self._run_with_lint(str(tmp_path), 2, {
+            "concurrency_family_seconds": 1.1,
+            "witness_overhead_ns_per_acquire": 3100.0})
+        for path, values in (("lint.concurrency_family_seconds", [1.2, 1.1]),
+                             ("lint.witness_overhead_ns_per_acquire",
+                              [3200.0, 3100.0])):
+            rows = load_trajectory(str(tmp_path), extract=path)
+            assert [r["value"] for r in rows] == values
+        assert main(["--dir", str(tmp_path)]) == 0
+
+    def test_history_without_the_keys_stays_ok(self, tmp_path):
+        _write_run(str(tmp_path), 1, 20000.0)
+        self._run_with_lint(str(tmp_path), 2,
+                            {"concurrency_family_seconds": 1.2})
+        rows = load_trajectory(str(tmp_path),
+                               extract="lint.concurrency_family_seconds")
+        assert rows[0]["value"] is None and rows[0]["note"] == "metric absent"
+        verdict = judge(rows, 0.20)
+        assert verdict["ok"] is True
+        # the repo's real history predates the keys entirely
+        assert main(["--dir", REPO_ROOT]) == 0

@@ -227,6 +227,28 @@ def test_fault_hygiene_clean_over_source_tree():
     assert _errors(findings) == []
 
 
+def test_concurrency_clean_over_source_tree():
+    """ISSUE 16: the threaded runtime's lock discipline holds — no
+    unguarded shared mutation across thread entry points (CX1000), no
+    static lock-order cycle (CX1001), no blocking call under a held lock
+    (CX1002), no bare ``threading.Lock()`` outside the named-lock
+    registry (CX1003, bootstrap modules noqa'd with reasons)."""
+    from paddle_tpu.analysis.concurrency_check import check_paths
+
+    findings = check_paths([os.path.join(_REPO, "paddle_tpu")])
+    assert _errors(findings) == []
+
+
+def test_concurrency_demo_green_under_witness():
+    """ISSUE 16: a warmed ServingEngine taking live traffic while a
+    DeviceLoader prefetches, with the runtime lock-order witness lit,
+    records acquisitions across the migrated runtime locks and finds no
+    order inversion (CX1004) and no hold-budget breach (CX1005)."""
+    from paddle_tpu.analysis.concurrency_check import record_demo_concurrency
+
+    assert [str(f) for f in record_demo_concurrency()] == []
+
+
 def test_cli_exits_zero_with_machine_readable_findings(capsys):
     """`tools.lint --json --include-tests` over the repo: exit 0,
     parseable. Run in-process (the tests above already paid the analyzer
@@ -243,7 +265,7 @@ def test_cli_exits_zero_with_machine_readable_findings(capsys):
     assert set(payload["analyzers"]) == {"trace", "registry", "program",
                                          "jaxpr", "spmd", "cost", "serving",
                                          "telemetry", "cache", "comm",
-                                         "fault", "ckpt"}
+                                         "fault", "ckpt", "concurrency"}
     assert isinstance(payload["findings"], list)
     # per-family wall-time (CI satellite): one entry per analyzer run
     assert set(payload["timings_s"]) == set(payload["analyzers"])

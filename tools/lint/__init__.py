@@ -1,6 +1,6 @@
 """``python -m tools.lint`` — the repo's static-analysis driver.
 
-Runs the twelve ``paddle_tpu.analysis`` analyzers and reports findings:
+Runs the thirteen ``paddle_tpu.analysis`` analyzers and reports findings:
 
 - **trace**:    the trace-safety AST linter over ``paddle_tpu/`` (or the
                 paths given on the command line),
@@ -53,6 +53,16 @@ Runs the twelve ``paddle_tpu.analysis`` analyzers and reports findings:
                 through ``load_sharded``): every piece present, byte-
                 and sha256-exact, bounds covering each tensor exactly,
                 no orphan pieces or stale writer tmp dirs.
+- **concurrency**: the threaded runtime's lock discipline (CX10xx) over
+                the same paths as the trace linter plus a lit-witness
+                demo (ServingEngine under traffic + DeviceLoader
+                prefetch): no unguarded shared mutation across thread
+                entry closures, no static lock-order cycle, no blocking
+                call under a held lock, no bare lock outside the
+                ``observability.locks`` registry, and no runtime order
+                inversion / hold-budget breach recorded by the witness.
+                ``--select CX`` is the pre-fleet gate before launching
+                multi-thread serving work.
 
 Exit-code contract (stable, CI-gateable):
   0 = no error-severity findings (warnings never gate)
@@ -75,7 +85,8 @@ import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd", "cost",
-              "serving", "telemetry", "cache", "comm", "fault", "ckpt")
+              "serving", "telemetry", "cache", "comm", "fault", "ckpt",
+              "concurrency")
 
 
 def _source_paths(paths, include_tests=False):
@@ -290,19 +301,36 @@ def _run_ckpt(_paths, include_tests=False):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _run_concurrency(paths, include_tests=False):
+    """CX10xx: static lock discipline over the same source paths as the
+    trace linter (unguarded shared mutation, static lock-order cycles,
+    blocking under a lock, unregistered bare locks) plus the lit-witness
+    demo — one warmed ServingEngine taking traffic while a DeviceLoader
+    prefetches, with ``FLAGS_concurrency_witness`` recording every
+    named-lock acquisition (CX1004 inversions / CX1005 hold budget).
+    Never scans tests/ — concurrency tests seed inversions on purpose."""
+    from paddle_tpu.analysis.concurrency_check import (check_paths,
+                                                       record_demo_concurrency)
+
+    findings = list(record_demo_concurrency())
+    findings.extend(check_paths(_source_paths(paths, include_tests=False)))
+    return findings
+
+
 _RUNNERS = {"trace": _run_trace, "registry": _run_registry,
             "program": _run_program, "jaxpr": _run_jaxpr,
             "spmd": _run_spmd, "cost": _run_cost,
             "serving": _run_serving, "telemetry": _run_telemetry,
             "cache": _run_cache, "comm": _run_comm, "fault": _run_fault,
-            "ckpt": _run_ckpt}
+            "ckpt": _run_ckpt, "concurrency": _run_concurrency}
 
 # analyzer -> its finding-code family prefix, so a crash finding
 # (<PREFIX>999) stays visible under --select filters for that family
 _FAMILY_PREFIX = {"trace": "TS", "registry": "RC", "program": "PV",
                   "jaxpr": "JX", "spmd": "SP", "cost": "CM",
                   "serving": "JX", "telemetry": "OB", "cache": "CC",
-                  "comm": "QZ", "fault": "FT", "ckpt": "CK"}
+                  "comm": "QZ", "fault": "FT", "ckpt": "CK",
+                  "concurrency": "CX"}
 
 
 def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
