@@ -239,7 +239,10 @@ def _lint_bench(step):
     overhead, lit vs dark (interleaved best-of-2, the same protocol as
     extras.telemetry — the dark number is the tax EVERY runtime lock
     pays after the named_lock migration, so it must stay at one bool
-    read)."""
+    read). ISSUE 17 adds the numerics family's static-scan cost and the
+    NaN/range witness's per-watch overhead on the same lit-vs-dark
+    protocol (dark must stay at one bool read — watch() sits on the
+    TrainStep/GradScaler hot paths)."""
     from tools.lint import run_analyzers
 
     t0 = time.perf_counter()
@@ -252,6 +255,13 @@ def _lint_bench(step):
         [os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "paddle_tpu")])
     cx_s = time.perf_counter() - t0
+    from paddle_tpu.analysis.numerics_check import check_paths as nm_paths
+
+    t0 = time.perf_counter()
+    nm_findings = nm_paths(
+        [os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "paddle_tpu")])
+    nm_s = time.perf_counter() - t0
     builds_before = sum(step._compiled._compile_counts.values())
     t0 = time.perf_counter()
     report = step.audit_report()
@@ -263,12 +273,15 @@ def _lint_bench(step):
         "lint_crashed": crashed,
         "concurrency_family_seconds": round(cx_s, 3),
         "concurrency_findings": len(cx_findings),
+        "numerics_family_seconds": round(nm_s, 3),
+        "numerics_findings": len(nm_findings),
         "audit_report_us": round(report_us, 1),
         "audit_builds_delta": (sum(step._compiled._compile_counts.values())
                                - builds_before),
         "cache_keys": report["n_cache_keys"],
     }
     out.update(_witness_overhead_bench())
+    out.update(_numerics_witness_overhead_bench())
     return out
 
 
@@ -304,6 +317,43 @@ def _witness_overhead_bench(n=20000, reps=2):
         "witness_overhead_ns_per_acquire": round(lit - dark, 1),
         "witness_dark_ns_per_acquire": round(dark, 1),
         "witness_lit_ns_per_acquire": round(lit, 1),
+    }
+
+
+def _numerics_witness_overhead_bench(n=20000, reps=2):
+    """Per-watch cost of the numerics witness, dark vs lit (informational,
+    not trend-gated). Same interleaved best-of-``reps`` protocol as the
+    lock-witness bench. The dark number is the tax every watch site
+    (TrainStep loss, GradScaler grads, KV commits) pays when the flag is
+    off — one bool read, same budget class as the lock witness's dark
+    acquire."""
+    import numpy as np
+
+    from paddle_tpu.observability import numerics as num
+
+    probe = np.ones(64, np.float32)
+
+    def drive():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            num.watch("bench.numerics_probe", probe)
+        return (time.perf_counter() - t0) / n * 1e9
+
+    was = num.set_witness(False)
+    try:
+        dark = lit = float("inf")
+        for _ in range(reps):
+            num.set_witness(False)
+            dark = min(dark, drive())
+            num.set_witness(True)
+            lit = min(lit, drive())
+    finally:
+        num.set_witness(was)
+        num.witness_reset()
+    return {
+        "numerics_witness_overhead_ns_per_check": round(lit - dark, 1),
+        "numerics_witness_dark_ns_per_check": round(dark, 1),
+        "numerics_witness_lit_ns_per_check": round(lit, 1),
     }
 
 

@@ -33,6 +33,25 @@ import numpy as np
 from ..base.log import get_logger
 from ..core import hooks
 
+# numpy can't parse extension dtypes (ml_dtypes bfloat16) by name: map
+# them to the numpy float we scan their host copy as, explicitly —
+# string surgery on dtype names is an NM1100 finding
+_HOST_SCAN_DTYPES = {"bfloat16": np.dtype(np.float32),
+                     "float8_e4m3fn": np.dtype(np.float32),
+                     "float8_e5m2": np.dtype(np.float32)}
+
+
+def _is_float_dtype(dtype) -> bool:
+    """Is ``dtype`` a floating dtype worth nan/inf-scanning (including
+    the extension floats numpy only knows through the map above)?"""
+    np_dtype = _HOST_SCAN_DTYPES.get(str(dtype))
+    if np_dtype is None:
+        try:
+            np_dtype = np.dtype(dtype)
+        except TypeError:
+            return False
+    return np.issubdtype(np_dtype, np.floating)
+
 
 class DebugMode(Enum):
     """reference amp/debugging.py DebugMode (the subset that applies off-GPU)."""
@@ -93,9 +112,7 @@ class _TensorChecker:
         serial = self._op_serial.get(name, 0)
         self._op_serial[name] = serial + 1
         for idx, v in enumerate(values):
-            if not hasattr(v, "dtype") or not np.issubdtype(
-                    np.dtype(str(v.dtype).replace("bfloat16", "float32")),
-                    np.floating):
+            if not hasattr(v, "dtype") or not _is_float_dtype(v.dtype):
                 continue
             arr = np.asarray(v, dtype=np.float32)
             num_nan = int(np.isnan(arr).sum())

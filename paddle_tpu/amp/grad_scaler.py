@@ -56,14 +56,27 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable or self._already_unscaled:
             return
+        from ..observability import numerics
+        from ..reliability.faults import fault_point
+
+        # chaos site: a "corrupt" plan poisons the first grad with NaN —
+        # the finite check below must trip, found_inf must set, and
+        # step() must revert the optimizer cells (the documented cleanup)
+        poison = fault_point("numerics.nonfinite_grad") == "corrupt"
         inv = 1.0 / self._scale._value
         found = jnp.asarray(False)
         for p in optimizer._parameter_list:
             if p._grad is None:
                 continue
             g = p._grad._value * inv
+            if poison:
+                g = jnp.full_like(g, jnp.nan)
+                poison = False
             found = found | ~jnp.all(jnp.isfinite(g))
             p._grad._replace_value(g)
+            # NaN/Inf + range sentinel on the unscaled grad (one bool
+            # read when the numerics witness is dark; skipped on tracers)
+            numerics.watch("amp.unscaled_grad", g)
         self._found_inf._replace_value(found)
         self._already_unscaled = True
 

@@ -48,6 +48,13 @@ analyzers that run at commit time:
   held lock, no bare ``threading.Lock()`` outside the named-lock
   registry; plus the runtime lock-order witness
   (``observability/locks.py``, CX1004 inversions / CX1005 hold budget).
+- :mod:`numerics_check` — the mixed-precision discipline (NM11xx): no
+  dtype identity built by string surgery, no hardcoded fp32 cast inside
+  AMP white-listed ops, no float64 into jnp calls; dtype-flow audit of
+  retraced programs (narrow dot accumulation, oversized bf16
+  reductions, int-to-narrow dequant epilogues), fp16-without-scaler and
+  degenerate-quantizer object audits; plus the runtime NaN/Inf +
+  dynamic-range witness (``observability/numerics.py``, NM1104/NM1105).
 
 One CLI drives them all: ``python -m tools.lint`` (exit 1 on any
 error-severity finding, 2 on an analyzer crash; ``--json`` for
@@ -63,10 +70,13 @@ __all__ = [
     "audit_fault_injector",
     "audit_jaxpr",
     "audit_kernel_cache",
+    "audit_numerics_witness",
     "audit_telemetry",
     "audit_witness",
     "check_concurrency_paths",
     "check_concurrency_source",
+    "check_numerics_paths",
+    "check_numerics_source",
     "check_cost",
     "check_fault_paths",
     "check_fault_source",
@@ -262,5 +272,23 @@ def check_concurrency_source(source, filename="<string>"):
 
 def audit_witness():
     from .concurrency_check import audit_witness as _impl
+
+    return _impl()
+
+
+def check_numerics_paths(paths):
+    from .numerics_check import check_paths as _impl
+
+    return _impl(paths)
+
+
+def check_numerics_source(source, filename="<string>"):
+    from .numerics_check import check_source as _impl
+
+    return _impl(source, filename)
+
+
+def audit_numerics_witness():
+    from .numerics_check import audit_witness as _impl
 
     return _impl()

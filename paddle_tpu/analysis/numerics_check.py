@@ -1,0 +1,420 @@
+"""Numerics discipline checker (NM11xx): the mixed-precision gate.
+
+The stack trains in bf16 (``amp/``), keeps int8 ZeRO-1 shards with fp32
+masters, quantizes gradient collectives on the wire, and ships int8
+PTQ/QAT — precision bugs in that stack are *silent*: a bf16 reduction
+quietly loses its small addends, an un-loss-scaled fp16 run flushes
+grads to zero, an uncalibrated quantizer collapses activations, and no
+exception ever fires. This module is the static + program-audit half of
+the ``numerics`` family of ``python -m tools.lint`` (the runtime half is
+``observability/numerics.py``):
+
+NM1100  dtype string surgery       dtype identity built by string
+                                   replacement between dtype-name
+                                   literals (``str(dtype).replace(
+                                   "bfloat16", "float32")``) — map the
+                                   dtypes explicitly (error)
+NM1101  fp32 cast in AMP op        a hardcoded float32 ``astype``/
+                                   ``cast`` inside a function named on
+                                   the AMP white list — it silently
+                                   defeats the bf16 compute AMP just
+                                   arranged (accumulate wide via
+                                   ``preferred_element_type`` instead)
+                                   (error)
+NM1102  float64 into traced code   a float64 dtype literal handed to a
+                                   ``jnp.``/``jax.numpy`` call — with
+                                   x64 disabled jax silently truncates
+                                   it to float32; with x64 enabled it
+                                   doubles the op's bytes (error)
+NM1103  narrow dot accumulation    *jaxpr*: a dot/conv whose narrow-
+                                   float (bf16/fp16) operands accumulate
+                                   in the same narrow dtype — no wide
+                                   ``preferred_element_type`` (error)
+NM1106  narrow large reduction     *jaxpr*: a bf16/fp16 ``reduce_sum``
+                                   whose reduced extent exceeds
+                                   ``FLAGS_numerics_bf16_reduce_limit``
+                                   elements (error)
+NM1107  fp16 without live scaler   a graph computing in float16 paired
+                                   with a GradScaler that resolved to
+                                   the no-op identity (``enable=False``)
+                                   — fp16's range needs loss scaling
+                                   (error)
+NM1108  int-to-narrow dequant      *jaxpr*: ``convert_element_type``
+                                   straight from int8/uint8 to bf16/fp16
+                                   — the dequant epilogue must widen to
+                                   fp32 before applying scales (error)
+NM1109  degenerate quant scale     a quantizer whose calibrated scale is
+                                   zero / non-finite (empty or
+                                   degenerate calibration range) (error)
+NM1104  non-finite value           *runtime*: the lit witness saw NaN/
+                                   Inf at a watch site (error)
+NM1105  dynamic-range collapse     *runtime*: a watched tensor's max-abs
+                                   fell below its rolling watermark by
+                                   ``FLAGS_numerics_collapse_ratio``
+                                   (error)
+
+Shared ``# noqa: NM11xx`` grammar with the other source linters.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from . import Finding
+
+_ANALYZER = "numerics"
+
+_DTYPE_NAMES = frozenset({
+    "bfloat16", "float16", "float32", "float64", "int8", "uint8",
+    "int16", "int32", "int64", "complex64", "complex128", "bool"})
+_NARROW_FLOATS = frozenset({"bfloat16", "float16"})
+_INT_WIRE = frozenset({"int8", "uint8"})
+# reductions where narrow-float accumulation order/width matters;
+# reduce_max/min are order-insensitive and stay exact in any width
+_ACCUM_REDUCES = frozenset({"reduce_sum", "cumsum", "add_any"})
+_DOT_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+
+
+def _amp_white_list() -> frozenset:
+    try:
+        from ..amp.amp_lists import WHITE_LIST
+
+        return frozenset(WHITE_LIST)
+    except Exception:  # pragma: no cover - amp always importable in-tree
+        return frozenset({"matmul", "mm", "bmm", "addmm", "linear",
+                          "einsum", "conv1d", "conv2d", "conv3d"})
+
+
+def _bf16_reduce_limit() -> int:
+    try:
+        from ..base.flags import get_flag
+
+        return int(get_flag("numerics_bf16_reduce_limit"))
+    except Exception:
+        return 4096
+
+
+# ------------------------------------------------------------------ AST
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse covers our python floor
+        return ""
+
+
+def _dtype_literal(node: ast.AST) -> str:
+    """The dtype name a literal expression denotes: ``"float64"`` /
+    ``np.float64`` / ``jnp.float64`` -> ``float64``; anything else
+    (variables, ``a.dtype``) -> ``""``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _DTYPE_NAMES else ""
+    tail = ""
+    if isinstance(node, ast.Attribute):
+        tail = node.attr
+    elif isinstance(node, ast.Name):
+        tail = node.id
+    return tail if tail in _DTYPE_NAMES else ""
+
+
+def _is_jnp_call(node: ast.Call) -> bool:
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return False
+    recv = fn.value
+    if isinstance(recv, ast.Name):
+        return recv.id in ("jnp", "jax_numpy")
+    if isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name):
+        return recv.value.id == "jax" and recv.attr == "numpy"
+    return False
+
+
+class _NmVisitor(ast.NodeVisitor):
+    """Single pass collecting NM1100 (dtype string surgery), NM1101
+    (hardcoded fp32 cast inside an AMP white-listed op) and NM1102
+    (float64 literals handed to jnp calls)."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.findings: List[Finding] = []
+        self._fn_stack: List[str] = []
+        self._white = _amp_white_list()
+
+    def _flag(self, code: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            _ANALYZER, code, "error", message,
+            f"{self.filename}:{getattr(node, 'lineno', 0)}"))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _in_amp_op(self) -> bool:
+        return any(name in self._white for name in self._fn_stack)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        # NM1100: dtype identity via .replace("bfloat16", "float32")
+        if isinstance(fn, ast.Attribute) and fn.attr == "replace" and \
+                len(node.args) == 2 and \
+                all(isinstance(a, ast.Constant) and a.value in _DTYPE_NAMES
+                    for a in node.args):
+            self._flag(
+                "NM1100", node,
+                f"dtype rewritten by string surgery "
+                f"({_expr_text(node)!r}) — a renamed or aliased dtype "
+                "slips through silently; use an explicit dtype map")
+        # NM1101: hardcoded fp32 cast inside an AMP white-listed op
+        if isinstance(fn, ast.Attribute) and fn.attr == "astype" and \
+                node.args and _dtype_literal(node.args[0]) == "float32" and \
+                self._in_amp_op():
+            self._flag(
+                "NM1101", node,
+                f"hardcoded float32 astype inside AMP white-listed op "
+                f"{'.'.join(self._fn_stack)!r} — it silently undoes the "
+                "bf16 compute AMP arranged; accumulate wide with "
+                "preferred_element_type and cast back to the input dtype")
+        # NM1102: float64 literal into a jnp call
+        if _is_jnp_call(node):
+            f64 = [a for a in list(node.args)
+                   + [kw.value for kw in node.keywords]
+                   if _dtype_literal(a) == "float64"]
+            if f64:
+                self._flag(
+                    "NM1102", node,
+                    f"float64 dtype handed to {_expr_text(node.func)}() — "
+                    "jax truncates it to float32 silently (x64 disabled) "
+                    "or doubles the op's bytes (x64 enabled); pick an "
+                    "explicit float32/bfloat16")
+        self.generic_visit(node)
+
+
+def check_source(source: str, filename: str = "<string>") -> List[Finding]:
+    """NM1100/NM1101/NM1102 over one file, with the shared noqa
+    grammar."""
+    from .trace_safety import _apply_noqa
+
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Finding(_ANALYZER, "NM999", "error",
+                        f"could not parse {filename}: {e}", filename)]
+    visitor = _NmVisitor(filename)
+    visitor.visit(tree)
+    return _apply_noqa(visitor.findings, source)
+
+
+def check_paths(paths: Sequence[str]) -> List[Finding]:
+    """The static AST rules over every ``.py`` under ``paths``."""
+    from . import iter_py_files
+
+    findings: List[Finding] = []
+    for f in iter_py_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            findings.extend(check_source(fh.read(), f))
+    return findings
+
+
+# ---------------------------------------------------------- jaxpr audit
+def audit_jaxpr_numerics(closed_jaxpr, *, location: str = "") -> List[Finding]:
+    """Dtype-flow audit of one ClosedJaxpr (NM1103/NM1106/NM1108):
+    narrow-float dot accumulation, narrow large-extent reductions,
+    int-to-narrow dequant epilogues."""
+    from .jaxpr_audit import _aval_dtype, _aval_shape, _iter_jaxprs
+
+    findings: List[Finding] = []
+    limit = _bf16_reduce_limit()
+    for j in _iter_jaxprs(closed_jaxpr.jaxpr):
+        for eqn in j.eqns:
+            prim = eqn.primitive.name
+            if prim in _DOT_PRIMS:
+                in_dts = {_aval_dtype(v) for v in eqn.invars}
+                out_dt = _aval_dtype(eqn.outvars[0])
+                narrow = in_dts & _NARROW_FLOATS
+                if narrow and out_dt in narrow:
+                    findings.append(Finding(
+                        _ANALYZER, "NM1103", "error",
+                        f"{prim} accumulates {out_dt} operands in "
+                        f"{out_dt} — the contraction sums partial "
+                        "products in 8-bit-mantissa precision; pass "
+                        "preferred_element_type=float32 and cast the "
+                        "result back", location or "jaxpr"))
+            elif prim in _ACCUM_REDUCES and eqn.invars:
+                op = eqn.invars[0]
+                dt = _aval_dtype(op)
+                if dt in _NARROW_FLOATS and limit > 0:
+                    shape = _aval_shape(op)
+                    axes = eqn.params.get("axes", None)
+                    if axes is None:
+                        axes = range(len(shape))
+                    extent = 1
+                    for ax in axes:
+                        if 0 <= int(ax) < len(shape):
+                            extent *= int(shape[int(ax)])
+                    if extent > limit:
+                        findings.append(Finding(
+                            _ANALYZER, "NM1106", "error",
+                            f"{prim} reduces {extent} {dt} elements "
+                            f"(> FLAGS_numerics_bf16_reduce_limit="
+                            f"{limit}) — addends below the running "
+                            "sum's ulp vanish; accumulate in float32 "
+                            "and cast back", location or "jaxpr"))
+            elif prim == "convert_element_type":
+                src = _aval_dtype(eqn.invars[0])
+                dst = str(eqn.params.get("new_dtype",
+                                         _aval_dtype(eqn.outvars[0])))
+                if src in _INT_WIRE and dst in _NARROW_FLOATS:
+                    findings.append(Finding(
+                        _ANALYZER, "NM1108", "error",
+                        f"convert_element_type {src} -> {dst}: a "
+                        "quantized payload dequantized straight into a "
+                        "narrow float — the scale multiply then rounds "
+                        "in 8-bit mantissa; widen to float32 first",
+                        location or "jaxpr"))
+    return findings
+
+
+def audit_step_numerics(step) -> List[Finding]:
+    """Retrace every cached program of a TrainStep / CompiledFunction
+    and run the dtype-flow audit over each (trace only, no
+    compilation). Entries the jaxpr family already reports as
+    unretraceable (JX300) are skipped here — one finding per defect."""
+    from .jaxpr_audit import RetraceError, retrace_entry
+
+    cf = getattr(step, "_compiled", step)
+    findings: List[Finding] = []
+    name = getattr(cf, "name", "fn")
+    for idx, entry in enumerate(list(cf._cache.values())):
+        subs = ([(f"guards={k}", s) for k, s in entry["entries"].items()]
+                if entry.get("guarded") and not entry.get("eager")
+                else [("", entry)] if not entry.get("eager") else [])
+        for tag, sub in subs:
+            loc = f"{name}[{idx}]" + (f":{tag}" if tag else "")
+            try:
+                closed, _n_outs, _n_cells = retrace_entry(sub)
+            except RetraceError:
+                continue
+            findings.extend(audit_jaxpr_numerics(closed, location=loc))
+    return findings
+
+
+# --------------------------------------------------------- object audits
+def audit_scaler(scaler, graph_dtypes, location: str = "amp") -> List[Finding]:
+    """NM1107: a float16 graph whose GradScaler resolved to the no-op
+    identity — fp16 overflows at 65504 and flushes grads below ~6e-5,
+    so an identity scaler means silent zero/inf gradients."""
+    dtypes = {str(d) for d in graph_dtypes}
+    if "float16" not in dtypes:
+        return []
+    if scaler is not None and getattr(scaler, "_enable", False):
+        return []
+    why = ("no GradScaler at all" if scaler is None
+           else "GradScaler(enable=False) — the identity pass-through")
+    return [Finding(
+        _ANALYZER, "NM1107", "error",
+        f"float16 compute with {why}: fp16's 5-bit exponent needs "
+        "dynamic loss scaling (GradScaler(enable=True)) or the grads "
+        "underflow/overflow silently", location)]
+
+
+def audit_quanter(quanter, location: str = "quant") -> List[Finding]:
+    """NM1109: a quantizer whose calibrated scale is zero or non-finite
+    — an empty/degenerate calibration range that would collapse every
+    activation it fake-quantizes."""
+    import numpy as np
+
+    scale = getattr(quanter, "scale", None)
+    if scale is None:
+        return []
+    try:
+        vals = np.asarray(getattr(scale, "_value", scale), np.float64)
+    except Exception:
+        return []
+    if vals.size and np.isfinite(vals).all() and (vals > 0).all():
+        return []
+    name = type(quanter).__name__
+    return [Finding(
+        _ANALYZER, "NM1109", "error",
+        f"{name} scale is {vals.tolist()} — an empty/degenerate "
+        "calibration range (observer never saw data, or saw all "
+        "zeros); fake-quant through it collapses activations to the "
+        "clamp floor. Calibrate before freezing, or pass the input "
+        "through unquantized on a degenerate scale", location)]
+
+
+# ------------------------------------------------------------- runtime
+def audit_witness() -> List[Finding]:
+    """NM1104/NM1105 over the live process witness: every verdict the
+    lit witness has recorded becomes an error finding."""
+    from ..observability import numerics
+
+    findings: List[Finding] = []
+    for v in numerics.witness_violations():
+        if v["code"] == "NM1104":
+            findings.append(Finding(
+                _ANALYZER, "NM1104", "error",
+                f"non-finite value at watch site {v['name']!r} "
+                f"(finite max-abs {v.get('max_abs_finite')}, thread "
+                f"{v.get('thread', '?')})", "witness"))
+        else:
+            findings.append(Finding(
+                _ANALYZER, "NM1105", "error",
+                f"dynamic range collapsed at watch site {v['name']!r}: "
+                f"max-abs {v.get('max_abs')} vs watermark "
+                f"{v.get('watermark')} (ratio limit {v.get('ratio')}, "
+                f"underflow fraction {v.get('underflow_frac')})",
+                "witness"))
+    return findings
+
+
+# ----------------------------------------------------------------- demo
+def record_demo_numerics(step=None) -> List[Finding]:
+    """The representative numerics session: dtype-flow audit over the
+    shared demo TrainStep's cached programs, a traced bf16 matmul
+    through the ops-layer accumulation helper (the AMP-shaped graph
+    must accumulate wide), and a short lit-witness run over healthy
+    tensors. Returns the findings (none, on a healthy tree) — and
+    errors loudly if the lit witness recorded ZERO checks, which would
+    mean the watch sites went dead (a silently dead witness must not
+    pass the gate)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..observability import numerics as num
+
+    if step is None:
+        from .jaxpr_audit import record_demo_step
+
+        step = record_demo_step()
+    findings = audit_step_numerics(step)
+
+    # the bf16 program AMP produces through the ops layer: clean only
+    # because matmul accumulates wide (preferred_element_type)
+    from ..ops.math import _accum_matmul
+
+    sds = jax.ShapeDtypeStruct((8, 8), jnp.bfloat16)
+    closed = jax.make_jaxpr(_accum_matmul)(sds, sds)
+    findings += audit_jaxpr_numerics(closed, location="demo_bf16_matmul")
+
+    baseline_violations = len(num.witness_violations())
+    before = num.witness_stats()["checks"]
+    was = num.set_witness(True)
+    try:
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            num.watch("demo.loss", np.abs(rng.randn(4)) + 0.5)
+    finally:
+        num.set_witness(was)
+    findings += audit_witness()[baseline_violations:]
+    after = num.witness_stats()["checks"]
+    if after <= before:
+        findings.append(Finding(
+            _ANALYZER, "NM1104", "error",
+            "the lit witness recorded ZERO checks across the demo "
+            "watch loop — watch() went dead (flag plumbing or the "
+            "early-return regressed), so NaN/range detection is "
+            "silently off", "witness"))
+    return findings

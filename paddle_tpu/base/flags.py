@@ -339,6 +339,27 @@ define_flag("concurrency_max_hold_ms", 0.0,
             "records a CX1005 violation (blocking work is living under a "
             "lock); <=0 disables the hold-time watcher — compile/warmup "
             "phases legitimately hold program locks for seconds")
+define_flag("numerics_witness", False,
+            "numerics lint family (observability/numerics.py): arm the "
+            "runtime NaN/Inf + dynamic-range witness — every watch() "
+            "site (loss, unscaled grads, zero1 updates, quantized comm, "
+            "KV commits) checks finiteness and tracks a per-name max-abs "
+            "watermark + underflow fraction; a non-finite value is an "
+            "NM1104 verdict, a range collapse vs the rolling watermark "
+            "is NM1105, both fed to the anomaly flight recorder. Off "
+            "(the default) = one bool read per watch site, zero work")
+define_flag("numerics_bf16_reduce_limit", 4096,
+            "numerics lint (NM1106): a bf16/fp16 reduction whose reduced "
+            "extent exceeds this element count is flagged — bf16 has 8 "
+            "mantissa bits, so summing >~2^12 same-sign terms loses the "
+            "small addends entirely; widen to fp32 for the accumulation "
+            "(preferred_element_type) and cast back. <=0 disables")
+define_flag("numerics_collapse_ratio", 1e-4,
+            "numerics witness (NM1105): once a watched tensor's max-abs "
+            "watermark is established, a later sample whose max-abs "
+            "falls below watermark*ratio records a range-collapse "
+            "verdict (grads flushed to zero, a dead quantizer scale, an "
+            "underflowed loss). <=0 disables the collapse watcher")
 define_flag("cost_max_guard_preds", 8,
             "cost-model lint (CM505): a speculative branch family "
             "verifying more guard predicates than this per call is "

@@ -167,7 +167,13 @@ def qpsum_reference(stacked, block: Optional[int] = None):
     s2 = s2.reshape(n, cb)
     # "all_gather" is a no-op here: every chunk is already present
     out = dequantize_blockwise(q2, s2)[:numel].reshape(shape)
-    return out.astype(stacked.dtype)
+    out = out.astype(stacked.dtype)
+    # NaN/Inf + range sentinel on the dequantized sum (one bool read
+    # when the numerics witness is dark; skipped under a trace)
+    from ...observability import numerics
+
+    numerics.watch("comm.qpsum", out)
+    return out
 
 
 # --------------------------------------------------------------- GSPMD tier
@@ -209,7 +215,13 @@ def dp_sync_gspmd(value, jmesh, axis: str = "dp",
     scales = jax.lax.with_sharding_constraint(
         scales, NamedSharding(jmesh, P()))
     out = (q.astype(jnp.float32) * scales[..., None]).reshape(-1)
-    return out[:numel].reshape(shape).astype(dtype)
+    out = out[:numel].reshape(shape).astype(dtype)
+    # inside a compiled TrainStep this is a tracer and the witness skips
+    # it; the site still observes eager/oracle-driven syncs when lit
+    from ...observability import numerics
+
+    numerics.watch("comm.dp_sync", out)
+    return out
 
 
 # --------------------------------------------------------------- accounting

@@ -431,6 +431,12 @@ class Zero1Strategy:
             full = jax.lax.with_sharding_constraint(new_shard, rep_sp)
         out = full[:row.numel].reshape(p._value.shape)
         p._replace_value(out.astype(p._value.dtype))
+        # NaN/Inf + range sentinel on the gathered update (one bool read
+        # when dark; inside the compiled TrainStep the value is a tracer
+        # and the lit witness skips it — eager optimizer paths observe)
+        from ...observability import numerics
+
+        numerics.watch("zero1.update", p._value)
 
         _tick("zero1_params")
         ring = (n - 1) / n

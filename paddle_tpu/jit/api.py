@@ -187,11 +187,13 @@ class TrainStep:
 
             self._lr_cell._replace_value(jnp.asarray(lr, jnp.float32))
             self._lr_host = lr
+        from ..observability import numerics
         from ..observability.anomaly import monitor
         from ..observability.tracing import tracer
 
-        if not (tracer.enabled or monitor.enabled):
-            # both telemetry surfaces dark: two attribute reads, no clock
+        if not (tracer.enabled or monitor.enabled
+                or numerics._enabled):
+            # all telemetry surfaces dark: three attribute reads, no clock
             return self._compiled(*batch)
         # snapshot once: the clock is only read for the monitor (tracer-only
         # mode stays clock-free here — the span stamps its own), and a flag
@@ -208,6 +210,10 @@ class TrainStep:
             # detector sees the host-side dispatch wall (a retrace or a
             # blocking sync shows up here orders of magnitude over median)
             monitor.on_step(time.perf_counter() - t0)
+        # NaN/Inf + dynamic-range sentinel on the step's loss (one bool
+        # read when the numerics witness is dark)
+        numerics.watch("train.loss", out[0] if isinstance(out, (tuple, list))
+                       and out else out)
         return out
 
     @property

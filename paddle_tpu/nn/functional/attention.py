@@ -68,7 +68,7 @@ def flash_attention(
                 s, t = logits.shape[-2], logits.shape[-1]
                 mask = jnp.tril(jnp.ones((s, t), bool), t - s)
                 logits = jnp.where(mask, logits, -1e30)
-            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)  # noqa: NM1101 — widening for softmax stability, cast back after
             p = probs
             if dropout > 0.0 and training and dkey is not None:
                 keep = jax.random.bernoulli(dkey, 1.0 - dropout, p.shape)

@@ -98,6 +98,10 @@ try:
 
     _on_flag_change("concurrency_witness",
                     lambda v: _set_witness(bool(v)))
+    from .numerics import set_witness as _set_num_witness
+
+    _on_flag_change("numerics_witness",
+                    lambda v: _set_num_witness(bool(v)))
 except Exception:
     pass
 

@@ -25,6 +25,9 @@ compile_cache          ``compile_cache.stats()`` (persistent AOT store:
 concurrency            ``observability.locks.witness_stats()`` (named-lock
                        registry size, witness acquires/contended/hold_ms,
                        order-graph edges, CX1004/CX1005 violation counts)
+numerics               ``observability.numerics.witness_stats()`` (watched
+                       tensor count, checks, NM1104 non-finite / NM1105
+                       range-collapse violation counts)
 ====================== ====================================================
 
 Registered once at ``paddle_tpu.observability`` import; every import in
@@ -77,6 +80,12 @@ def _collect_concurrency() -> dict:
     return witness_stats()
 
 
+def _collect_numerics() -> dict:
+    from .numerics import witness_stats
+
+    return witness_stats()
+
+
 def _collect_compile_cache() -> dict:
     from ..compile_cache import stats
 
@@ -92,3 +101,4 @@ def register_default_collectors(reg: MetricsRegistry = registry) -> None:
     reg.register_collector("jit.compile", _collect_compile)
     reg.register_collector("compile_cache", _collect_compile_cache)
     reg.register_collector("concurrency", _collect_concurrency)
+    reg.register_collector("numerics", _collect_numerics)

@@ -344,6 +344,16 @@ class AnomalyMonitor:
         v["kind"] = "lock_inversion"
         return self._trigger(v, None)
 
+    def on_numerics(self, verdict: dict) -> Optional[str]:
+        """Numerics witness verdict (observability/numerics.py, NM1104
+        non-finite / NM1105 range collapse): always a trigger — the
+        witness being lit is the opt-in, so this feed does not also
+        gate on ``enabled``. The per-kind cooldown bounds a NaN storm
+        (every subsequent step is non-finite too) to one bundle."""
+        v = dict(verdict)
+        v["kind"] = "numerics"
+        return self._trigger(v, None)
+
     def on_exception(self, where: str, exc: BaseException) -> Optional[str]:
         """Uncaught train-loop / serving-worker exception: always a
         trigger (rate-limited like the detectors); the bundle is the

@@ -64,13 +64,27 @@ def divide_no_nan(x, y, name=None):
     return primitive("divide_no_nan", lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)), [x, y])
 
 
+_NARROW_FLOATS = (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16))
+
+
+def _accum_matmul(a, b):
+    """matmul that never accumulates in a narrow float: bf16/fp16
+    operands contract with a float32 accumulator (the MXU's native
+    mode) and cast back, so AMP's bf16 cast costs mantissa only on the
+    wire, not in the reduction (NM1103)."""
+    if a.dtype in _NARROW_FLOATS or b.dtype in _NARROW_FLOATS:
+        return jnp.matmul(
+            a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    return jnp.matmul(a, b)
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     def fn(a, b):
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:
             b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-        return jnp.matmul(a, b)
+        return _accum_matmul(a, b)
 
     return primitive("matmul", fn, [x, y])
 
@@ -84,12 +98,18 @@ def bmm(x, y, name=None):
 
 
 def dot(x, y, name=None):
-    return primitive("dot", lambda a, b: jnp.sum(a * b, axis=-1), [x, y])
+    def fn(a, b):
+        if a.dtype in _NARROW_FLOATS:
+            return jnp.sum((a * b).astype(jnp.float32),
+                           axis=-1).astype(a.dtype)
+        return jnp.sum(a * b, axis=-1)
+
+    return primitive("dot", fn, [x, y])
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
     return primitive(
-        "addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), [input, x, y]
+        "addmm", lambda i, a, b: beta * i + alpha * _accum_matmul(a, b), [input, x, y]
     )
 
 

@@ -16,6 +16,10 @@ def _fake_quant(x, scale, bits):
     qmax = float(2 ** (bits - 1) - 1)
     s = jnp.maximum(scale, 1e-9)
     q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    # a degenerate scale (uncalibrated observer, all-zero calibration
+    # range) must pass the activation through untouched — quantizing
+    # against it collapses every value to ±1e-9 (NM1109)
+    q = jnp.where(scale > 0.0, q, x)
     # STE: forward quantized value, backward identity
     return x + jax.lax.stop_gradient(q - x)
 

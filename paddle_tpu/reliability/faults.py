@@ -77,6 +77,12 @@ SITES: Dict[str, str] = {
         "simulated hung collective: the watchdog backdate fires the "
         "timeout handler + an anomaly forensic bundle; the task is "
         "reported once and dropped"),
+    "numerics.nonfinite_grad": (
+        "GradScaler.unscale_ poisons one grad with NaN: the finite "
+        "check trips, found_inf sets, step() reverts every optimizer "
+        "cell and update() backs the scale off — the poisoned step is "
+        "skipped and training continues (the lit numerics witness also "
+        "records an NM1104 verdict + flight-recorder bundle)"),
 }
 
 

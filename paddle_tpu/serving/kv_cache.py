@@ -161,6 +161,12 @@ class KVSlotPool:
                 f"{new_k.shape}/{new_k.dtype}")
         self.k = new_k
         self.v = new_v
+        # NaN/Inf sentinel on the committed keys (one bool read when the
+        # numerics witness is dark; a poisoned decode step shows up here
+        # before it contaminates every later token)
+        from ..observability import numerics
+
+        numerics.watch("serving.kv_commit", new_k)
 
     def device_bytes(self) -> int:
         return int(self.k.nbytes) + int(self.v.nbytes)

@@ -1,13 +1,14 @@
 """CI gate: the repo itself passes its own static analysis.
 
-Runs all twelve ``paddle_tpu.analysis`` analyzer families over the live
+Runs all fourteen ``paddle_tpu.analysis`` analyzer families over the live
 codebase and asserts ZERO error-severity findings, so a regression (a new
 jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug,
 a host callback in a compiled step, a typo'd mesh axis, a cost-model
 budget blowout, a serving-tier steady-state recompile, a leaked telemetry
 span, a sync inside a memory sampler, a non-hermetic persistent-cache
-entry, an armed fault injector / undeclared fault site or a sharded
-checkpoint whose manifest stopped holding its pieces) fails tier-1
+entry, an armed fault injector / undeclared fault site, a sharded
+checkpoint whose manifest stopped holding its pieces or a narrow-float
+accumulation / dtype-surgery numerics hazard) fails tier-1
 instead of rotting until pod scale. The
 ``python -m tools.lint`` CLI contract (exit 0, machine-readable JSON
 with per-family wall-time, ``--include-tests``) is gated here too.
@@ -249,6 +250,27 @@ def test_concurrency_demo_green_under_witness():
     assert [str(f) for f in record_demo_concurrency()] == []
 
 
+def test_numerics_clean_over_source_tree():
+    """ISSUE 17: paddle_tpu/ is NM-clean — no dtype string surgery, no
+    hardcoded fp32 cast inside an AMP white-listed op, no float64
+    handed to a jnp call (deliberate widenings carry a reasoned
+    noqa)."""
+    from paddle_tpu.analysis.numerics_check import check_paths
+
+    findings = check_paths([os.path.join(_REPO, "paddle_tpu")])
+    assert _errors(findings) == []
+
+
+def test_numerics_demo_green():
+    """ISSUE 17: the representative numerics session — dtype-flow audit
+    of the demo TrainStep's programs, a traced bf16 matmul through the
+    ops-layer wide-accumulation helper, and a lit-witness run over
+    healthy tensors — records zero NM findings."""
+    from paddle_tpu.analysis.numerics_check import record_demo_numerics
+
+    assert [str(f) for f in record_demo_numerics()] == []
+
+
 def test_cli_exits_zero_with_machine_readable_findings(capsys):
     """`tools.lint --json --include-tests` over the repo: exit 0,
     parseable. Run in-process (the tests above already paid the analyzer
@@ -265,7 +287,8 @@ def test_cli_exits_zero_with_machine_readable_findings(capsys):
     assert set(payload["analyzers"]) == {"trace", "registry", "program",
                                          "jaxpr", "spmd", "cost", "serving",
                                          "telemetry", "cache", "comm",
-                                         "fault", "ckpt", "concurrency"}
+                                         "fault", "ckpt", "concurrency",
+                                         "numerics"}
     assert isinstance(payload["findings"], list)
     # per-family wall-time (CI satellite): one entry per analyzer run
     assert set(payload["timings_s"]) == set(payload["analyzers"])

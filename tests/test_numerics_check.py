@@ -1,0 +1,464 @@
+"""ISSUE 17: the numerics analyzer (NM11xx) + runtime NaN/range witness.
+
+Three layers under test:
+
+- the static rules (NM1100–NM1102) each catch a seeded negative and
+  respect the shared noqa grammar;
+- the jaxpr dtype-flow rules (NM1103/NM1106/NM1108) and the object
+  audits (NM1107/NM1109) each catch a seeded negative with a clean
+  positive control;
+- the runtime witness catches a REAL NaN (NM1104) and a REAL dynamic-
+  range collapse (NM1105) live, dumps exactly one AnomalyMonitor
+  flight-recorder bundle per kind, and dark mode is genuinely dark (no
+  per-name state growth — one bool read per watch site).
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis.numerics_check import (audit_jaxpr_numerics,
+                                                audit_quanter, audit_scaler,
+                                                audit_witness, check_source)
+from paddle_tpu.observability import numerics as num
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+@pytest.fixture(autouse=True)
+def _quiet_witness():
+    """Every test starts dark with clean watermarks and leaves no
+    witness state behind for the rest of the suite (the lint demo and
+    other tests share the process-wide state)."""
+    was = num.set_witness(False)
+    num.witness_reset()
+    yield
+    num.set_witness(was)
+    num.witness_reset()
+
+
+# ------------------------------------------------------------- NM1100
+def test_nm1100_dtype_string_surgery_flagged():
+    src = 'dt = np.dtype(str(v.dtype).replace("bfloat16", "float32"))\n'
+    assert "NM1100" in _codes(check_source(src, "a.py"))
+
+
+def test_nm1100_explicit_map_clean_and_noqa_suppresses():
+    clean = ('_MAP = {"bfloat16": "float32"}\n'
+             'dt = _MAP.get(str(v.dtype), str(v.dtype))\n')
+    assert check_source(clean, "a.py") == []
+    noqad = ('dt = str(d).replace("bfloat16", "float32")'
+             '  # noqa: NM1100 — bootstrap\n')
+    assert check_source(noqad, "a.py") == []
+
+
+def test_nm1100_non_dtype_replace_clean():
+    src = 'name = path.replace("float_dir", "int_dir")\n'
+    assert "NM1100" not in _codes(check_source(src, "a.py"))
+
+
+# ------------------------------------------------------------- NM1101
+def test_nm1101_fp32_cast_inside_amp_op_flagged():
+    src = '''
+import jax.numpy as jnp
+
+def matmul(a, b):
+    return jnp.matmul(a.astype(jnp.float32), b)
+'''
+    assert "NM1101" in _codes(check_source(src, "m.py"))
+
+
+def test_nm1101_outside_amp_list_and_dynamic_dtype_clean():
+    # `softmax` is black-listed, not white-listed: widening there is fine
+    src_black = '''
+import jax.numpy as jnp
+
+def softmax(x):
+    return jnp.exp(x.astype(jnp.float32))
+'''
+    assert "NM1101" not in _codes(check_source(src_black, "m.py"))
+    # casting back to the INPUT dtype is the blessed epilogue
+    src_dyn = '''
+import jax.numpy as jnp
+
+def matmul(a, b):
+    wide = jnp.matmul(a, b, preferred_element_type=jnp.float32)
+    return wide.astype(a.dtype)
+'''
+    assert "NM1101" not in _codes(check_source(src_dyn, "m.py"))
+
+
+# ------------------------------------------------------------- NM1102
+def test_nm1102_float64_into_jnp_flagged():
+    src = ('import jax.numpy as jnp\n'
+           'y = jnp.asarray(x, dtype="float64")\n'
+           'z = jnp.zeros((4,), jnp.float64)\n')
+    assert _codes(check_source(src, "f.py")).count("NM1102") == 2
+
+
+def test_nm1102_host_numpy_float64_clean():
+    # host-side numpy f64 (metrics, samplers) is legitimate — only jnp
+    # calls are in scope
+    src = ('import numpy as np\n'
+           'acc = np.zeros((4,), np.float64)\n')
+    assert "NM1102" not in _codes(check_source(src, "f.py"))
+
+
+# ------------------------------------------------------------- NM1103
+def test_nm1103_narrow_dot_accumulation_flagged_and_wide_clean():
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.ShapeDtypeStruct((8, 8), jnp.bfloat16)
+    bad = jax.make_jaxpr(jnp.matmul)(sds, sds)
+    assert "NM1103" in _codes(audit_jaxpr_numerics(bad))
+
+    from paddle_tpu.ops.math import _accum_matmul
+
+    good = jax.make_jaxpr(_accum_matmul)(sds, sds)
+    assert _codes(audit_jaxpr_numerics(good)) == []
+
+
+def test_nm1103_fp32_dot_clean():
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    closed = jax.make_jaxpr(jnp.matmul)(sds, sds)
+    assert _codes(audit_jaxpr_numerics(closed)) == []
+
+
+# ------------------------------------------------------------- NM1106
+def test_nm1106_large_bf16_reduction_flagged_small_clean():
+    import jax
+    import jax.numpy as jnp
+
+    big = jax.ShapeDtypeStruct((8, 8192), jnp.bfloat16)
+    bad = jax.make_jaxpr(
+        lambda a: jax.lax.reduce_sum_p.bind(a, axes=(1,)))(big)
+    assert "NM1106" in _codes(audit_jaxpr_numerics(bad))
+
+    small = jax.ShapeDtypeStruct((8, 16), jnp.bfloat16)
+    ok = jax.make_jaxpr(
+        lambda a: jax.lax.reduce_sum_p.bind(a, axes=(1,)))(small)
+    assert _codes(audit_jaxpr_numerics(ok)) == []
+
+
+def test_nm1106_jnp_sum_widens_and_stays_clean():
+    """jnp.sum upcasts bf16 to an fp32 accumulator on its own — the
+    clean pattern the rule must NOT flag."""
+    import jax
+    import jax.numpy as jnp
+
+    big = jax.ShapeDtypeStruct((8, 8192), jnp.bfloat16)
+    closed = jax.make_jaxpr(lambda a: jnp.sum(a, axis=-1))(big)
+    assert _codes(audit_jaxpr_numerics(closed)) == []
+
+
+# ------------------------------------------------------------- NM1107
+def test_nm1107_fp16_without_live_scaler_flagged():
+    from paddle_tpu.amp import GradScaler
+
+    assert "NM1107" in _codes(audit_scaler(None, {"float16"}))
+    assert "NM1107" in _codes(
+        audit_scaler(GradScaler(enable=False), {"float16"}))
+
+
+def test_nm1107_live_scaler_or_bf16_clean():
+    from paddle_tpu.amp import GradScaler
+
+    assert audit_scaler(GradScaler(enable=True), {"float16"}) == []
+    assert audit_scaler(None, {"bfloat16", "float32"}) == []
+
+
+# ------------------------------------------------------------- NM1108
+def test_nm1108_int8_to_bf16_dequant_flagged_fp32_clean():
+    import jax
+    import jax.numpy as jnp
+
+    qi = jax.ShapeDtypeStruct((8,), jnp.int8)
+    bad = jax.make_jaxpr(lambda q: q.astype(jnp.bfloat16) * 2)(qi)
+    assert "NM1108" in _codes(audit_jaxpr_numerics(bad))
+    good = jax.make_jaxpr(lambda q: q.astype(jnp.float32) * 2)(qi)
+    assert _codes(audit_jaxpr_numerics(good)) == []
+
+
+def test_nm1108_qpsum_dequant_epilogue_clean():
+    """The wire path's own dequant (int8 blocks × fp32 scales) is the
+    reference-clean epilogue."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.collective_opt.qpsum import (
+        dequantize_blockwise)
+
+    q = jax.ShapeDtypeStruct((4, 128), jnp.int8)
+    s = jax.ShapeDtypeStruct((4,), jnp.float32)
+    closed = jax.make_jaxpr(dequantize_blockwise)(q, s)
+    assert _codes(audit_jaxpr_numerics(closed)) == []
+
+
+# ------------------------------------------------------------- NM1109
+def test_nm1109_uncalibrated_quanter_flagged_then_calibrated_clean():
+    import paddle_tpu as paddle
+    from paddle_tpu.quantization.quanters import (
+        FakeQuanterWithAbsMaxObserver)
+
+    quanter = FakeQuanterWithAbsMaxObserver()
+    assert "NM1109" in _codes(audit_quanter(quanter))
+
+    quanter.train()
+    quanter(paddle.Tensor(np.linspace(-1, 1, 16, dtype=np.float32)))
+    assert audit_quanter(quanter) == []
+
+
+def test_degenerate_scale_passes_activation_through():
+    """The fixed _fake_quant: an uncalibrated (zero) scale must not
+    collapse activations to the clamp floor — the input passes through
+    untouched until the observer sees data."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.quantization.quanters import _fake_quant
+
+    x = jnp.asarray(np.linspace(-2, 2, 8, dtype=np.float32))
+    out = np.asarray(_fake_quant(x, jnp.asarray(0.0), 8))
+    np.testing.assert_allclose(out, np.asarray(x))
+    # a real scale still quantizes
+    q = np.asarray(_fake_quant(x, jnp.asarray(2.0), 8))
+    assert not np.allclose(q, np.asarray(x))
+    assert np.max(np.abs(q - np.asarray(x))) <= 2.0 / 127 + 1e-6
+
+
+# ------------------------------------------------------------- NM1104
+def test_nm1104_live_nan_caught_and_dumped_once(tmp_path):
+    """The real thing: a NaN hits a lit watch site — the witness
+    records exactly one NM1104 verdict and the AnomalyMonitor dumps
+    exactly one flight-recorder bundle (cooldown absorbs the repeat)."""
+    from paddle_tpu.observability.anomaly import AnomalyMonitor
+
+    mon = AnomalyMonitor(dump_dir=str(tmp_path), cooldown_s=60.0)
+    bundles = []
+    orig = num._notify
+
+    def notify(verdict):
+        out = mon.on_numerics(verdict)
+        if out:
+            bundles.append(out)
+
+    num._notify = notify
+    num.set_witness(True)
+    try:
+        num.watch("t.loss", np.ones(4, np.float32))
+        num.watch("t.loss", np.asarray([1.0, np.nan, 2.0, 3.0]))
+        num.watch("t.loss", np.asarray([np.inf, 1.0]))  # cooldown absorbs
+    finally:
+        num.set_witness(False)
+        num._notify = orig
+
+    violations = num.witness_violations()
+    assert [v["code"] for v in violations] == ["NM1104", "NM1104"]
+    assert violations[0]["name"] == "t.loss"
+    assert "NM1104" in _codes(audit_witness())
+    assert len(bundles) == 1
+    assert list(tmp_path.glob("anomaly_numerics*")), "bundle not on disk"
+
+
+def test_nm1104_healthy_values_stay_quiet():
+    num.set_witness(True)
+    try:
+        for i in range(8):
+            num.watch("t.ok", np.full(4, 1.0 + i * 0.1, np.float32))
+    finally:
+        num.set_witness(False)
+    assert num.witness_violations() == []
+    stats = num.witness_stats()
+    assert stats["checks"] == 8 and stats["nonfinite"] == 0
+
+
+# ------------------------------------------------------------- NM1105
+def test_nm1105_range_collapse_flagged_after_watermark():
+    """Healthy samples establish the watermark; a sample whose max-abs
+    falls below watermark*ratio is a range-collapse verdict (grads
+    flushed to zero)."""
+    num.set_witness(True)
+    try:
+        for _ in range(4):
+            num.watch("t.grad", np.full(8, 3.0, np.float32))
+        num.watch("t.grad", np.full(8, 1e-9, np.float32))
+    finally:
+        num.set_witness(False)
+    violations = num.witness_violations()
+    assert [v["code"] for v in violations] == ["NM1105"]
+    assert violations[0]["watermark"] == pytest.approx(3.0)
+    assert "NM1105" in _codes(audit_witness())
+
+
+def test_nm1105_needs_established_watermark():
+    """Step-0 tensors have no 'normal range' yet: a tiny first sample
+    must not trip the collapse watcher."""
+    num.set_witness(True)
+    try:
+        num.watch("t.fresh", np.full(8, 1e-9, np.float32))
+        num.watch("t.fresh", np.full(8, 3.0, np.float32))
+    finally:
+        num.set_witness(False)
+    assert num.witness_violations() == []
+
+
+# ----------------------------------------------------------- dark mode
+def test_dark_mode_records_nothing():
+    """The contract that lets watch() live on hot paths: a dark witness
+    costs one bool read — no per-name state, no violations, no numpy
+    work."""
+    baseline = num.witness_report()
+    for _ in range(100):
+        num.watch("t.dark", np.ones(4, np.float32))
+    report = num.witness_report()
+    assert report["tensors"] == baseline["tensors"] == {}
+    assert report["violations"] == []
+
+
+def test_tracers_always_skipped():
+    """Watch sites inside compiled programs must never burn a tracer
+    into the graph: a traced value is skipped even when lit."""
+    import jax
+
+    num.set_witness(True)
+    try:
+        def f(x):
+            num.watch("t.traced", x)
+            return x * 2
+
+        jax.make_jaxpr(f)(np.ones(4, np.float32))
+    finally:
+        num.set_witness(False)
+    assert num.witness_stats()["checks"] == 0
+
+
+def test_witness_site_wired_through_train_step():
+    """The TrainStep site end-to-end: two steps under the lit witness
+    register train.loss checks and stay verdict-free."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.api import TrainStep
+
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    crit = nn.MSELoss()
+    step = TrainStep(model=model, optimizer=opt,
+                     loss_fn=lambda a, b: crit(model(a), b))
+    x = paddle.Tensor(np.ones((2, 8), np.float32), stop_gradient=True)
+    y = paddle.Tensor(np.zeros((2, 4), np.float32), stop_gradient=True)
+    num.set_witness(True)
+    try:
+        step(x, y)
+        step(x, y)
+    finally:
+        num.set_witness(False)
+    report = num.witness_report()
+    assert report["tensors"].get("train.loss", {}).get("checks", 0) >= 2
+    assert report["violations"] == []
+
+
+def test_numerics_flag_mirrors_into_witness():
+    from paddle_tpu.base.flags import set_flags
+
+    assert not num.witness_enabled()
+    set_flags({"numerics_witness": True})
+    try:
+        assert num.witness_enabled()
+    finally:
+        set_flags({"numerics_witness": False})
+    assert not num.witness_enabled()
+
+
+def test_witness_stats_published_via_collector():
+    from paddle_tpu.observability import registry
+
+    num.set_witness(True)
+    try:
+        num.watch("t.metric", np.ones(4, np.float32))
+    finally:
+        num.set_witness(False)
+    payload = registry.snapshot()["metrics"]["numerics"]
+    assert payload["checks"] >= 1
+    assert payload["nonfinite"] == 0
+
+
+# ------------------------------------------- forced-fp16 GradScaler path
+class TestFp16GradScalerRoundTrip:
+    """Forced-fp16 is the configuration NM1107 polices: float16 graphs
+    are only sound behind a live GradScaler. These tests pin the
+    scale → backward → unscale_ → found_inf contract that makes the
+    NM1107 negative (live scaler) actually safe."""
+
+    def _setup(self, init_scale=128.0):
+        import paddle_tpu as paddle
+        import paddle_tpu.optimizer as opt
+
+        p = paddle.Parameter(np.ones(4, np.float16))
+        o = opt.SGD(0.1, parameters=[p])
+        from paddle_tpu import amp
+
+        scaler = amp.GradScaler(init_loss_scaling=init_scale,
+                                decr_every_n_nan_or_inf=1)
+        return paddle, p, o, scaler
+
+    def test_scale_unscale_round_trips_fp16_grads(self):
+        paddle, p, o, scaler = self._setup()
+        loss = paddle.to_tensor(np.float16(0.5))
+        scaled = scaler.scale(loss)
+        assert float(scaled.numpy()) == pytest.approx(64.0)
+
+        g = np.asarray([0.25, -0.5, 1.0, 2.0], np.float16)
+        p._grad = paddle.to_tensor(g * np.float16(128.0))
+        scaler.unscale_(o)
+        np.testing.assert_allclose(np.asarray(p._grad.numpy(), np.float32),
+                                   np.asarray(g, np.float32), rtol=1e-3)
+        assert not bool(scaler._found_inf.numpy())
+        # second unscale_ before step() is a no-op, not a double divide
+        scaler.unscale_(o)
+        np.testing.assert_allclose(np.asarray(p._grad.numpy(), np.float32),
+                                   np.asarray(g, np.float32), rtol=1e-3)
+
+    def test_fp16_overflow_sets_found_inf_skips_step_backs_off(self):
+        # the canonical forced-fp16 failure: scale * grad exceeds the
+        # fp16 max (65504) and the SCALED grad is already inf on arrival
+        paddle, p, o, scaler = self._setup(init_scale=65536.0)
+        p._grad = paddle.to_tensor(np.ones(4, np.float16))
+        with np.errstate(over="ignore"):  # the overflow IS the fixture
+            p._grad._replace_value(p._grad._value * np.float16(65536.0))
+        assert not np.all(np.isfinite(np.asarray(p._grad.numpy(),
+                                                 np.float32)))
+        scaler.step(o)
+        assert bool(scaler._found_inf.numpy())
+        np.testing.assert_allclose(np.asarray(p.numpy(), np.float32),
+                                   np.ones(4, np.float32))  # step skipped
+        scaler.update()
+        assert float(scaler._scale.numpy()) == pytest.approx(32768.0)
+
+    def test_clean_fp16_step_advances_params(self):
+        paddle, p, o, scaler = self._setup()
+        p._grad = paddle.to_tensor(
+            np.full(4, 0.5 * 128.0, np.float16))  # scaled grad of 0.5
+        scaler.step(o)
+        scaler.update()
+        assert not bool(scaler._found_inf.numpy())
+        np.testing.assert_allclose(np.asarray(p.numpy(), np.float32),
+                                   np.full(4, 0.95, np.float32), rtol=1e-2)
+        assert float(scaler._scale.numpy()) == pytest.approx(128.0)
+
+    def test_unscaled_grads_hit_the_witness(self):
+        paddle, p, o, scaler = self._setup()
+        p._grad = paddle.to_tensor(np.full(4, 128.0, np.float16))
+        num.set_witness(True)
+        try:
+            scaler.unscale_(o)
+        finally:
+            num.set_witness(False)
+        report = num.witness_report()
+        assert report["tensors"].get("amp.unscaled_grad",
+                                     {}).get("checks", 0) == 1
+        assert report["violations"] == []

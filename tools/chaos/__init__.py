@@ -27,6 +27,10 @@ ckpt_torn_write     injected ``ckpt.write`` crash between tmp-write and
                     one; the retry lands the new one
 watchdog_hang       injected ``comm.watchdog`` hang: the timeout
                     handler fires and ``comm.watchdog_timeout`` ticks
+nonfinite_grad      injected ``numerics.nonfinite_grad`` NaN under a
+                    live GradScaler: the lit numerics witness dumps
+                    exactly one NM1104 bundle, the poisoned update
+                    reverts, the scale backs off, training continues
 ==================  ====================================================
 
 Exit code: 0 = every invariant held, 1 = any breach (CI-gateable).
@@ -391,6 +395,81 @@ def scenario_watchdog_hang(seed: int) -> dict:
             "timeouts": list(manager.timeouts)}
 
 
+def scenario_nonfinite_grad(seed: int) -> dict:
+    """An injected NaN grad under a live fp16-style GradScaler: the lit
+    numerics witness dumps exactly ONE NM1104 flight-recorder bundle,
+    the poisoned step's optimizer update reverts (params unchanged),
+    the dynamic scale backs off, and later steps train on finite."""
+    import glob
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import reliability as rel
+    from paddle_tpu.observability import numerics as num
+    from paddle_tpu.observability.anomaly import AnomalyMonitor
+
+    dumpdir = tempfile.mkdtemp(prefix="chaos_numerics_")
+    paddle.seed(seed)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(enable=True, init_loss_scaling=8.0)
+    crit = nn.MSELoss()
+    x = paddle.Tensor(np.ones((2, 4), np.float32), stop_gradient=True)
+    y = paddle.Tensor(np.zeros((2, 4), np.float32), stop_gradient=True)
+
+    mon = AnomalyMonitor(dump_dir=dumpdir, cooldown_s=60.0)
+    bundles = []
+    orig_notify = num._notify
+
+    def notify(verdict):
+        out = mon.on_numerics(verdict)
+        if out:
+            bundles.append(out)
+
+    num._notify = notify
+    was = num.set_witness(True)
+    # one poisoned step: unscale_ NaNs the first grad, found_inf trips
+    rel.arm(rel.FaultInjector(seed=seed).plan(
+        "numerics.nonfinite_grad", rate=1.0, kind="corrupt", max_fires=1))
+    try:
+        w_before = np.asarray(model.weight._value).copy()
+        losses = []
+        for _ in range(3):
+            loss = crit(model(x), y)
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            losses.append(float(loss._value))
+    finally:
+        rel.disarm()
+        num.set_witness(was)
+        num._notify = orig_notify
+    try:
+        violations = num.witness_violations()
+        nonfinite = [v for v in violations if v["code"] == "NM1104"]
+        # exactly one bundle: the monitor's cooldown absorbs repeats
+        on_disk = glob.glob(os.path.join(dumpdir, "anomaly_numerics*"))
+        w_final = np.asarray(model.weight._value)
+        scale_backed_off = float(scaler._scale._value) < 8.0
+        recovered = (np.isfinite(w_final).all()
+                     and not np.allclose(w_final, w_before))
+        ok = (len(nonfinite) == 1 and len(bundles) == 1
+              and len(on_disk) == 1 and scale_backed_off and recovered
+              and all(np.isfinite(losses)))
+        return {"ok": bool(ok), "nm1104_verdicts": len(nonfinite),
+                "bundles": len(bundles), "bundles_on_disk": len(on_disk),
+                "scale_backed_off": bool(scale_backed_off),
+                "trained_after_poison": bool(recovered),
+                "losses_finite": bool(all(np.isfinite(losses)))}
+    finally:
+        num.witness_reset()
+        shutil.rmtree(dumpdir, ignore_errors=True)
+
+
 _SCENARIOS = (
     ("train_resume", scenario_train_resume),
     ("serving_retry", scenario_serving_retry),
@@ -399,6 +478,7 @@ _SCENARIOS = (
     ("cache_corruption", scenario_cache_corruption),
     ("ckpt_torn_write", scenario_ckpt_torn_write),
     ("watchdog_hang", scenario_watchdog_hang),
+    ("nonfinite_grad", scenario_nonfinite_grad),
 )
 
 
@@ -426,7 +506,8 @@ def run_schedule(seed: int = 0, only=None) -> dict:
              "prefetch_crash": "io.h2d",
              "cache_corruption": "compile_cache.store",
              "ckpt_torn_write": "ckpt.write",
-             "watchdog_hang": "comm.watchdog"}
+             "watchdog_hang": "comm.watchdog",
+             "nonfinite_grad": "numerics.nonfinite_grad"}
     for name, result in report["scenarios"].items():
         site = known.get(name)
         if site and result.get("ok"):

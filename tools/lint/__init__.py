@@ -1,6 +1,6 @@
 """``python -m tools.lint`` — the repo's static-analysis driver.
 
-Runs the thirteen ``paddle_tpu.analysis`` analyzers and reports findings:
+Runs the fourteen ``paddle_tpu.analysis`` analyzers and reports findings:
 
 - **trace**:    the trace-safety AST linter over ``paddle_tpu/`` (or the
                 paths given on the command line),
@@ -63,6 +63,16 @@ Runs the thirteen ``paddle_tpu.analysis`` analyzers and reports findings:
                 inversion / hold-budget breach recorded by the witness.
                 ``--select CX`` is the pre-fleet gate before launching
                 multi-thread serving work.
+- **numerics**: the mixed-precision discipline (NM11xx) over the same
+                paths as the trace linter plus the shared demo TrainStep
+                and a traced bf16 matmul: no dtype string surgery, no
+                hardcoded fp32 cast inside AMP white-listed ops, no
+                float64 into jnp calls, no narrow-float dot accumulation
+                or oversized bf16 reductions in the audited programs, no
+                int8-to-bf16 dequant epilogue, and no NaN/Inf or range
+                collapse recorded by the lit runtime witness
+                (``observability/numerics.py``). ``--select NM`` is the
+                pre-run gate before a long mixed-precision job.
 
 Exit-code contract (stable, CI-gateable):
   0 = no error-severity findings (warnings never gate)
@@ -86,7 +96,7 @@ import sys
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd", "cost",
               "serving", "telemetry", "cache", "comm", "fault", "ckpt",
-              "concurrency")
+              "concurrency", "numerics")
 
 
 def _source_paths(paths, include_tests=False):
@@ -317,12 +327,29 @@ def _run_concurrency(paths, include_tests=False):
     return findings
 
 
+def _run_numerics(paths, include_tests=False):
+    """NM11xx: static mixed-precision discipline over the same source
+    paths as the trace linter (dtype string surgery, hardcoded fp32
+    casts in AMP ops, float64 into jnp) plus the dtype-flow audit of
+    the shared demo TrainStep's cached programs, a traced bf16 matmul
+    through the ops-layer accumulation helper, and a short lit-witness
+    run (NM1104/NM1105). Never scans tests/ — numerics tests seed
+    NaN/float64 negatives on purpose."""
+    from paddle_tpu.analysis.numerics_check import (check_paths,
+                                                    record_demo_numerics)
+
+    findings = list(record_demo_numerics(_demo_step()))
+    findings.extend(check_paths(_source_paths(paths, include_tests=False)))
+    return findings
+
+
 _RUNNERS = {"trace": _run_trace, "registry": _run_registry,
             "program": _run_program, "jaxpr": _run_jaxpr,
             "spmd": _run_spmd, "cost": _run_cost,
             "serving": _run_serving, "telemetry": _run_telemetry,
             "cache": _run_cache, "comm": _run_comm, "fault": _run_fault,
-            "ckpt": _run_ckpt, "concurrency": _run_concurrency}
+            "ckpt": _run_ckpt, "concurrency": _run_concurrency,
+            "numerics": _run_numerics}
 
 # analyzer -> its finding-code family prefix, so a crash finding
 # (<PREFIX>999) stays visible under --select filters for that family
@@ -330,7 +357,7 @@ _FAMILY_PREFIX = {"trace": "TS", "registry": "RC", "program": "PV",
                   "jaxpr": "JX", "spmd": "SP", "cost": "CM",
                   "serving": "JX", "telemetry": "OB", "cache": "CC",
                   "comm": "QZ", "fault": "FT", "ckpt": "CK",
-                  "concurrency": "CX"}
+                  "concurrency": "CX", "numerics": "NM"}
 
 
 def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
