@@ -332,6 +332,39 @@ def _eqn_flops(eqn) -> tuple:
                      if getattr(v, "aval", None) is not None)), 0.0
 
 
+def accumulation_width_delta(eqn) -> Dict[str, float]:
+    """Price one dot/conv equation's accumulation-width choice: what
+    widening a narrow-float contraction to float32
+    (``preferred_element_type=float32`` + cast back) costs over keeping
+    the narrow accumulator. Static aval arithmetic — never compiles.
+
+    FLOPs do not change: the MXU accumulates partial products at full
+    width either way, so the price is pure memory traffic — the f32
+    result materializes at 4 bytes/element where the narrow one took
+    ``itemsize``. Returned dict:
+
+    - ``extra_bytes``  ``out_numel * (4 - narrow_itemsize)`` — the added
+      result-write traffic of the widened accumulator
+    - ``out_bytes``    the narrow result's bytes as traced (the base)
+    - ``flops``        the contraction's FLOPs (unchanged; context for
+      ranking one dot against the program)
+
+    This is the NM1103 pricing hook: ``numerics_check`` compares
+    ``extra_bytes`` against the whole program's read+write bytes and
+    downgrades the flat error to a priced warning only when the widened
+    result would dominate the program's traffic.
+    """
+    out = getattr(eqn.outvars[0], "aval", None) if eqn.outvars else None
+    numel = _aval_numel(out)
+    itemsize = int(getattr(getattr(out, "dtype", None), "itemsize", 4))
+    flops, _ = _eqn_flops(eqn)
+    return {
+        "extra_bytes": float(numel * max(4 - itemsize, 0)),
+        "out_bytes": float(numel * itemsize),
+        "flops": float(flops),
+    }
+
+
 def _eqn_comm(eqn, axis_sizes: Optional[Dict[str, int]] = None
               ) -> Dict[str, float]:
     """Collective volume per mesh axis for one equation: moved bytes ×

@@ -524,25 +524,46 @@ def audit_serving(engine) -> List[Finding]:
                 "first live batch assembled at those rungs compiles "
                 "mid-traffic", name))
 
-    # KV-cache decode engines (serving/kv_cache.py): the pool must be
-    # allocated ONCE — steady state reuses freed slots, never grows
+    # KV-cache decode engines (serving/kv_cache.py): the pool — slot
+    # rows or pages — must be allocated ONCE; steady state reuses freed
+    # units, never grows
     pool = getattr(engine, "kv_pool", None)
     if pool is not None:
+        paged = getattr(pool, "page_size", None) is not None
+        unit = "page" if paged else "slot"
         baseline = getattr(pool, "bytes_at_warmup", None)
         if baseline is not None and pool.device_bytes() != baseline:
             findings.append(Finding(
                 "serving", "JX332", "error",
-                f"KV slot pool device bytes changed after warmup "
+                f"KV {unit} pool device bytes changed after warmup "
                 f"({baseline} -> {pool.device_bytes()}) — the pool must be "
-                "allocated once and reuse slots; growth means decode "
-                "memory is O(traffic), not O(max_slots)", name))
+                f"allocated once and reuse {unit}s; growth means decode "
+                "memory is O(traffic), not O(pool)", name))
         if (not getattr(engine, "active_requests", lambda: 0)()
                 and pool.in_use() > 0):
             findings.append(Finding(
                 "serving", "JX333", "warning",
-                f"{pool.in_use()} KV slot(s) still allocated with no "
-                "active request — a retired sequence leaked its slot and "
-                "the pool will exhaust under sustained traffic", name))
+                f"{pool.in_use()} KV {unit}(s) still allocated with no "
+                f"active request — a retired sequence leaked its {unit}s "
+                "and the pool will exhaust under sustained traffic", name))
+        # JX334: paged pools only — fragmentation watermark. Low mean
+        # utilization of IN-USE pages means the page size is too coarse
+        # for the traffic (most of each borrowed page is dead capacity).
+        util = getattr(pool, "utilization_report", None)
+        if util is not None:
+            from ..base.flags import get_flag
+
+            rep = util()
+            floor = float(get_flag("serving_frag_warn_utilization"))
+            if rep["samples"] >= 8 and rep["mean"] < floor:
+                findings.append(Finding(
+                    "serving", "JX334", "warning",
+                    f"mean KV page utilization {rep['mean']:.2f} over "
+                    f"{rep['samples']} decode steps is below the "
+                    f"fragmentation watermark ({floor}) — live tokens fill "
+                    "little of the pages they hold; shrink "
+                    "FLAGS_serving_page_size so residency tracks live "
+                    "tokens, not page granularity", name))
     return findings
 
 
@@ -593,10 +614,11 @@ def record_demo_engine(tmpdir: str):
 def record_demo_decode_engine():
     """Build, warm and briefly drive the representative DECODE engine the
     ``serving`` lint analyzer audits alongside the batch demo: a tiny GPT
-    behind a KV slot pool, two tenants' mixed prompts joining and leaving
-    the running batch. Exercises the full KV path — prefill grid, decode
-    rungs, slot alloc/release — so JX330-JX333 all see real state. One
-    definition so the CLI and the test gate audit the SAME engine."""
+    behind a paged KV pool, two tenants' mixed prompts joining and
+    leaving the running batch. Exercises the full KV path — prefill
+    grid, (batch × table) decode rungs, page alloc/release — so
+    JX330-JX334 all see real state. One definition so the CLI and the
+    test gate audit the SAME engine."""
     import numpy as np
 
     import paddle_tpu as paddle

@@ -29,7 +29,14 @@ NM1102  float64 into traced code   a float64 dtype literal handed to a
 NM1103  narrow dot accumulation    *jaxpr*: a dot/conv whose narrow-
                                    float (bf16/fp16) operands accumulate
                                    in the same narrow dtype — no wide
-                                   ``preferred_element_type`` (error)
+                                   ``preferred_element_type``. Priced
+                                   through ``cost_model.
+                                   accumulation_width_delta``: error
+                                   while the widened result is cheap
+                                   relative to the program's traffic,
+                                   warning carrying the bytes delta once
+                                   it exceeds ``FLAGS_numerics_widen_
+                                   warn_ratio`` of program bytes
 NM1106  narrow large reduction     *jaxpr*: a bf16/fp16 ``reduce_sum``
                                    whose reduced extent exceeds
                                    ``FLAGS_numerics_bf16_reduce_limit``
@@ -92,6 +99,15 @@ def _bf16_reduce_limit() -> int:
         return int(get_flag("numerics_bf16_reduce_limit"))
     except Exception:
         return 4096
+
+
+def _widen_warn_ratio() -> float:
+    try:
+        from ..base.flags import get_flag
+
+        return float(get_flag("numerics_widen_warn_ratio"))
+    except Exception:
+        return 0.25
 
 
 # ------------------------------------------------------------------ AST
@@ -226,6 +242,17 @@ def audit_jaxpr_numerics(closed_jaxpr, *, location: str = "") -> List[Finding]:
 
     findings: List[Finding] = []
     limit = _bf16_reduce_limit()
+    prog_bytes: List[float] = []  # lazy: cost the program once, only
+    #                               when an NM1103 site actually fires
+
+    def _program_bytes() -> float:
+        if not prog_bytes:
+            from .cost_model import cost_jaxpr
+
+            rep = cost_jaxpr(closed_jaxpr, location=location or "jaxpr")
+            prog_bytes.append(float(rep.bytes_read + rep.bytes_written))
+        return max(prog_bytes[0], 1.0)
+
     for j in _iter_jaxprs(closed_jaxpr.jaxpr):
         for eqn in j.eqns:
             prim = eqn.primitive.name
@@ -234,13 +261,36 @@ def audit_jaxpr_numerics(closed_jaxpr, *, location: str = "") -> List[Finding]:
                 out_dt = _aval_dtype(eqn.outvars[0])
                 narrow = in_dts & _NARROW_FLOATS
                 if narrow and out_dt in narrow:
-                    findings.append(Finding(
-                        _ANALYZER, "NM1103", "error",
-                        f"{prim} accumulates {out_dt} operands in "
-                        f"{out_dt} — the contraction sums partial "
-                        "products in 8-bit-mantissa precision; pass "
-                        "preferred_element_type=float32 and cast the "
-                        "result back", location or "jaxpr"))
+                    from .cost_model import accumulation_width_delta
+
+                    delta = accumulation_width_delta(eqn)
+                    share = delta["extra_bytes"] / _program_bytes()
+                    ratio = _widen_warn_ratio()
+                    if ratio > 0 and share > ratio:
+                        findings.append(Finding(
+                            _ANALYZER, "NM1103", "warning",
+                            f"{prim} accumulates {out_dt} operands in "
+                            f"{out_dt}; widening to float32 adds "
+                            f"{int(delta['extra_bytes'])} result bytes "
+                            f"— {share:.0%} of the program's traffic "
+                            "(> FLAGS_numerics_widen_warn_ratio="
+                            f"{ratio:g}), so the dot output dominates "
+                            "this program — a deliberate narrow "
+                            "accumulator needs a noqa and a measured "
+                            "loss gate; otherwise pass "
+                            "preferred_element_type=float32",
+                            location or "jaxpr"))
+                    else:
+                        findings.append(Finding(
+                            _ANALYZER, "NM1103", "error",
+                            f"{prim} accumulates {out_dt} operands in "
+                            f"{out_dt} — the contraction sums partial "
+                            "products in 8-bit-mantissa precision and "
+                            "widening is cheap "
+                            f"({int(delta['extra_bytes'])} extra bytes, "
+                            f"{share:.1%} of program traffic); pass "
+                            "preferred_element_type=float32 and cast "
+                            "the result back", location or "jaxpr"))
             elif prim in _ACCUM_REDUCES and eqn.invars:
                 op = eqn.invars[0]
                 dt = _aval_dtype(op)

@@ -181,6 +181,19 @@ define_flag("serving_bulk_queue_share", 0.5,
             "serving tier: fraction of serving_max_queue a bulk-tier "
             "tenant may fill — the headroom above it is reserved for "
             "interactive tiers (AdmissionController.set_tier)")
+define_flag("serving_page_size", 16,
+            "decode serving: tokens per KV page — KVPagePool allocates "
+            "device memory in fixed pages this long instead of full "
+            "max_seq slot rows (serving/kv_cache.py); must be a power "
+            "of two so the block-table ladder stays aligned")
+define_flag("serving_pool_pages", 0,
+            "decode serving: total pages the paged KV pool holds "
+            "device-resident (allocated ONCE); 0 sizes it equal-bytes "
+            "to the slot pool it replaces: max_slots * max_seq tokens")
+define_flag("serving_frag_warn_utilization", 0.2,
+            "decode serving: JX334 page-fragmentation watermark — warn "
+            "when mean live-token utilization of in-use pages sampled "
+            "across the run falls below this fraction")
 define_flag("cost_while_default_trips", 1,
             "cost model: trip-count multiplier assumed for a while-loop "
             "whose counter pattern cannot be statically derived (1 keeps "
@@ -354,6 +367,16 @@ define_flag("numerics_bf16_reduce_limit", 4096,
             "mantissa bits, so summing >~2^12 same-sign terms loses the "
             "small addends entirely; widen to fp32 for the accumulation "
             "(preferred_element_type) and cast back. <=0 disables")
+define_flag("numerics_widen_warn_ratio", 0.25,
+            "numerics lint (NM1103): widening a narrow-float dot's "
+            "accumulator to float32 adds out_numel*(4-itemsize) bytes of "
+            "result traffic (cost_model.accumulation_width_delta). When "
+            "that price stays at or below this fraction of the whole "
+            "program's read+write bytes the fix is cheap and the finding "
+            "is an error; above it the program is dot-output-bound and "
+            "the finding downgrades to a warning carrying the priced "
+            "delta (a deliberate narrow accumulator needs a noqa and a "
+            "measured loss gate). <=0 makes every NM1103 an error")
 define_flag("numerics_collapse_ratio", 1e-4,
             "numerics witness (NM1105): once a watched tensor's max-abs "
             "watermark is established, a later sample whose max-abs "

@@ -65,6 +65,18 @@ def bucket_pair_for(n: int, seq_len: int, batch_ladder: Sequence[int],
     return bucket_for(n, batch_ladder), bucket_for(seq_len, seq_ladder)
 
 
+def table_ladder(max_seq: int, page_size: int) -> List[int]:
+    """The block-table-width ladder of a paged KV pool: powers of two
+    from one page up to ``ceil(max_seq / page_size)`` pages. The paged
+    decode program keys on (batch rung × table rung) — the table rung
+    bounds how many pages the gather reads, so a 128-token context in a
+    4k-capable pool pays a 1-page-rung gather, not the 4k one."""
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    max_pages = -(-int(max_seq) // int(page_size))
+    return powers_of_two_buckets(1, max_pages)
+
+
 def assemble_bucket(counts: Sequence[int], buckets: Sequence[int],
                     max_total: Optional[int] = None):
     """Mixed-size batch assembly for the serving tier: given the FIFO

@@ -58,6 +58,12 @@ SITES: Dict[str, str] = {
     "kv.commit": (
         "KVSlotPool.commit rejects; the pool keeps the previous buffers "
         "and the decode fault wall releases the step's slots"),
+    "kv.page_alloc": (
+        "KVPagePool.alloc raises before touching the free list; the "
+        "paged scheduler sheds exactly the one request that wanted the "
+        "pages (AdmissionError reason='kv_pages', pages it already held "
+        "release — no leak, JX333 stays clean) and every other lane "
+        "keeps decoding"),
     "io.h2d": (
         "prefetch worker forwards the error through the bounded queue; "
         "the consumer (Model.fit) re-raises instead of deadlocking"),

@@ -37,11 +37,11 @@ from ..observability.locks import named_lock
 from ..profiler.pipeline import serving_stats
 from . import kv_cache as kvc
 from .engine import EngineBase
-from .kv_cache import KVSlotPool
+from .kv_cache import KVPagePool, KVSlotPool
 from .request_queue import DecodeRequest
-from .scheduler import DecodeScheduler
+from .scheduler import DecodeScheduler, PagedDecodeScheduler
 
-__all__ = ["DecodeEngine", "DecodePrograms"]
+__all__ = ["DecodeEngine", "DecodePrograms", "PagedDecodePrograms"]
 
 
 def _extract_gpt(model):
@@ -168,7 +168,12 @@ class DecodePrograms:
         w = params["wte"].T if self._tied else params["head_w"]
         return x @ w
 
-    def _prefill_fn(self, params, ck, cv, tokens, lengths, slot_ids):
+    def _prefill_trunk(self, params, tokens, lengths):
+        """The prefill transformer body shared by the slot and paged
+        program families: ``[B, S]`` prompt tokens → per-lane head
+        logits at the last real position plus the stacked per-layer K/V
+        rows ``[layers, B, S, heads, head_dim]``. Pure function of the
+        prompt — cache writing is the caller's (pool-specific) job."""
         import jax
         import jax.numpy as jnp
 
@@ -200,10 +205,17 @@ class DecodePrograms:
         idx = (lengths - 1).astype(jnp.int32)
         x_last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
         hfin = _ln(x_last, params["lnf_w"], params["lnf_b"], eps)
-        next_tok = jnp.argmax(self._logits_head(params, hfin),
-                              axis=-1).astype(jnp.int32)
+        head = self._logits_head(params, hfin)
         krows = jnp.stack(ks)  # [layers, B, S, heads, head_dim]
         vrows = jnp.stack(vs)
+        return head, krows, vrows
+
+    def _prefill_fn(self, params, ck, cv, tokens, lengths, slot_ids):
+        import jax.numpy as jnp
+
+        B = tokens.shape[0]
+        head, krows, vrows = self._prefill_trunk(params, tokens, lengths)
+        next_tok = jnp.argmax(head, axis=-1).astype(jnp.int32)
         if B == 1:
             # interactive path: one dynamic_update_slice per buffer
             ck = kvc.write_prompt(ck, slot_ids[0], krows[:, 0])
@@ -373,19 +385,223 @@ class DecodePrograms:
                                 positions)
 
 
+class PagedDecodePrograms(DecodePrograms):
+    """The decode program set over a :class:`~.kv_cache.KVPagePool`.
+
+    Same warmup/compile-cache/donation/hot-swap machinery as the slot
+    family; the cache layout and the rung key change:
+
+    - K/V is indexed through a per-request *block table* — a traced
+      ``[B, T]`` int32 array naming each lane's pages in order. The
+      table is DATA: one compiled program serves any page map, so page
+      churn (alloc on growth, reclaim on retire, reuse by the next
+      request) costs zero retraces.
+    - decode rungs key on (batch rung × table rung): ``("decode", b,
+      t)`` where ``t`` walks :func:`~..jit.bucketing.table_ladder` —
+      a short context pays a short gather, a 4k one a long gather, and
+      both replay warm.
+    - sampling rides as traced per-lane arguments (temperature / top-k
+      / top-p / raw uint32 PRNG key pair): sampling is data too, never
+      a retrace. ``temp == 0`` lanes take the argmax branch bit-exactly
+      — the greedy audit mode the slot oracle is compared against.
+    """
+
+    def __init__(self, model, pool: KVPagePool, *,
+                 seq_ladder: Sequence[int],
+                 prefill_batch_rungs: Sequence[int],
+                 decode_rungs: Sequence[int],
+                 max_seq: int):
+        from ..jit.bucketing import table_ladder
+
+        self.max_seq = int(max_seq)
+        # super() derives _model_key from pool.k.shape (already the page
+        # layout) and jits self._prefill_fn/_decode_fn — the overrides
+        # below, bound through normal method resolution
+        super().__init__(model, pool,
+                         seq_ladder=seq_ladder,
+                         prefill_batch_rungs=prefill_batch_rungs,
+                         decode_rungs=decode_rungs)
+        self.table_rungs = table_ladder(self.max_seq, pool.page_size)
+        # disambiguate from a slot pool that happens to share shapes,
+        # and cover the table ladder (it shapes the warmed rung set)
+        self._model_key = self._model_key + (
+            "paged", int(pool.page_size), tuple(self.table_rungs))
+
+    # ----------------------------------------------------------- sampling
+    def _choose_tokens(self, head, temps, top_ks, top_ps, rkeys):
+        """Per-lane next-token choice from head logits ``[B, V]``.
+
+        All sampling parameters are traced data. A lane with ``temp ==
+        0`` returns plain argmax — the SAME op the slot programs run,
+        so greedy mode stays bit-exact. Otherwise: temperature-scale,
+        keep the top-k / top-p prefix of the descending sort, and draw
+        with ``jax.random.categorical`` from the lane's own raw uint32
+        key pair — the key is ``[request_seed, token_index]`` on the
+        host, so a request's stream never depends on batch composition.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        greedy = jnp.argmax(head, axis=-1).astype(jnp.int32)
+        V = head.shape[-1]
+
+        def lane(lg, temp, tk, tp, key):
+            lg = lg.astype(jnp.float32)
+            scaled = lg / jnp.where(temp > 0, temp, 1.0)
+            srt = jnp.sort(scaled)[::-1]  # descending
+            rank = jnp.arange(V)
+            k_eff = jnp.clip(jnp.where(tk > 0, tk, V), 1, V)
+            probs = jax.nn.softmax(srt)
+            p_eff = jnp.where((tp > 0.0) & (tp < 1.0), tp, 1.0)
+            # both filters are prefixes of the sort: kept set = prefix,
+            # cutoff = the smallest kept value (rank 0 is always kept)
+            keep = (rank < k_eff) & (jnp.cumsum(probs) - probs < p_eff)
+            cutoff = jnp.min(jnp.where(keep, srt, jnp.inf))
+            filtered = jnp.where(scaled >= cutoff, scaled, -jnp.inf)
+            return jax.random.categorical(key, filtered).astype(jnp.int32)
+
+        sampled = jax.vmap(lane)(head, temps, top_ks, top_ps, rkeys)
+        return jnp.where(temps > 0, sampled, greedy)
+
+    # ----------------------------------------------------------- programs
+    def _prefill_fn(self, params, ck, cv, tokens, lengths, tables,
+                    temps, top_ks, top_ps, rkeys):
+        import jax.numpy as jnp
+
+        head, krows, vrows = self._prefill_trunk(params, tokens, lengths)
+        next_tok = self._choose_tokens(head, temps, top_ks, top_ps, rkeys)
+        # pad the prompt rows up to whole pages; the surplus rows route
+        # through table entries past the lane's real pages (pad page 0)
+        S = krows.shape[2]
+        want = tables.shape[1] * self.pool.page_size
+        if want > S:
+            padw = ((0, 0), (0, 0), (0, want - S), (0, 0), (0, 0))
+            krows = jnp.pad(krows, padw)
+            vrows = jnp.pad(vrows, padw)
+        ck = kvc.write_prompt_pages(ck, tables, krows)
+        cv = kvc.write_prompt_pages(cv, tables, vrows)
+        return ck, cv, next_tok
+
+    def _decode_fn(self, params, ck, cv, tokens, tables, positions,
+                   temps, top_ks, top_ps, rkeys):
+        import jax
+        import jax.numpy as jnp
+
+        self.traces += 1
+        B, T = tables.shape
+        ps = self.pool.page_size
+        eps = self._eps
+        x = params["wte"][tokens] + params["wpe"][positions]
+        # the traced table maps token position -> page: column j of the
+        # gathered view IS position j, so the slot program's mask and
+        # softmax carry over unchanged (bit-exact greedy contract)
+        col = jnp.arange(T * ps)
+        page_idx = (positions // ps).astype(jnp.int32)
+        pages = jnp.take_along_axis(tables, page_idx[:, None], axis=1)[:, 0]
+        offsets = (positions % ps).astype(jnp.int32)
+        for li, blk in enumerate(params["blocks"]):
+            h = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
+            qkv = (h @ blk["qkv_w"] + blk["qkv_b"]).reshape(
+                B, self._heads, 3, self._head_dim)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            ck = kvc.append_token_paged(ck, li, pages, offsets, k)
+            cv = kvc.append_token_paged(cv, li, pages, offsets, v)
+            keys = kvc.gather_pages(ck, li, tables)  # [B, T*ps, h, d]
+            vals = kvc.gather_pages(cv, li, tables)
+            logits = jnp.einsum("bhd,bthd->bht", q, keys) * self._scale
+            mask = col[None, None, :] <= positions[:, None, None]
+            logits = jnp.where(mask, logits, -1e30)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(x.dtype)
+            att = jnp.einsum("bht,bthd->bhd", probs, vals).reshape(
+                B, self._hidden)
+            x = x + att @ blk["out_w"] + blk["out_b"]
+            h2 = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
+            x = x + jax.nn.gelu(h2 @ blk["fc1_w"] + blk["fc1_b"],
+                                approximate=True) @ blk["fc2_w"] + blk["fc2_b"]
+        hfin = _ln(x, params["lnf_w"], params["lnf_b"], eps)
+        next_tok = self._choose_tokens(self._logits_head(params, hfin),
+                                       temps, top_ks, top_ps, rkeys)
+        return ck, cv, next_tok
+
+    # -------------------------------------------------------------- rungs
+    def _prefill_table_cols(self, seq_rung: int) -> int:
+        return -(-int(seq_rung) // self.pool.page_size)
+
+    @property
+    def rungs(self) -> List[tuple]:
+        """``("decode", b, t)`` over (batch × table) rungs plus
+        ``("prefill", b, s)`` over the (batch × seq) grid — the prefill
+        table width is a function of the seq rung, not a third axis."""
+        out = [("decode", b, t) for b in self.decode_rungs
+               for t in self.table_rungs]
+        out += [("prefill", b, s) for b in self.prefill_batch_rungs
+                for s in self.seq_ladder]
+        return out
+
+    def _zero_args(self, key):
+        def sample_args(b):
+            return (np.zeros(b, np.float32), np.zeros(b, np.int32),
+                    np.ones(b, np.float32), np.zeros((b, 2), np.uint32))
+
+        if key[0] == "decode":
+            _, b, t = key
+            return (np.zeros(b, np.int32),          # tokens
+                    np.zeros((b, t), np.int32),     # tables -> pad page
+                    np.zeros(b, np.int32),          # positions
+                    *sample_args(b))
+        _, b, s = key
+        t = self._prefill_table_cols(s)
+        return (np.zeros((b, s), np.int32), np.ones(b, np.int32),
+                np.zeros((b, t), np.int32), *sample_args(b))
+
+    # -------------------------------------------------------------- calls
+    def prefill(self, ck, cv, tokens, lengths, tables,
+                temps, top_ks, top_ps, rkeys):
+        key = ("prefill", int(tokens.shape[0]), int(tokens.shape[1]))
+        args = (tokens, lengths, tables, temps, top_ks, top_ps, rkeys)
+        ex = self._aot.get(key)
+        if ex is not None:
+            return ex(self.params, ck, cv, *args)
+        return self._jit_prefill(self.params, ck, cv, *args)
+
+    def decode(self, ck, cv, tokens, tables, positions,
+               temps, top_ks, top_ps, rkeys):
+        key = ("decode", int(tokens.shape[0]), int(tables.shape[1]))
+        args = (tokens, tables, positions, temps, top_ks, top_ps, rkeys)
+        ex = self._aot.get(key)
+        if ex is not None:
+            return ex(self.params, ck, cv, *args)
+        return self._jit_decode(self.params, ck, cv, *args)
+
+
 class DecodeEngine(EngineBase):
     """GPT decode serving with true continuous batching.
 
     ``model`` is a live ``models.gpt.GPTForCausalLM`` (eval mode; its
     device weights are shared zero-copy with training/export users).
-    Requests (:meth:`submit`) borrow a KV slot, join the running batch at
-    the next step boundary, and leave the step they finish — the
-    :class:`~.scheduler.DecodeScheduler` runs ONE prefill-or-decode
-    program call per step against the warmed rung set, so
-    ``compiles_after_warmup == 0`` holds under any mix of prefill and
-    decode traffic (JX330), the KV pool footprint never moves after
-    warmup (JX332), and emitted tokens are bit-exact with a
+    Requests (:meth:`submit`) join the running batch at the next step
+    boundary and leave the step they finish — the scheduler runs ONE
+    prefill-or-decode program call per step against the warmed rung
+    set, so ``compiles_after_warmup == 0`` holds under any mix of
+    prefill and decode traffic (JX330), the KV pool footprint never
+    moves after warmup (JX332), and greedy tokens are bit-exact with a
     single-request decode of the same prompt.
+
+    Two KV residency modes (``kv_mode``):
+
+    - ``"paged"`` (default, ISSUE 18): a :class:`~.kv_cache.KVPagePool`
+      holds fixed-size pages; each request owns only the pages its live
+      tokens fill, named by a per-request block table that rides the
+      compiled programs as TRACED int32 data — one executable per
+      (batch rung × table rung), any page map. Mixed 128–4k contexts
+      stop stranding worst-case rows, admission waits for pages instead
+      of shedding, and sampled decoding (``temperature``/``top_k``/
+      ``top_p``/``seed`` on :meth:`submit`) draws from a per-request
+      PRNG stream that is deterministic per seed and independent of
+      batch composition.
+    - ``"slots"`` (PR 13): one full ``max_seq`` row per request — the
+      greedy bit-exact oracle the paged mode is audited against.
     """
 
     def __init__(self, model, *,
@@ -395,6 +611,9 @@ class DecodeEngine(EngineBase):
                  prefill_max_batch: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  kv_dtype: str = "float32",
+                 kv_mode: str = "paged",
+                 page_size: Optional[int] = None,
+                 pool_pages: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  tenant_quota: Optional[int] = None,
                  request_ttl_ms: Optional[float] = None,
@@ -406,6 +625,9 @@ class DecodeEngine(EngineBase):
                          request_ttl_ms=request_ttl_ms,
                          serve_telemetry_port=serve_telemetry_port,
                          stats=stats)
+        if kv_mode not in ("paged", "slots"):
+            raise ValueError(f"kv_mode must be 'paged' or 'slots', "
+                             f"got {kv_mode!r}")
         cfg = model.config
         max_slots = int(get_flag("serving_max_slots")
                         if max_slots is None else max_slots)
@@ -429,23 +651,49 @@ class DecodeEngine(EngineBase):
         if seq_buckets[-1] > max_seq:
             raise ValueError(f"seq bucket {seq_buckets[-1]} exceeds "
                              f"max_seq {max_seq}")
-        self.kv_pool = KVSlotPool(
-            cfg.num_hidden_layers, max_slots, max_seq,
-            cfg.num_attention_heads, cfg.head_dim, dtype=kv_dtype)
-        self.programs = DecodePrograms(
-            model, self.kv_pool,
-            seq_ladder=seq_buckets,
-            prefill_batch_rungs=powers_of_two_buckets(1, prefill_max),
-            decode_rungs=powers_of_two_buckets(1, max_slots))
+        self.kv_mode = kv_mode
+        self.max_slots = max_slots  # max concurrent lanes in either mode
         self.eos_id = eos_id
         self._model = model  # the weight source swap_weights re-extracts
         from ..reliability.policy import RetryPolicy
 
-        self._scheduler = DecodeScheduler(
-            self.queue, self.programs, self.kv_pool,
-            prefill_max_batch=prefill_max, eos_id=eos_id, stats=stats,
-            retry=RetryPolicy("serving.decode_step"),
-            breakers=self.breakers)
+        retry = RetryPolicy("serving.decode_step")
+        if kv_mode == "slots":
+            self.kv_pool = KVSlotPool(
+                cfg.num_hidden_layers, max_slots, max_seq,
+                cfg.num_attention_heads, cfg.head_dim, dtype=kv_dtype)
+            self.programs = DecodePrograms(
+                model, self.kv_pool,
+                seq_ladder=seq_buckets,
+                prefill_batch_rungs=powers_of_two_buckets(1, prefill_max),
+                decode_rungs=powers_of_two_buckets(1, max_slots))
+            self._scheduler = DecodeScheduler(
+                self.queue, self.programs, self.kv_pool,
+                prefill_max_batch=prefill_max, eos_id=eos_id, stats=stats,
+                retry=retry, breakers=self.breakers)
+        else:
+            ps = int(get_flag("serving_page_size")
+                     if page_size is None else page_size)
+            n_pages = int(get_flag("serving_pool_pages")
+                          if pool_pages is None else pool_pages)
+            if n_pages <= 0:
+                # equal-bytes default: the token capacity the slot pool
+                # this replaces would have held (max_slots full rows)
+                n_pages = -(-max_slots * max_seq // ps)
+            self.kv_pool = KVPagePool(
+                cfg.num_hidden_layers, n_pages, ps,
+                cfg.num_attention_heads, cfg.head_dim, dtype=kv_dtype)
+            self.programs = PagedDecodePrograms(
+                model, self.kv_pool,
+                seq_ladder=seq_buckets,
+                prefill_batch_rungs=powers_of_two_buckets(1, prefill_max),
+                decode_rungs=powers_of_two_buckets(1, max_slots),
+                max_seq=max_seq)
+            self._scheduler = PagedDecodeScheduler(
+                self.queue, self.programs, self.kv_pool,
+                max_lanes=max_slots, prefill_max_batch=prefill_max,
+                eos_id=eos_id, stats=stats, retry=retry,
+                breakers=self.breakers)
 
     # ------------------------------------------------------------ lifecycle
     def warmup(self) -> "DecodeEngine":
@@ -458,21 +706,41 @@ class DecodeEngine(EngineBase):
         return self
 
     # ------------------------------------------------------------- serving
-    def submit(self, tenant: str, prompt,
-               max_new_tokens: int = 16) -> DecodeRequest:
+    def submit(self, tenant: str, prompt, max_new_tokens: int = 16,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: int = 0) -> DecodeRequest:
         """Enqueue one generation request; returns the future. The prompt
         must fit the seq ladder; generation stops at ``max_new_tokens``,
-        the engine's ``eos_id``, or the slot's ``max_seq`` capacity —
-        whichever comes first."""
+        the engine's ``eos_id``, or the ``max_seq`` capacity — whichever
+        comes first.
+
+        ``temperature == 0`` (default) decodes greedily — the bit-exact
+        audit mode. A positive temperature samples with optional top-k /
+        top-p truncation from the request's own PRNG stream (``seed``):
+        deterministic per seed, independent of batch composition. The
+        sampling knobs ride the compiled programs as traced data (paged
+        engines); a slots-mode engine serves greedy only."""
+        if self.kv_mode == "slots" and temperature > 0:
+            raise ValueError("sampled decoding needs kv_mode='paged'; "
+                             "the slot-pool engine is the greedy oracle")
         if not self._started:
             raise RuntimeError("engine not started: call warmup() first")
-        req = DecodeRequest(tenant, prompt, max_new_tokens)
+        req = DecodeRequest(tenant, prompt, max_new_tokens,
+                            temperature=temperature, top_k=top_k,
+                            top_p=top_p, seed=seed)
         top = self.programs.seq_ladder[-1]
         if req.prompt.size > top:
             raise ValueError(
                 f"prompt of {req.prompt.size} tokens exceeds the largest "
                 f"seq bucket ({top}); raise FLAGS_serving_max_seq or the "
                 "seq ladder")
+        if self.kv_mode == "paged":
+            need = -(-int(req.prompt.size) // self.kv_pool.page_size)
+            if need > self.kv_pool.num_pages:
+                raise ValueError(
+                    f"prompt needs {need} KV pages but the pool holds "
+                    f"{self.kv_pool.num_pages} total; it could never be "
+                    "admitted — raise FLAGS_serving_pool_pages")
         self.tenant(tenant)
         return self.queue.submit(req)
 
@@ -553,10 +821,19 @@ class DecodeEngine(EngineBase):
     def telemetry_health(self) -> dict:
         health = super().telemetry_health()
         health.update(
-            kv_slots_in_use=self.kv_pool.in_use(),
-            kv_slots=self.kv_pool.max_slots,
+            kv_slots=self.max_slots,
             active_requests=self.active_requests(),
         )
+        if self.kv_mode == "paged":
+            health.update(
+                kv_mode="paged",
+                kv_pages=self.kv_pool.num_pages,
+                kv_page_size=self.kv_pool.page_size,
+                kv_pages_in_use=self.kv_pool.in_use(),
+            )
+        else:
+            health.update(kv_mode="slots",
+                          kv_slots_in_use=self.kv_pool.in_use())
         return health
 
     def serving_report(self) -> dict:
@@ -574,6 +851,17 @@ class DecodeEngine(EngineBase):
             kv_pool_bytes_constant=(
                 self.kv_pool.bytes_at_warmup is None
                 or self.kv_pool.device_bytes() == self.kv_pool.bytes_at_warmup),
-            kv_slots=self.kv_pool.max_slots,
+            kv_slots=self.max_slots,
+            kv_mode=self.kv_mode,
         )
+        if self.kv_mode == "paged":
+            util = self.kv_pool.utilization_report()
+            report.update(
+                table_rungs=list(self.programs.table_rungs),
+                kv_pages=self.kv_pool.num_pages,
+                kv_page_size=self.kv_pool.page_size,
+                kv_pages_in_use=self.kv_pool.in_use(),
+                kv_pool_utilization=round(util["mean"], 4),
+                kv_shed_requests=self._scheduler.shed_count,
+            )
         return report

@@ -24,14 +24,15 @@ jitted programs and carry no python state.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Iterable, List, Optional
 
 import numpy as np
 
 from ..observability.locks import named_lock
 
-__all__ = ["KVSlotPool", "write_prompt", "write_prompt_batch",
-           "append_token"]
+__all__ = ["KVSlotPool", "KVPagePool", "write_prompt",
+           "write_prompt_batch", "append_token", "write_prompt_pages",
+           "append_token_paged", "gather_pages"]
 
 
 # ------------------------------------------------------ functional updates
@@ -66,6 +67,40 @@ def append_token(cache, layer, slot_ids, positions, rows):
     point at the pool's pad slot so the scatter needs no mask."""
     return cache.at[layer, slot_ids, positions].set(
         rows.astype(cache.dtype))
+
+
+# ------------------------------------------------- paged functional updates
+def write_prompt_pages(cache, tables, rows):
+    """Batched paged prefill write: ``rows`` is ``[layers, B, T*ps,
+    heads, dim]`` (prompt K/V padded up to whole pages), ``tables`` is
+    the traced ``[B, T]`` int32 block table — one scatter over the page
+    axis covering every layer. Table entries past a lane's real pages
+    are 0 (the pad page), so garbage rows land in the trash page and a
+    padded program call never touches live state."""
+    L, B, _, H, D = rows.shape
+    T = tables.shape[1]
+    ps = cache.shape[2]
+    paged = rows.astype(cache.dtype).reshape(L, B, T, ps, H, D)
+    return cache.at[:, tables].set(paged)
+
+
+def append_token_paged(cache, layer, pages, offsets, rows):
+    """One decode step's paged write for one layer: ``rows`` is ``[B,
+    heads, dim]`` landing at ``(layer, pages[b], offsets[b])`` where
+    ``pages[b] = table[b, pos // page_size]`` and ``offsets[b] = pos %
+    page_size`` — both traced. Pad lanes carry page 0."""
+    return cache.at[layer, pages, offsets].set(rows.astype(cache.dtype))
+
+
+def gather_pages(cache, layer, tables):
+    """Materialize a batch's contiguous K (or V) view from the page
+    array: ``cache[layer][tables]`` gathers ``[B, T, ps, heads, dim]``
+    along the page axis and reshapes to ``[B, T*ps, heads, dim]`` — the
+    traced-block-table read the decode attention indexes through. One
+    compiled program serves ANY page map because the table is data."""
+    B, T = tables.shape
+    ps, H, D = cache.shape[2], cache.shape[3], cache.shape[4]
+    return cache[layer][tables].reshape(B, T * ps, H, D)
 
 
 # --------------------------------------------------------------- the pool
@@ -185,3 +220,167 @@ class KVSlotPool:
             "KV cache slots currently allocated to live decode sequences "
             "(capacity = FLAGS_serving_max_slots)").set(
                 self.max_slots - len(self._free))
+
+
+# ---------------------------------------------------------- the page pool
+class KVPagePool:
+    """Free-list *page* allocator over one device-resident K/V buffer
+    pair shaped ``[layers, num_pages+1, page_size, heads, head_dim]``.
+
+    The vLLM discipline applied to the slot pool above: instead of one
+    full ``max_seq`` row per sequence, a request holds only the fixed-
+    size pages its live tokens occupy, named by a per-request *block
+    table* (a list of page ids, traced as an int32 array inside the
+    decode programs). Page 0 is the pad page — bucket-padding lanes and
+    table padding both point there, so scatters and gathers need no
+    mask. Mixed 128–4k contexts share one pool whose residency tracks
+    live tokens, not the per-request worst case.
+
+    The host side mirrors :class:`KVSlotPool`: ``alloc``/``release`` on
+    the scheduler thread under a lock, :meth:`commit` swapping in the
+    jitted programs' functional outputs under donation, and
+    :meth:`device_bytes` frozen after :meth:`mark_warm` (the JX332
+    audit and the bench's ``kv_pool_bytes_constant`` proof duck-type
+    both pools). :meth:`note_utilization` feeds the JX334
+    page-fragmentation watermark."""
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 num_heads: int, head_dim: int, dtype="float32"):
+        import jax.numpy as jnp
+
+        if num_pages < 1:
+            raise ValueError("KVPagePool needs at least one page")
+        if page_size < 1 or (page_size & (page_size - 1)):
+            raise ValueError(
+                f"page_size must be a power of two, got {page_size}")
+        self.num_layers = int(num_layers)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        # +1: page 0 is the pad page — never allocated, absorbs garbage
+        shape = (self.num_layers, self.num_pages + 1, self.page_size,
+                 self.num_heads, self.head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        # low page ids hand out first: pop() from the tail
+        self._free: List[int] = list(range(self.num_pages, 0, -1))
+        self._lock = named_lock("serving.kv_pool")
+        self.bytes_at_warmup: Optional[int] = None
+        self._util_sum = 0.0
+        self._util_min = 1.0
+        self._util_samples = 0
+        self._gauge_occupancy()
+
+    # ------------------------------------------------------------ pages
+    @property
+    def pad_page(self) -> int:
+        """The trash page padded lanes and table padding point at."""
+        return 0
+
+    def alloc(self, n: int = 1) -> List[int]:
+        """Borrow ``n`` free pages; raises ``RuntimeError`` when the
+        pool cannot cover the request — the caller (scheduler) sheds
+        that ONE request and releases any pages it already holds, so an
+        allocation failure never leaks and never touches other lanes.
+        The ``kv.page_alloc`` fault site lives here: an injected
+        failure exercises exactly that shed path."""
+        from ..reliability.faults import fault_point
+
+        fault_point("kv.page_alloc")
+        with self._lock:
+            if len(self._free) < n:
+                raise RuntimeError(
+                    f"KV page pool exhausted ({self.num_pages - len(self._free)}"
+                    f"/{self.num_pages} pages in use, {n} requested); "
+                    "admission must wait for a retirement")
+            pages = [self._free.pop() for _ in range(n)]
+        self._gauge_occupancy()
+        return pages
+
+    def release(self, pages: Iterable[int]) -> None:
+        """Return a request's pages to the free list (idempotence and
+        range guarded per page)."""
+        with self._lock:
+            for page in pages:
+                page = int(page)
+                if not 1 <= page <= self.num_pages:
+                    raise ValueError(f"page {page} out of range")
+                if page in self._free:
+                    raise ValueError(f"page {page} is already free")
+                self._free.append(page)
+        self._gauge_occupancy()
+
+    def in_use(self) -> int:
+        with self._lock:
+            return self.num_pages - len(self._free)
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    # ------------------------------------------------------------ buffers
+    def commit(self, new_k, new_v) -> None:
+        """Swap in the post-step buffers — same contract as
+        :meth:`KVSlotPool.commit`: footprint pinned, ``kv.commit``
+        fault rejects BEFORE assignment, numerics witness on keys."""
+        from ..reliability.faults import fault_point
+
+        fault_point("kv.commit")
+        if (new_k.shape != self.k.shape or new_v.shape != self.v.shape
+                or new_k.dtype != self.k.dtype):
+            raise ValueError(
+                f"KV commit changed the pool footprint: "
+                f"{self.k.shape}/{self.k.dtype} -> "
+                f"{new_k.shape}/{new_k.dtype}")
+        self.k = new_k
+        self.v = new_v
+        from ..observability import numerics
+
+        numerics.watch("serving.kv_commit", new_k)
+
+    def device_bytes(self) -> int:
+        return int(self.k.nbytes) + int(self.v.nbytes)
+
+    def mark_warm(self) -> None:
+        """Freeze the footprint baseline (end of engine warmup): any
+        later :meth:`device_bytes` drift is a JX332 error."""
+        self.bytes_at_warmup = self.device_bytes()
+
+    # ------------------------------------------------------ observability
+    def note_utilization(self, live_tokens: int) -> None:
+        """Record one page-utilization sample: live tokens over the
+        token capacity of the pages currently in use. Sampled by the
+        scheduler each decode step; the running mean/min feed the JX334
+        fragmentation watermark and the utilization gauge."""
+        with self._lock:
+            used = self.num_pages - len(self._free)
+        if used <= 0:
+            return
+        util = min(1.0, float(live_tokens) / float(used * self.page_size))
+        self._util_sum += util
+        self._util_min = min(self._util_min, util)
+        self._util_samples += 1
+        from ..observability.metrics import registry
+
+        registry.gauge(
+            "serving.kv_page_utilization",
+            "live tokens / token capacity of in-use KV pages — low "
+            "values mean fragmentation (JX334)").set(util)
+
+    def utilization_report(self) -> dict:
+        n = self._util_samples
+        return {
+            "samples": n,
+            "mean": (self._util_sum / n) if n else 1.0,
+            "min": self._util_min if n else 1.0,
+        }
+
+    def _gauge_occupancy(self) -> None:
+        from ..observability.metrics import registry
+
+        registry.gauge(
+            "serving.kv_pages_in_use",
+            "KV cache pages currently allocated to live decode "
+            "sequences (capacity = pool num_pages)").set(
+                self.num_pages - len(self._free))

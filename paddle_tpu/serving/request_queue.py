@@ -126,9 +126,12 @@ class DecodeRequest(Request):
     ``max_new_tokens`` or the engine's EOS). ``n`` is 1 — admission is
     denominated in slots for the decode tier."""
 
-    __slots__ = ("prompt", "max_new_tokens", "generated", "slot", "seq_rung")
+    __slots__ = ("prompt", "max_new_tokens", "generated", "slot", "seq_rung",
+                 "pages", "temperature", "top_k", "top_p", "seed")
 
-    def __init__(self, tenant: str, prompt, max_new_tokens: int):
+    def __init__(self, tenant: str, prompt, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0):
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("decode request needs a non-empty prompt")
@@ -138,6 +141,13 @@ class DecodeRequest(Request):
         self.generated: List[int] = []
         self.slot = None          # KV slot, assigned at admission-to-slot
         self.seq_rung = None      # prefill seq-ladder rung (scheduler set)
+        self.pages: List[int] = []  # block table (paged pools only)
+        # sampling knobs ride the programs as traced DATA (never a
+        # retrace); temperature 0 = greedy, the bit-exact audit mode
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
 
     @property
     def position(self) -> int:
@@ -342,7 +352,8 @@ class RequestQueue:
                        "is refused, not executed"))
 
     def take_slots(self, max_requests: int,
-                   timeout: Optional[float] = None) -> List[Request]:
+                   timeout: Optional[float] = None,
+                   budget_fn=None) -> List[Request]:
         """Decode-scheduler side: pop up to ``max_requests`` pending
         requests in (priority tier, FIFO) order — the slot-admission path
         of the continuous-batching loop. Interactive-tier requests go
@@ -350,7 +361,13 @@ class RequestQueue:
         admission); within a tier FIFO order holds. TTL-overdue requests
         are expired first, never handed out. Returns ``[]`` on
         timeout/closed-empty; with ``timeout`` of 0/None it never blocks
-        (the decode loop polls between steps)."""
+        (the decode loop polls between steps).
+
+        ``budget_fn(request) -> bool`` is the paged pools' admission
+        gate: taking STOPS at the first request it refuses (the request
+        stays queued, and nothing behind it jumps ahead — a page-budget
+        wait must not become a reorder), so a request that merely has to
+        wait for a retirement is never shed."""
         if max_requests <= 0:
             return []
         with self._cond:
@@ -369,6 +386,15 @@ class RequestQueue:
                 range(len(self._dq)),
                 key=lambda i: (self.admission.tier_of(self._dq[i].tenant), i))
             chosen = order[:int(max_requests)]
+            if budget_fn is not None:
+                fits = 0
+                for i in chosen:
+                    if not budget_fn(self._dq[i]):
+                        break
+                    fits += 1
+                chosen = chosen[:fits]
+                if not chosen:
+                    return []
             # returned in PRIORITY order (interactive lanes anchor prefill
             # grouping); the survivors keep their FIFO deque order
             taken = [self._dq[i] for i in chosen]

@@ -358,12 +358,18 @@ class DecodeScheduler:
                 for tenant in {r.tenant for r in involved}:
                     self.breakers.record_failure(tenant)
             for r in involved:
-                if r.slot is not None:
-                    self._active.pop(r.slot, None)
-                    self.pool.release(r.slot)
-                    r.slot = None
+                self._free_lane(r)
                 self.queue.admission.on_complete(r.tenant, r.n)
                 r._fail(e)
+
+    def _free_lane(self, r) -> None:
+        """Detach one request from its KV residency — the single cleanup
+        path the fault wall and retirement share (slot pools release the
+        slot; paged pools release the block table's pages)."""
+        if r.slot is not None:
+            self._active.pop(r.slot, None)
+            self.pool.release(r.slot)
+            r.slot = None
 
     def _program_call(self, fn):
         """One prefill/decode program call through the fault point and
@@ -477,9 +483,7 @@ class DecodeScheduler:
     def _retire(self, r) -> None:
         from ..observability.anomaly import monitor
 
-        self._active.pop(r.slot, None)
-        self.pool.release(r.slot)
-        r.slot = None
+        self._free_lane(r)
         self.queue.admission.on_complete(r.tenant, r.n)
         r._complete(np.asarray(r.generated, np.int32))
         if self.stats is not None:
@@ -489,3 +493,260 @@ class DecodeScheduler:
             monitor.on_serving_request(
                 r.t_complete - r.t_enqueue, r.t_dispatch - r.t_admit,
                 tenant=r.tenant)
+
+class PagedDecodeScheduler(DecodeScheduler):
+    """The decode loop over a :class:`~.kv_cache.KVPagePool`.
+
+    Same one-program-call-per-beat shape as the slot scheduler; what
+    changes is the residency model:
+
+    - admission is gated on LANES (the batch ladder's width) and on the
+      page budget — a taken request allocates ``ceil(prompt/page_size)``
+      pages up front, and an allocation failure (pool pressure or an
+      injected ``kv.page_alloc`` fault) sheds exactly that request with
+      ``AdmissionError(reason="kv_pages")``: its pages release, every
+      other lane keeps serving.
+    - before each decode step, lanes crossing a page boundary grow
+      their block table by one page (:meth:`_ensure_pages`) through the
+      same fault site and the same single-request shed path.
+    - the program call carries the batch's block tables as ONE traced
+      int32 array padded to the (batch × table) rung — page maps are
+      data, so churn never retraces — plus the per-lane sampling
+      arguments (temperature/top-k/top-p/PRNG key pair).
+    - retirement releases the request's pages; the pool's utilization
+      watermark (JX334) samples live tokens against in-use pages each
+      step.
+    """
+
+    def __init__(self, queue: RequestQueue, programs, pool, *,
+                 max_lanes: int, prefill_max_batch: int,
+                 eos_id: Optional[int] = None, stats=None,
+                 on_step: Optional[Callable] = None, retry=None,
+                 breakers=None):
+        super().__init__(queue, programs, pool,
+                         prefill_max_batch=prefill_max_batch,
+                         eos_id=eos_id, stats=stats, on_step=on_step,
+                         retry=retry, breakers=breakers)
+        self.max_lanes = max(int(max_lanes), 1)
+        self.max_seq = int(programs.max_seq)
+        # _active is keyed by request id here (no slot identity exists)
+        self.shed_count = 0
+        self._starved = set()  # lane ids waiting on a page (gate admission)
+
+    # ---------------------------------------------------------- admission
+    def _admit_and_step(self, monitor) -> bool:
+        free = self.max_lanes - self.active_count()
+        # starved active lanes get first claim on freed pages: admitting
+        # new prompts while a running lane waits for growth would steal
+        # its pages and starve it forever
+        if free > 0 and self.pool.free_count() > 0 and not self._starved:
+            idle = not self._active and not self._pending
+            # page-budget admission gate: a request is taken only when
+            # its PROMPT pages fit the free list right now — one that
+            # merely has to wait for a retirement stays queued (FIFO,
+            # never shed); growth past the prompt is overcommitted by
+            # design and sheds only on true mid-flight exhaustion
+            budget = [self.pool.free_count()]
+
+            def fits(r):
+                need = -(-int(r.prompt.size) // self.pool.page_size)
+                if need > budget[0]:
+                    return False
+                budget[0] -= need
+                return True
+
+            taken = self.queue.take_slots(
+                free, timeout=0.05 if idle else 0.0, budget_fn=fits)
+            now = time.perf_counter()
+            for r in taken:
+                r.seq_rung = self._seq_rung(r)
+                r.t_dispatch = now
+                need = -(-int(r.prompt.size) // self.pool.page_size)
+                try:
+                    r.pages = self.pool.alloc(need)
+                except Exception as e:  # noqa: BLE001 — shed, don't crash
+                    self._shed(r, e)
+                    continue
+                self._pending.append(r)
+        if self._pending:
+            self._guarded(self._prefill_step, monitor)
+            return True
+        if self._active:
+            self._guarded(self._decode_step, monitor)
+            return True
+        return False
+
+    def _shed(self, r, cause) -> None:
+        """Page-allocation failure sheds ONE request: its pages return
+        to the pool (no leak — the JX333 audit stays clean), its future
+        fails with ``AdmissionError(reason="kv_pages")``, and every
+        other lane keeps decoding."""
+        from .request_queue import AdmissionError
+
+        self._free_lane(r)
+        self.queue.admission.on_complete(r.tenant, r.n)
+        if self.breakers is not None:
+            self.breakers.record_failure(r.tenant)
+        self.shed_count += 1
+        try:
+            from ..observability.metrics import registry
+
+            registry.counter(
+                "serving.kv_page_shed",
+                "decode requests shed because a KV page allocation "
+                "failed (pool pressure or injected kv.page_alloc "
+                "fault)").inc()
+        except Exception:
+            pass
+        r._fail(AdmissionError(
+            "kv_pages",
+            f"request {r.id} shed: KV page allocation failed ({cause})"))
+
+    def _free_lane(self, r) -> None:
+        self._active.pop(r.id, None)
+        if r.pages:
+            self.pool.release(r.pages)
+            r.pages = []
+
+    def _ensure_pages(self, lanes):
+        """Grow each lane's block table to cover its next write position.
+        Returns the lanes ready to step. An INJECTED ``kv.page_alloc``
+        fault sheds its lane (the chaos contract: prove the shed path).
+        Natural exhaustion is gentler: the starved lane simply sits out
+        this step — it keeps its pages and retries next beat, by which
+        time a retirement has usually freed some. Only when EVERY active
+        lane is starved (no retirement can ever come) does the deadlock
+        breaker shed the youngest starved lane, freeing its pages for
+        the older ones — guaranteed progress, FIFO-fair."""
+        from ..reliability.faults import FaultInjection
+
+        ready, starved = [], []
+        for r in lanes:
+            need = int(r.position) // self.pool.page_size + 1
+            try:
+                while len(r.pages) < need:
+                    r.pages.extend(self.pool.alloc(1))
+            except FaultInjection as e:
+                self._shed(r, e)
+                continue
+            except Exception:  # noqa: BLE001 — natural pressure: wait
+                starved.append(r)
+                continue
+            ready.append(r)
+        if not ready and starved and not self._pending:
+            victim = max(starved, key=lambda r: r.id)
+            starved.remove(victim)
+            self._shed(victim, RuntimeError(
+                "page pool deadlocked: every active lane needs a page "
+                "and none can retire"))
+        self._starved = {r.id for r in starved}
+        return ready
+
+    # -------------------------------------------------------------- steps
+    def _sample_args(self, lanes, b_rung: int):
+        """The per-lane sampling arguments of one program call. The PRNG
+        key is ``[request_seed, generated_token_index]`` — a pure
+        function of the request, never of batch composition, so sampled
+        streams are deterministic per seed under any join/leave order.
+        Pad lanes carry temperature 0 (the cheap greedy branch)."""
+        temps = np.zeros(b_rung, np.float32)
+        top_ks = np.zeros(b_rung, np.int32)
+        top_ps = np.ones(b_rung, np.float32)
+        rkeys = np.zeros((b_rung, 2), np.uint32)
+        for i, r in enumerate(lanes):
+            temps[i] = r.temperature
+            top_ks[i] = r.top_k
+            top_ps[i] = r.top_p
+            rkeys[i] = (np.uint32(r.seed & 0xFFFFFFFF),
+                        np.uint32(len(r.generated)))
+        return temps, top_ks, top_ps, rkeys
+
+    def _prefill_step(self) -> None:
+        from ..jit.bucketing import bucket_for
+        from ..observability.tracing import tracer
+
+        rung = self._pending[0].seq_rung  # oldest request anchors the rung
+        group = [r for r in self._pending
+                 if r.seq_rung == rung][: self.prefill_max_batch]
+        for r in group:
+            self._pending.remove(r)
+        self._step_lanes = list(group)  # the fault wall's blast radius
+        b_rung = bucket_for(len(group), self.programs.prefill_batch_rungs)
+        t_cols = self.programs._prefill_table_cols(rung)
+        tokens = np.zeros((b_rung, rung), np.int32)
+        lengths = np.ones(b_rung, np.int32)
+        tables = np.zeros((b_rung, t_cols), np.int32)  # 0 = pad page
+        for i, r in enumerate(group):
+            L = int(r.prompt.size)
+            tokens[i, :L] = r.prompt
+            lengths[i] = L
+            tables[i, :len(r.pages)] = r.pages
+        t0 = time.perf_counter()
+        with tracer.span("serving.decode", track="serving.scheduler",
+                         kind="prefill", rung=(b_rung, rung),
+                         lanes=len(group)):
+            ck, cv, toks = self._program_call(lambda: self.programs.prefill(
+                self.pool.k, self.pool.v, tokens, lengths, tables,
+                *self._sample_args(group, b_rung)))
+            self.pool.commit(ck, cv)
+            toks = np.asarray(toks)
+        self._absorb(group, toks, kind="prefill",
+                     seconds=time.perf_counter() - t0, rung=(b_rung, rung))
+
+    def _decode_step(self) -> None:
+        from ..jit.bucketing import bucket_for
+        from ..observability.tracing import tracer
+
+        lanes = sorted(self._active.values(), key=lambda r: r.id)
+        lanes = self._ensure_pages(lanes)
+        if not lanes:
+            return
+        self._step_lanes = list(lanes)  # the fault wall's blast radius
+        b_rung = bucket_for(len(lanes), self.programs.decode_rungs)
+        t_rung = bucket_for(max(len(r.pages) for r in lanes),
+                            self.programs.table_rungs)
+        tokens = np.zeros(b_rung, np.int32)
+        tables = np.zeros((b_rung, t_rung), np.int32)  # 0 = pad page
+        positions = np.zeros(b_rung, np.int32)
+        for i, r in enumerate(lanes):
+            tokens[i] = r.generated[-1]
+            tables[i, :len(r.pages)] = r.pages
+            positions[i] = r.position
+        t0 = time.perf_counter()
+        with tracer.span("serving.decode", track="serving.scheduler",
+                         kind="decode", rung=(b_rung, t_rung),
+                         lanes=len(lanes)):
+            ck, cv, toks = self._program_call(lambda: self.programs.decode(
+                self.pool.k, self.pool.v, tokens, tables, positions,
+                *self._sample_args(lanes, b_rung)))
+            self.pool.commit(ck, cv)
+            toks = np.asarray(toks)
+        self._absorb(lanes, toks, kind="decode",
+                     seconds=time.perf_counter() - t0, rung=(b_rung, t_rung))
+
+    def _absorb(self, lanes, toks, *, kind: str, seconds: float,
+                rung) -> None:
+        self._step_lanes = []  # the call succeeded: nothing to fail
+        if self.breakers is not None:
+            for tenant in {r.tenant for r in lanes}:
+                self.breakers.record_success(tenant)
+        for i, r in enumerate(lanes):
+            tok = int(toks[i])
+            r.generated.append(tok)
+            done = (len(r.generated) >= r.max_new_tokens
+                    or (self.eos_id is not None and tok == self.eos_id)
+                    or r.position >= self.max_seq)
+            if done:
+                self._retire(r)
+            else:
+                self._active[r.id] = r
+        live_tokens = sum(int(r.prompt.size) + len(r.generated)
+                          for r in self._active.values())
+        self.pool.note_utilization(live_tokens)
+        if self.stats is not None:
+            self.stats.record_decode_step(kind, seconds, len(lanes),
+                                          len(lanes))
+            self.stats.record_slot_occupancy(self.active_count(),
+                                             self.max_lanes)
+        if self.on_step is not None:
+            self.on_step(kind, len(lanes), rung, len(lanes))

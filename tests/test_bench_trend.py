@@ -250,6 +250,47 @@ class TestZero1Track:
         assert verdict["ok"] is True and "single parsed" in verdict["reason"]
 
 
+class TestKvPoolUtilizationTrack:
+    """ISSUE 18 satellite: the paged-KV pool's live-token share of
+    allocated page bytes (bench extras.serving.kv_pool_utilization)
+    rides the extras trajectory as a HIGHER_IS_BETTER gate — a drop
+    means fragmentation started stranding HBM again."""
+
+    PATH = "serving.kv_pool_utilization"
+
+    def _run_with_serving(self, dirpath, n, util):
+        _write_run(dirpath, n, parsed_override={
+            "metric": DEFAULT_METRIC, "value": 20000.0,
+            "unit": "tokens/sec", "note": "cpu_fallback",
+            "serving": {"decode_tokens_per_sec": 500.0,
+                        "kv_pool_utilization": util}})
+
+    def test_utilization_is_a_higher_is_better_default_extra(self):
+        from tools.bench_trend import LOWER_IS_BETTER
+
+        assert self.PATH in DEFAULT_EXTRAS
+        assert self.PATH not in LOWER_IS_BETTER
+
+    def test_fragmentation_collapse_gates(self, tmp_path):
+        self._run_with_serving(str(tmp_path), 1, 0.74)
+        self._run_with_serving(str(tmp_path), 2, 0.78)
+        rows = load_trajectory(str(tmp_path), extract=self.PATH)
+        assert [r["value"] for r in rows] == [0.74, 0.78]
+        assert main(["--dir", str(tmp_path)]) == 0
+        # pages sitting mostly empty again (page size regression, leak)
+        self._run_with_serving(str(tmp_path), 3, 0.3)
+        assert main(["--dir", str(tmp_path)]) == 1
+
+    def test_repo_history_tolerates_absent_utilization(self, tmp_path):
+        """Pre-ISSUE-18 rounds carry extras.serving without the pool
+        key: absent rows, no gate until two rounds carry it."""
+        _write_run(str(tmp_path), 1, 20000.0)
+        self._run_with_serving(str(tmp_path), 2, 0.74)
+        verdict = judge(load_trajectory(str(tmp_path), extract=self.PATH),
+                        0.20)
+        assert verdict["ok"] is True and "single parsed" in verdict["reason"]
+
+
 class TestConcurrencyLintKeys:
     """ISSUE 16 satellite: extras.lint gains the concurrency family's
     static-scan wall time and the witness's per-acquire overhead. They

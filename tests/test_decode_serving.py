@@ -155,7 +155,7 @@ class TestContinuousDecode:
         assert engine.kv_pool.device_bytes() == bytes0
         assert engine.kv_pool.in_use() == 0      # every slot came back
         dec = engine.stats.summary()["decode"]
-        assert dec["slot_occupancy_peak"] == engine.kv_pool.max_slots
+        assert dec["slot_occupancy_peak"] == engine.max_slots
 
     def test_requests_join_and_leave_midflight(self, engine):
         """Staggered arrivals ride the running batch: a request submitted

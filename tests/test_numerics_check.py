@@ -128,6 +128,74 @@ def test_nm1103_fp32_dot_clean():
     assert _codes(audit_jaxpr_numerics(closed)) == []
 
 
+def test_nm1103_priced_severity_tracks_program_share():
+    """The priced path (ISSUE 18): the SAME narrow dot is a warning when
+    its widened result dominates the program's traffic and an error when
+    it is buried in other traffic — the fix is cheap there."""
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.ShapeDtypeStruct((8, 8), jnp.bfloat16)
+    lone = jax.make_jaxpr(jnp.matmul)(sds, sds)
+    f = [x for x in audit_jaxpr_numerics(lone) if x.code == "NM1103"]
+    # the 8x8 dot IS the program: +128B on ~384B of traffic, share 1/3
+    assert len(f) == 1 and f[0].severity == "warning"
+    assert "128" in f[0].message
+
+    ballast = jax.ShapeDtypeStruct((64, 1024), jnp.bfloat16)
+
+    def buried(a, b, c):
+        return jnp.matmul(a, b), c * 2 + 1
+
+    deep = jax.make_jaxpr(buried)(sds, sds, ballast)
+    f = [x for x in audit_jaxpr_numerics(deep) if x.code == "NM1103"]
+    assert len(f) == 1 and f[0].severity == "error"
+    assert "128" in f[0].message
+
+
+def test_nm1103_zero_ratio_restores_flat_error():
+    """FLAGS_numerics_widen_warn_ratio <= 0 disables the downgrade —
+    every narrow accumulation is an error again."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.base.flags import get_flag, set_flags
+
+    sds = jax.ShapeDtypeStruct((8, 8), jnp.bfloat16)
+    lone = jax.make_jaxpr(jnp.matmul)(sds, sds)
+    prev = get_flag("numerics_widen_warn_ratio")
+    set_flags({"numerics_widen_warn_ratio": 0.0})
+    try:
+        f = [x for x in audit_jaxpr_numerics(lone) if x.code == "NM1103"]
+        assert len(f) == 1 and f[0].severity == "error"
+    finally:
+        set_flags({"numerics_widen_warn_ratio": prev})
+
+
+def test_accumulation_width_delta_prices_bytes_not_flops():
+    """The cost-model hook itself: bf16 8x8 @ 8x8 -> widening adds
+    64*(4-2)=128 result bytes, FLOPs unchanged (2*8*8*8); an fp32 dot
+    prices at zero extra."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.cost_model import accumulation_width_delta
+
+    def dot_eqn(dtype):
+        sds = jax.ShapeDtypeStruct((8, 8), dtype)
+        closed = jax.make_jaxpr(jnp.matmul)(sds, sds)
+        return next(e for e in closed.jaxpr.eqns
+                    if e.primitive.name == "dot_general")
+
+    d = accumulation_width_delta(dot_eqn(jnp.bfloat16))
+    assert d["extra_bytes"] == 128.0
+    assert d["out_bytes"] == 128.0
+    assert d["flops"] == 2.0 * 8 * 8 * 8
+
+    wide = accumulation_width_delta(dot_eqn(jnp.float32))
+    assert wide["extra_bytes"] == 0.0
+
+
 # ------------------------------------------------------------- NM1106
 def test_nm1106_large_bf16_reduction_flagged_small_clean():
     import jax

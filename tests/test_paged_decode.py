@@ -1,0 +1,353 @@
+"""Paged KV decode tests (ISSUE 18): the vLLM-style page pool behind
+the decode serving tier — block-table paging, the mixed-context decode
+matrix (the bench runs the real 128–4k spread; these tests scale the
+same four-bucket shape down to fit the tier-1 budget), mid-flight page
+growth, page reclaim, greedy bit-exactness vs the slot-pool oracle,
+sampled decoding determinism, pool-pressure wait/shed semantics, the
+JX334 fragmentation watermark and the page-pressure chaos scenario."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.profiler.pipeline import ServingStats
+from paddle_tpu.serving import AdmissionError
+from paddle_tpu.serving.kv_cache import KVPagePool, KVSlotPool
+
+
+def _tiny_model(**overrides):
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(0)
+    base = dict(vocab_size=128, num_hidden_layers=1, hidden_size=8,
+                num_attention_heads=1, max_position_embeddings=512)
+    base.update(overrides)
+    model = GPTForCausalLM(gpt_tiny(**base))
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _paged(model, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_seq", 512)
+    kw.setdefault("seq_buckets", [64, 128, 256, 512])
+    kw.setdefault("prefill_max_batch", 2)
+    kw.setdefault("page_size", 32)
+    kw.setdefault("kv_mode", "paged")
+    kw.setdefault("stats", ServingStats())
+    return serving.DecodeEngine(model, **kw)
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    eng = _paged(model).warmup()
+    yield eng
+    eng.shutdown(drain=True)
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    """The PR 13 slot-pool engine: greedy decode ground truth."""
+    eng = serving.DecodeEngine(
+        model, max_slots=4, max_seq=512, seq_buckets=[64, 128, 256, 512],
+        prefill_max_batch=2, kv_mode="slots", stats=ServingStats()).warmup()
+    yield eng
+    eng.shutdown(drain=True)
+
+
+# the four-bucket interleaved matrix: every seq rung, two mid-flight
+# page growers (32+8 and 63+8 both cross a 32-token page boundary)
+MATRIX = [50, 100, 240, 500, 32, 63, 200, 120]
+
+
+def _prompts(sizes, seed=3):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 128, size=int(n)).astype(np.int32)
+            for n in sizes]
+
+
+# ------------------------------------------------------------ KVPagePool
+class TestKVPagePool:
+    def _pool(self, pages=6, ps=8):
+        return KVPagePool(1, pages, ps, 1, 4)
+
+    def test_alloc_low_ids_first_pad_reserved(self):
+        pool = self._pool()
+        assert pool.pad_page == 0
+        assert pool.alloc(3) == [1, 2, 3]  # low ids hand out first
+        pool.release([2])
+        assert pool.alloc(2) == [2, 4]  # freed page reused before fresh
+        assert pool.in_use() == 4
+
+    def test_release_guards_double_free_and_range(self):
+        pool = self._pool()
+        pages = pool.alloc(2)
+        pool.release(pages)
+        with pytest.raises(ValueError, match="already free"):
+            pool.release([pages[0]])
+        with pytest.raises(ValueError, match="out of range"):
+            pool.release([0])  # the pad page is never allocatable
+
+    def test_exhaustion_names_occupancy(self):
+        pool = self._pool(pages=2)
+        pool.alloc(2)
+        with pytest.raises(RuntimeError, match="exhausted"):
+            pool.alloc(1)
+        # a failed alloc must not leak partial state
+        assert pool.in_use() == 2 and pool.free_count() == 0
+
+    def test_commit_footprint_guard(self):
+        import jax.numpy as jnp
+
+        pool = self._pool()
+        with pytest.raises(ValueError, match="footprint"):
+            pool.commit(jnp.zeros((1, 3, 8, 1, 4)), pool.v)
+
+    def test_equal_bytes_vs_slot_pool(self):
+        """The bench's sizing identity: a page pool with
+        ``(slots+1)*max_seq/ps - 1`` pages holds EXACTLY the slot
+        pool's bytes — the pad page stands in for the pad slot row."""
+        slots, max_seq, ps = 4, 64, 8
+        slot_pool = KVSlotPool(1, slots, max_seq, 1, 4)
+        page_pool = KVPagePool(1, (slots + 1) * max_seq // ps - 1, ps, 1, 4)
+        assert page_pool.device_bytes() == slot_pool.device_bytes()
+
+    def test_utilization_watermark(self):
+        pool = self._pool(pages=4, ps=8)
+        pool.alloc(4)  # 32-token capacity in use
+        pool.note_utilization(8)   # quarter full
+        pool.note_utilization(32)  # full
+        rep = pool.utilization_report()
+        assert rep["samples"] == 2
+        assert rep["mean"] == pytest.approx(0.625)
+        assert rep["min"] == pytest.approx(0.25)
+
+
+# ------------------------------------------------- mixed-context matrix
+class TestMixedContextMatrix:
+    def test_greedy_bit_exact_vs_slot_oracle(self, engine, oracle):
+        """The contractual proof: continuous paged decode over the
+        interleaved four-bucket mix emits the same tokens as the
+        slot-pool engine — page indirection is invisible to the math."""
+        prompts = _prompts(MATRIX)
+        paged = [engine.submit("a" if i % 2 else "b", p, max_new_tokens=8)
+                 for i, p in enumerate(prompts)]
+        slot = [oracle.submit("a" if i % 2 else "b", p, max_new_tokens=8)
+                for i, p in enumerate(prompts)]
+        for pr, sr in zip(paged, slot):
+            assert np.array_equal(pr.result(60), sr.result(60))
+
+    def test_zero_retrace_and_constant_footprint(self, engine):
+        before = engine.kv_pool.device_bytes()
+        reqs = [engine.submit("mix", p, max_new_tokens=6)
+                for p in _prompts(MATRIX, seed=5)]
+        for r in reqs:
+            r.result(60)
+        report = engine.serving_report()
+        assert report["compiles_after_warmup"] == 0
+        assert report["kv_pool_bytes_constant"] is True
+        assert engine.kv_pool.device_bytes() == before
+
+    def test_pages_reclaimed_after_drain(self, engine):
+        outs = [engine.generate("r", p, max_new_tokens=6)
+                for p in _prompts([63, 32, 500], seed=9)]
+        assert all(len(o) == 6 for o in outs)
+        assert engine.kv_pool.in_use() == 0  # every page came home
+
+    def test_requests_join_and_leave_midflight(self, engine):
+        first = [engine.submit("j", p, max_new_tokens=10)
+                 for p in _prompts([240, 500], seed=11)]
+        # second wave joins while the first is decoding
+        second = [engine.submit("j", p, max_new_tokens=4)
+                  for p in _prompts([50, 100, 63], seed=12)]
+        outs = [r.result(60) for r in first + second]
+        assert [len(o) for o in outs] == [10, 10, 4, 4, 4]
+        assert engine.kv_pool.in_use() == 0
+
+    def test_report_surfaces_paged_keys(self, engine):
+        engine.generate("rep", _prompts([100])[0], max_new_tokens=4)
+        report = engine.serving_report()
+        assert report["kv_mode"] == "paged"
+        assert report["kv_page_size"] == 32
+        assert report["kv_pages"] == 64  # equal-bytes default sizing
+        assert report["table_rungs"] == [1, 2, 4, 8, 16]
+        assert 0.0 < report["kv_pool_utilization"] <= 1.0
+        assert report["kv_shed_requests"] == 0
+
+    def test_audit_clean_on_live_engine(self, engine):
+        from paddle_tpu.analysis.jaxpr_audit import audit_serving
+
+        engine.generate("audit", _prompts([120])[0], max_new_tokens=4)
+        assert audit_serving(engine) == []
+
+
+# ---------------------------------------------------- sampled decoding
+class TestSampledDecoding:
+    PROMPT = _prompts([40], seed=21)[0]
+
+    def test_same_seed_same_stream(self, engine):
+        a = engine.submit("s", self.PROMPT, max_new_tokens=12,
+                          temperature=1.5, seed=7).result(60)
+        b = engine.submit("s", self.PROMPT, max_new_tokens=12,
+                          temperature=1.5, seed=7).result(60)
+        assert np.array_equal(a, b)
+
+    def test_seeds_decorrelate(self, engine):
+        a = engine.submit("s", self.PROMPT, max_new_tokens=12,
+                          temperature=1.5, seed=7).result(60)
+        b = engine.submit("s", self.PROMPT, max_new_tokens=12,
+                          temperature=1.5, seed=8).result(60)
+        assert not np.array_equal(a, b)
+
+    def test_sampling_independent_of_batch_composition(self, engine):
+        solo = engine.submit("s", self.PROMPT, max_new_tokens=10,
+                             temperature=1.5, seed=7).result(60)
+        reqs = [engine.submit("s", self.PROMPT, max_new_tokens=10,
+                              temperature=1.5, seed=7)]
+        reqs += [engine.submit("noise", p, max_new_tokens=10)
+                 for p in _prompts([500, 63, 240], seed=23)]
+        batched = reqs[0].result(60)
+        for r in reqs[1:]:
+            r.result(60)
+        assert np.array_equal(solo, batched)
+
+    def test_topk_topp_deterministic_per_seed(self, engine):
+        kw = dict(max_new_tokens=10, temperature=0.9, top_k=16,
+                  top_p=0.9, seed=3)
+        a = engine.submit("s", self.PROMPT, **kw).result(60)
+        b = engine.submit("s", self.PROMPT, **kw).result(60)
+        assert np.array_equal(a, b)
+        assert all(0 <= int(t) < 128 for t in a)
+
+    def test_slots_engine_refuses_sampling(self, oracle):
+        with pytest.raises(ValueError, match="greedy oracle"):
+            oracle.submit("s", self.PROMPT, max_new_tokens=4,
+                          temperature=0.9)
+
+
+# ------------------------------------------------------- pool pressure
+class TestPagePressure:
+    def _small(self, model16, **kw):
+        kw.setdefault("max_slots", 4)
+        kw.setdefault("max_seq", 16)
+        kw.setdefault("seq_buckets", [8, 16])
+        kw.setdefault("prefill_max_batch", 1)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("kv_mode", "paged")
+        kw.setdefault("stats", ServingStats())
+        return serving.DecodeEngine(model16, **kw)
+
+    @pytest.fixture(scope="class")
+    def model16(self):
+        return _tiny_model(max_position_embeddings=16)
+
+    def test_admission_waits_for_pages_not_sheds(self, model16):
+        """6 one-page requests over a 3-page pool: admission staggers
+        behind retirements — every request completes, zero sheds."""
+        eng = self._small(model16, pool_pages=3).warmup()
+        try:
+            reqs = [eng.submit("w", p, max_new_tokens=2)
+                    for p in _prompts([6] * 6, seed=31)]
+            outs = [r.result(60) for r in reqs]
+            assert all(len(o) == 2 for o in outs)
+            report = eng.serving_report()
+            assert report["kv_shed_requests"] == 0
+            assert eng.kv_pool.in_use() == 0
+        finally:
+            eng.shutdown(drain=True)
+
+    def test_starved_lane_waits_and_resumes_bit_exact(self, model16):
+        """Natural exhaustion mid-decode: the growing lane sits out
+        steps until a retirement frees a page, then finishes with the
+        same tokens it would have produced unobstructed."""
+        eng = self._small(model16, pool_pages=2, max_slots=2).warmup()
+        try:
+            grower, quick = _prompts([6, 6], seed=33)
+            solo = eng.generate("solo", grower, max_new_tokens=8)
+            # both lanes hold the pool's 2 pages; the grower needs a
+            # third at position 8 and must wait for quick to retire
+            a = eng.submit("p", grower, max_new_tokens=8)
+            b = eng.submit("p", quick, max_new_tokens=2)
+            assert np.array_equal(a.result(60), solo)
+            assert len(b.result(60)) == 2
+            assert eng.serving_report()["kv_shed_requests"] == 0
+        finally:
+            eng.shutdown(drain=True)
+
+    def test_never_fits_refused_at_submit(self, model16):
+        eng = self._small(model16, pool_pages=1).warmup()
+        try:
+            with pytest.raises(ValueError, match="never be admitted"):
+                eng.submit("n", _prompts([9], seed=35)[0],
+                           max_new_tokens=2)
+        finally:
+            eng.shutdown(drain=True)
+
+    def test_deadlock_breaker_sheds_youngest(self, model16):
+        """Both lanes starve with nothing pending: the youngest sheds
+        (AdmissionError, pages released), the oldest completes."""
+        eng = self._small(model16, pool_pages=2, max_slots=2).warmup()
+        try:
+            old = eng.submit("d", _prompts([6], seed=37)[0],
+                             max_new_tokens=8)
+            young = eng.submit("d", _prompts([6], seed=38)[0],
+                               max_new_tokens=8)
+            assert len(old.result(60)) == 8
+            with pytest.raises(AdmissionError) as ei:
+                young.result(60)
+            assert ei.value.reason == "kv_pages"
+            assert eng.serving_report()["kv_shed_requests"] == 1
+            assert eng.kv_pool.in_use() == 0  # the shed leaked nothing
+        finally:
+            eng.shutdown(drain=True)
+
+
+# ------------------------------------------------- JX334 fragmentation
+class TestJX334Fragmentation:
+    class _Duck:
+        """audit_serving duck-type: counters + a pool."""
+        compiles_after_warmup = 0
+
+        def __init__(self, pool):
+            self.kv_pool = pool
+            self.kv_pool.mark_warm()
+            self._held = pool.alloc(4)
+
+        def active_requests(self):
+            return 1
+
+    def test_seeded_low_utilization_warns(self):
+        duck = self._Duck(KVPagePool(1, 8, 64, 1, 4))
+        for _ in range(8):  # 4 pages held, ~3% of their tokens live
+            duck.kv_pool.note_utilization(8)
+        from paddle_tpu.analysis.jaxpr_audit import audit_serving
+
+        findings = [f for f in audit_serving(duck) if f.code == "JX334"]
+        assert len(findings) == 1
+        assert findings[0].severity == "warning"
+        assert "page_size" in findings[0].message
+
+    def test_healthy_utilization_clean(self):
+        duck = self._Duck(KVPagePool(1, 8, 64, 1, 4))
+        for _ in range(8):
+            duck.kv_pool.note_utilization(4 * 64)  # pages brim-full
+        from paddle_tpu.analysis.jaxpr_audit import audit_serving
+
+        assert [f for f in audit_serving(duck) if f.code == "JX334"] == []
+
+
+# ------------------------------------------------- chaos regression
+class TestChaosPagePressure:
+    def test_scenario_page_pressure_green(self):
+        from tools.chaos import scenario_page_pressure
+
+        out = scenario_page_pressure(0)
+        assert out["ok"] is True, out
+        assert out["shed_admission_error"] > 0
+        assert out["kv_pages_leaked"] == 0
+        assert out["compiles_after_warmup"] == 0

@@ -1,0 +1,62 @@
+"""ISSUE 18 satellite: the ``--probe-sweep`` root-cause harness.
+
+The sweep itself is a subprocess matrix (each combination imports jax
+under its own env), so these tests stub ``bench._spawn`` and verify the
+orchestration: one verdict row per (site x option) combination, the
+winning combination identifiable by its recorded env, timeouts and
+budget exhaustion landing as data rather than exceptions.
+
+The real-world check ran once by hand in this container: with
+JAX_PLATFORMS unset and libtpu installed, every combination hangs in
+backend init EXCEPT ``skip_mds`` (TPU_SKIP_MDS_QUERY=1) — the sweep's
+verdict table points straight at the metadata-server query.
+"""
+import subprocess
+
+import bench
+
+
+def _codes(rows):
+    return [(r["site"], r["options"], r["verdict"]) for r in rows]
+
+
+def test_sweep_sites_stock_plus_overlays(monkeypatch):
+    monkeypatch.setenv(
+        "PYTHONPATH", "/opt/.axon_site_r5/lib:/usr/extra:/opt/.axon_site_r4")
+    sites = dict(bench._sweep_sites())
+    assert sites["stock"] == ["/usr/extra"]
+    # each overlay pins its own jaxlib: overlay first, stock entries kept
+    assert sites[".axon_site_r5"] == ["/opt/.axon_site_r5/lib", "/usr/extra"]
+    assert sites[".axon_site_r4"] == ["/opt/.axon_site_r4", "/usr/extra"]
+
+
+def test_probe_sweep_verdict_per_combination(monkeypatch):
+    monkeypatch.setenv("PYTHONPATH", "")
+
+    def fake_spawn(env, timeout, want="metric"):
+        assert want == "probe" and env["BENCH_PROBE"] == "1"
+        assert "JAX_PLATFORMS" not in env  # default resolution must run
+        if env.get("TPU_SKIP_MDS_QUERY") == "1":
+            return {"probe": "tpu", "device_kind": "TPU v5 lite"}, 0, ""
+        raise subprocess.TimeoutExpired(
+            ["python"], timeout, output="", stderr="stuck in MDS query")
+
+    monkeypatch.setattr(bench, "_spawn", fake_spawn)
+    rows = bench.probe_sweep(budget_s=600.0)
+    assert len(rows) == len(bench._SWEEP_OPTIONS)  # stock site only
+    ok = [r for r in rows if r["verdict"] == "ok"]
+    assert [r["options"] for r in ok] == ["skip_mds"]
+    assert ok[0]["platform"] == "tpu"
+    assert ok[0]["env"] == {"TPU_SKIP_MDS_QUERY": "1"}  # adoptable winner
+    hung = [r for r in rows if r["verdict"] == "timeout"]
+    assert len(hung) == len(rows) - 1
+    assert all(r["stderr_tail"] == "stuck in MDS query" for r in hung)
+
+
+def test_probe_sweep_budget_exhaustion_lands_as_skipped(monkeypatch):
+    monkeypatch.setenv("PYTHONPATH", "")
+    monkeypatch.setattr(
+        bench, "_spawn",
+        lambda env, timeout, want="metric": ({"probe": "cpu"}, 0, ""))
+    rows = bench.probe_sweep(budget_s=0.0)
+    assert rows and all(r["verdict"] == "skipped" for r in rows)

@@ -45,12 +45,15 @@ DEFAULT_METRIC = "gpt_tiny_train_tokens_per_sec_cpu"
 # replay distance (bench extras.resilience, ISSUE 14 — deterministic:
 # crash step and snapshot cadence are seeded, so any move means the
 # snapshot path changed) and the mid-traffic weight-hot-swap latency
-# spike (bench extras.swap, ISSUE 15); each gates only once two rounds
-# carry it
+# spike (bench extras.swap, ISSUE 15) and the paged-KV pool's live-token
+# share of allocated page bytes (bench extras.serving, ISSUE 18 —
+# higher means less fragmentation stranding HBM); each gates only once
+# two rounds carry it
 DEFAULT_EXTRAS = ("coldstart.train_warm_speedup_x",
                   "comm.allreduce_bytes_saved_ratio",
                   "zero1.opt_state_bytes_ratio",
                   "serving.decode_tokens_per_sec",
+                  "serving.kv_pool_utilization",
                   "resilience.recovery_steps",
                   "swap.pause_ms_p99")
 

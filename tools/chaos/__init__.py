@@ -258,6 +258,66 @@ def scenario_decode_faults(seed: int) -> dict:
             "compiles_after_warmup": engine.compiles_after_warmup}
 
 
+def scenario_page_pressure(seed: int) -> dict:
+    """KV page-allocation failure under pool pressure: the starved
+    request sheds with ``AdmissionError`` (reason ``kv_pages``), its
+    pages come home (no leak — JX333 clean), and every in-flight lane
+    keeps decoding to completion."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import reliability as rel
+    from paddle_tpu.analysis.jaxpr_audit import audit_serving
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.profiler.pipeline import ServingStats
+    from paddle_tpu.serving import AdmissionError, DecodeEngine
+
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_tiny(
+        num_hidden_layers=1, hidden_size=32, num_attention_heads=2,
+        max_position_embeddings=32))
+    model.eval()
+    engine = DecodeEngine(model, kv_mode="paged", max_slots=3, max_seq=16,
+                          seq_buckets=[8, 16], prefill_max_batch=2,
+                          page_size=8, stats=ServingStats())
+    engine.warmup()
+    rs = np.random.RandomState(seed)
+    inj = rel.arm(rel.FaultInjector(seed=seed)
+                  .plan("kv.page_alloc", rate=0.25))
+    completed = shed = other = 0
+    try:
+        reqs = [engine.submit(t, rs.randint(0, 512, size=n).astype(np.int32),
+                              max_new_tokens=6)
+                for t, n in (("a", 4), ("b", 9), ("a", 3), ("b", 12),
+                             ("a", 6), ("b", 5), ("a", 10), ("b", 7))]
+        for r in reqs:
+            try:
+                r.result(60)
+                completed += 1
+            except AdmissionError as e:
+                assert e.reason == "kv_pages", e.reason
+                shed += 1
+            except Exception:
+                other += 1
+    finally:
+        rel.disarm()
+    engine.shutdown(drain=True)
+    findings = [str(f) for f in audit_serving(engine)]
+    pages_leaked = engine.kv_pool.in_use()
+    summary = inj.summary()
+    ok = (completed + shed == len(reqs) and other == 0 and shed > 0
+          and completed > 0 and pages_leaked == 0 and not findings
+          and summary["total_injected"] > 0
+          and engine.compiles_after_warmup == 0)
+    return {"ok": bool(ok), "requests": len(reqs), "completed": completed,
+            "shed_admission_error": shed, "other_failures": other,
+            "kv_pages_leaked": pages_leaked,
+            "audit_findings": findings,
+            "injected": summary["total_injected"],
+            "injected_by_site": summary["by_site"],
+            "compiles_after_warmup": engine.compiles_after_warmup}
+
+
 def scenario_prefetch_crash(seed: int) -> dict:
     """A killed prefetch thread must fail fit, not deadlock it."""
     import numpy as np
@@ -474,6 +534,7 @@ _SCENARIOS = (
     ("train_resume", scenario_train_resume),
     ("serving_retry", scenario_serving_retry),
     ("decode_faults", scenario_decode_faults),
+    ("page_pressure", scenario_page_pressure),
     ("prefetch_crash", scenario_prefetch_crash),
     ("cache_corruption", scenario_cache_corruption),
     ("ckpt_torn_write", scenario_ckpt_torn_write),
