@@ -242,7 +242,11 @@ def _lint_bench(step):
     read). ISSUE 17 adds the numerics family's static-scan cost and the
     NaN/range witness's per-watch overhead on the same lit-vs-dark
     protocol (dark must stay at one bool read — watch() sits on the
-    TrainStep/GradScaler hot paths)."""
+    TrainStep/GradScaler hot paths). ISSUE 19 adds the drift family's
+    cost (retrace + fingerprint of every representative program against
+    ``programs.lock.json``) — drift runs at lint time ONLY, so
+    ``audit_builds_delta`` staying 0 below is the proof the hot path
+    never pays for it."""
     from tools.lint import run_analyzers
 
     t0 = time.perf_counter()
@@ -262,6 +266,11 @@ def _lint_bench(step):
         [os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "paddle_tpu")])
     nm_s = time.perf_counter() - t0
+    from paddle_tpu.analysis.drift_check import check_drift
+
+    t0 = time.perf_counter()
+    pd_findings = check_drift()
+    pd_s = time.perf_counter() - t0
     builds_before = sum(step._compiled._compile_counts.values())
     t0 = time.perf_counter()
     report = step.audit_report()
@@ -275,6 +284,8 @@ def _lint_bench(step):
         "concurrency_findings": len(cx_findings),
         "numerics_family_seconds": round(nm_s, 3),
         "numerics_findings": len(nm_findings),
+        "drift_family_seconds": round(pd_s, 3),
+        "drift_findings": len(pd_findings),
         "audit_report_us": round(report_us, 1),
         "audit_builds_delta": (sum(step._compiled._compile_counts.values())
                                - builds_before),

@@ -55,6 +55,17 @@ analyzers that run at commit time:
   reductions, int-to-narrow dequant epilogues), fp16-without-scaler and
   degenerate-quantizer object audits; plus the runtime NaN/Inf +
   dynamic-range witness (``observability/numerics.py``, NM1104/NM1105).
+- :mod:`drift_check` — the program-drift gate (PD12xx): every
+  representative program (TrainStep sharding tiers, serving batch
+  ladder, paged-decode rung grid, qpsum oracle, reshard route) is
+  retraced, canonically fingerprinted and compared against the
+  committed ``programs.lock.json`` — new primitives, lost donation,
+  dtype narrowing, rung-grid shrinkage and cost growth past the
+  ``FLAGS_drift_max_*_ratio`` tolerances all gate. ``python -m
+  tools.lint --update-lock`` regenerates the lock deterministically.
+
+The ``# noqa: CODE — reason`` suppression grammar every source-scanning
+family honours lives in :mod:`noqa` (one regex, one ``apply_noqa``).
 
 One CLI drives them all: ``python -m tools.lint`` (exit 1 on any
 error-severity finding, 2 on an analyzer crash; ``--json`` for
@@ -75,6 +86,7 @@ __all__ = [
     "audit_witness",
     "check_concurrency_paths",
     "check_concurrency_source",
+    "check_drift",
     "check_numerics_paths",
     "check_numerics_source",
     "check_cost",
@@ -292,3 +304,9 @@ def audit_numerics_witness():
     from .numerics_check import audit_witness as _impl
 
     return _impl()
+
+
+def check_drift(live=None, lock_path=None):
+    from .drift_check import check_drift as _impl
+
+    return _impl(live=live, lock_path=lock_path)

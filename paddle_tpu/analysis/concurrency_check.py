@@ -530,7 +530,7 @@ def check_source(source: str, filename: str = "<string>",
     """CX1000/CX1002/CX1003 over one file; lock-nesting edges are
     appended to ``_edges_out`` for the caller's cross-file CX1001 graph
     (standalone calls get their own single-file cycle check)."""
-    from .trace_safety import _apply_noqa
+    from .noqa import apply_noqa
 
     try:
         tree = ast.parse(source, filename=filename)
@@ -552,7 +552,7 @@ def check_source(source: str, filename: str = "<string>",
         _edges_out.extend(visitor.edges)
     else:
         findings += _cycle_findings(visitor.edges)
-    return _apply_noqa(findings, source)
+    return apply_noqa(findings, source)
 
 
 def _cycle_findings(edges: Sequence[Tuple[str, str, str]]) -> List[Finding]:

@@ -124,7 +124,7 @@ class _FaultVisitor(ast.NodeVisitor):
 
 def check_source(source: str, filename: str = "<string>") -> List[Finding]:
     from ..reliability.faults import SITES
-    from .trace_safety import _apply_noqa
+    from .noqa import apply_noqa
 
     try:
         tree = ast.parse(source, filename=filename)
@@ -133,7 +133,7 @@ def check_source(source: str, filename: str = "<string>") -> List[Finding]:
                         f"could not parse {filename}: {e}", filename)]
     visitor = _FaultVisitor(filename, frozenset(SITES))
     visitor.visit(tree)
-    return _apply_noqa(visitor.findings, source)
+    return apply_noqa(visitor.findings, source)
 
 
 def check_paths(paths: Sequence[str]) -> List[Finding]:

@@ -1,0 +1,52 @@
+"""Shared ``# noqa`` suppression grammar for every analyzer family.
+
+One definition of the comment grammar the source-scanning analyzers
+(trace, spmd, telemetry, fault, concurrency, numerics, drift) honor:
+
+- ``# noqa`` — bare: suppress every finding on that line;
+- ``# noqa: TS101`` — suppress exactly that code;
+- ``# noqa: TS101, SP401 — reason`` — multiple codes; everything after
+  the code list (the em-dash reason) is ignored by the parser but
+  required by review convention: a suppression without a reason is a
+  review comment waiting to happen.
+
+Codes are matched case-insensitively and exactly (no prefix matching —
+``# noqa: TS1`` does not suppress TS101; a family-wide waiver is a
+``--ignore`` filter on the CLI, not a source comment).
+"""
+from __future__ import annotations
+
+import re
+from typing import List
+
+NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.I)
+
+
+def suppressed(line: str, code: str) -> bool:
+    """True when ``line`` carries a ``# noqa`` comment matching ``code``
+    (bare ``# noqa`` matches every code)."""
+    m = NOQA_RE.search(line)
+    if not m:
+        return False
+    codes = m.group("codes")
+    return codes is None or code.upper() in {
+        c.strip().upper() for c in codes.split(",")}
+
+
+def apply_noqa(findings: List, source: str) -> List:
+    """Drop findings whose ``file:line`` location points at a source line
+    carrying a matching ``# noqa``. Findings without a parseable line
+    number (program/registry/runtime findings) are always kept."""
+    lines = source.splitlines()
+    kept = []
+    for f in findings:
+        try:
+            lineno = int(f.location.rsplit(":", 1)[1])
+            text = lines[lineno - 1]
+        except (IndexError, ValueError):
+            kept.append(f)
+            continue
+        if suppressed(text, f.code):
+            continue
+        kept.append(f)
+    return kept

@@ -210,7 +210,7 @@ class _NmVisitor(ast.NodeVisitor):
 def check_source(source: str, filename: str = "<string>") -> List[Finding]:
     """NM1100/NM1101/NM1102 over one file, with the shared noqa
     grammar."""
-    from .trace_safety import _apply_noqa
+    from .noqa import apply_noqa
 
     try:
         tree = ast.parse(source, filename=filename)
@@ -219,7 +219,7 @@ def check_source(source: str, filename: str = "<string>") -> List[Finding]:
                         f"could not parse {filename}: {e}", filename)]
     visitor = _NmVisitor(filename)
     visitor.visit(tree)
-    return _apply_noqa(visitor.findings, source)
+    return apply_noqa(visitor.findings, source)
 
 
 def check_paths(paths: Sequence[str]) -> List[Finding]:

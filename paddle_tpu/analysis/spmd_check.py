@@ -294,9 +294,9 @@ def check_source(source: str, filename: str = "<string>",
             declared |= extra
             findings = []
             _SpmdChecker(declared, findings, filename).visit(tree)
-    from .trace_safety import _apply_noqa
+    from .noqa import apply_noqa
 
-    return _apply_noqa(findings, source)
+    return apply_noqa(findings, source)
 
 
 def check_paths(paths: Sequence[str],

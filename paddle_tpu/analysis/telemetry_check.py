@@ -205,7 +205,7 @@ class _SamplerSyncChecker(ast.NodeVisitor):
 
 def check_source(source: str, filename: str = "<string>") -> List[Finding]:
     """OB602 over one module's source text."""
-    import re
+    from .noqa import apply_noqa
 
     try:
         tree = ast.parse(source, filename=filename)
@@ -220,22 +220,8 @@ def check_source(source: str, filename: str = "<string>") -> List[Finding]:
             checker = _SamplerSyncChecker(findings, filename, node.name)
             for stmt in node.body:
                 checker.visit(stmt)
-    # shared noqa grammar with the trace-safety linter
-    noqa_re = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.I)
-    lines = source.splitlines()
-    kept = []
-    for f in findings:
-        try:
-            lineno = int(f.location.rsplit(":", 1)[1])
-            m = noqa_re.search(lines[lineno - 1])
-        except (IndexError, ValueError):
-            kept.append(f)
-            continue
-        if m and (m.group("codes") is None or f.code in {
-                c.strip().upper() for c in m.group("codes").split(",")}):
-            continue
-        kept.append(f)
-    return kept
+    # suppression grammar shared with every family (analysis/noqa.py)
+    return apply_noqa(findings, source)
 
 
 def check_paths(paths: Sequence[str]) -> List[Finding]:

@@ -65,7 +65,8 @@ _HOST_EVAL_CALLS = {"len", "isinstance", "hasattr", "getattr", "callable",
                     # static under tracing (jnp.iscomplexobj, np.issubdtype, …)
                     "iscomplexobj", "isrealobj", "issubdtype", "result_type",
                     "ndim", "shape"}
-_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.I)
+# suppression grammar shared by every analyzer family (analysis/noqa.py)
+from .noqa import NOQA_RE as _NOQA_RE  # noqa: E402 — re-export for compat
 
 _DISPATCH_FNS = {"primitive", "passthrough"}
 
@@ -483,23 +484,11 @@ def lint_source(source: str, filename: str = "<string>") -> List[Finding]:
 
 
 def _apply_noqa(findings: List[Finding], source: str) -> List[Finding]:
-    lines = source.splitlines()
-    kept = []
-    for f in findings:
-        try:
-            lineno = int(f.location.rsplit(":", 1)[1])
-            text = lines[lineno - 1]
-        except (IndexError, ValueError):
-            kept.append(f)
-            continue
-        m = _NOQA_RE.search(text)
-        if m:
-            codes = m.group("codes")
-            if codes is None or f.code in {c.strip().upper()
-                                           for c in codes.split(",")}:
-                continue
-        kept.append(f)
-    return kept
+    """Kept as an alias: the grammar moved to :mod:`analysis.noqa` (one
+    shared definition for every family)."""
+    from .noqa import apply_noqa
+
+    return apply_noqa(findings, source)
 
 
 def lint_paths(paths: Sequence[str]) -> List[Finding]:

@@ -388,6 +388,20 @@ define_flag("cost_max_guard_preds", 8,
             "verifying more guard predicates than this per call is "
             "flagged — every predicate is a device→host fetch on each "
             "call to validate the speculation")
+define_flag("drift_max_flops_ratio", 1.25,
+            "drift lint (PD1202): a locked program whose live FLOPs "
+            "exceed lockfile FLOPs by more than this ratio fails the "
+            "program-drift gate")
+define_flag("drift_max_bytes_ratio", 1.25,
+            "drift lint (PD1202): tolerance ratio for bytes_read / "
+            "bytes_written growth over the locked program")
+define_flag("drift_max_comm_ratio", 1.25,
+            "drift lint (PD1202): tolerance ratio for collective comm "
+            "byte growth over the locked program (comm appearing from "
+            "zero always fails)")
+define_flag("drift_max_peak_ratio", 1.25,
+            "drift lint (PD1202): tolerance ratio for liveness "
+            "peak-residency growth over the locked program")
 
 
 def enable_check_model_nan_inf():

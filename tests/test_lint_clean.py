@@ -1,15 +1,16 @@
 """CI gate: the repo itself passes its own static analysis.
 
-Runs all fourteen ``paddle_tpu.analysis`` analyzer families over the live
+Runs all fifteen ``paddle_tpu.analysis`` analyzer families over the live
 codebase and asserts ZERO error-severity findings, so a regression (a new
 jit-unsafe pattern in a kernel, a broken alias row, an IR recording bug,
 a host callback in a compiled step, a typo'd mesh axis, a cost-model
 budget blowout, a serving-tier steady-state recompile, a leaked telemetry
 span, a sync inside a memory sampler, a non-hermetic persistent-cache
 entry, an armed fault injector / undeclared fault site, a sharded
-checkpoint whose manifest stopped holding its pieces or a narrow-float
-accumulation / dtype-surgery numerics hazard) fails tier-1
-instead of rotting until pod scale. The
+checkpoint whose manifest stopped holding its pieces, a narrow-float
+accumulation / dtype-surgery numerics hazard or a representative program
+drifting from its committed ``programs.lock.json`` fingerprint) fails
+tier-1 instead of rotting until pod scale. The
 ``python -m tools.lint`` CLI contract (exit 0, machine-readable JSON
 with per-family wall-time, ``--include-tests``) is gated here too.
 """
@@ -271,6 +272,26 @@ def test_numerics_demo_green():
     assert [str(f) for f in record_demo_numerics()] == []
 
 
+def test_drift_gate_green_against_committed_lockfile():
+    """ISSUE 19: the committed ``programs.lock.json`` matches a fresh
+    retrace + canonical fingerprint of every representative program
+    (PD12xx clean on the 8-device harness, nothing skipped) — and
+    ``render_lock`` over the live set reproduces the committed bytes
+    EXACTLY, which is the cross-process determinism proof for
+    ``--update-lock`` (the lockfile was generated in a different
+    process than this test)."""
+    from paddle_tpu.analysis.drift_check import (
+        check_drift, default_lock_path, record_drift_programs, render_lock)
+
+    live = record_drift_programs()
+    assert live["skipped"] == {}, live["skipped"]  # every tier built
+    assert len(live["programs"]) >= 10
+    assert [str(f) for f in check_drift(live)] == []
+    with open(default_lock_path(), "r", encoding="utf-8") as fh:
+        committed = fh.read()
+    assert render_lock(live) == committed
+
+
 def test_cli_exits_zero_with_machine_readable_findings(capsys):
     """`tools.lint --json --include-tests` over the repo: exit 0,
     parseable. Run in-process (the tests above already paid the analyzer
@@ -288,7 +309,7 @@ def test_cli_exits_zero_with_machine_readable_findings(capsys):
                                          "jaxpr", "spmd", "cost", "serving",
                                          "telemetry", "cache", "comm",
                                          "fault", "ckpt", "concurrency",
-                                         "numerics"}
+                                         "numerics", "drift"}
     assert isinstance(payload["findings"], list)
     # per-family wall-time (CI satellite): one entry per analyzer run
     assert set(payload["timings_s"]) == set(payload["analyzers"])

@@ -86,11 +86,16 @@ def cmd_ls(cache_dir: str, as_json: bool) -> int:
 
 def cmd_verify(cache_dir: str, as_json: bool) -> int:
     """Integrity pass: every entry must parse, checksum and carry a
-    fingerprint; no orphan tmp files. Non-zero exit on ANY defect."""
+    fingerprint; no orphan tmp files. Non-zero exit on ANY defect.
+    Prints the committed program-lock digest (``programs.lock.json``,
+    the drift family's baseline) alongside each entry's content digest,
+    so one log line correlates a cached executable with the program set
+    it was built under."""
+    from paddle_tpu.analysis.drift_check import lock_digest
     from paddle_tpu.compile_cache import store as st
 
     problems = []
-    n_ok = 0
+    entries = []
     for r in _rows(cache_dir):
         name = os.path.basename(r["path"])
         if r.get("orphan"):
@@ -113,11 +118,23 @@ def cmd_verify(cache_dir: str, as_json: bool) -> int:
                              "problem": "no environment fingerprint "
                              "(non-hermetic key, CC700)"})
             continue
-        n_ok += 1
+        entries.append({"file": name,
+                        "digest": r.get("digest") or "",
+                        "content_sha256": header.get("payload_sha256")})
+    n_ok = len(entries)
+    program_lock = lock_digest()
     if as_json:
         print(json.dumps({"dir": cache_dir, "ok": n_ok,
+                          "program_lock_digest": program_lock,
+                          "entries": entries,
                           "problems": problems}, indent=2))
     else:
+        print("program-lock: "
+              + (program_lock[:16] if program_lock else "ABSENT "
+                 "(run python -m tools.lint --update-lock)"))
+        for e in entries:
+            print(f"  ok  {e['digest'][:12]:<12}  {e['file']}  "
+                  f"content={e['content_sha256'][:8]}")
         for p in problems:
             print(f"BAD  {p['file']}: {p['problem']}")
         print(f"tools.cache verify: {n_ok} ok, {len(problems)} problem(s)")

@@ -1,6 +1,6 @@
 """``python -m tools.lint`` — the repo's static-analysis driver.
 
-Runs the fourteen ``paddle_tpu.analysis`` analyzers and reports findings:
+Runs the fifteen ``paddle_tpu.analysis`` analyzers and reports findings:
 
 - **trace**:    the trace-safety AST linter over ``paddle_tpu/`` (or the
                 paths given on the command line),
@@ -73,6 +73,19 @@ Runs the fourteen ``paddle_tpu.analysis`` analyzers and reports findings:
                 collapse recorded by the lit runtime witness
                 (``observability/numerics.py``). ``--select NM`` is the
                 pre-run gate before a long mixed-precision job.
+- **drift**:    the program-drift gate (PD12xx) over the committed
+                ``programs.lock.json``: every representative program
+                (TrainStep replicated/gspmd/zero1 tiers, serving batch
+                ladder, paged-decode rung grid, qpsum oracle, reshard
+                route) is retraced, canonically fingerprinted
+                (primitive histogram, donation, per-dtype bytes,
+                per-axis collectives, cost-model scalars) and compared
+                against the lock — new primitives, lost donation,
+                dtype narrowing, rung-grid shrinkage and cost growth
+                past the ``FLAGS_drift_max_*_ratio`` tolerances all
+                fail. ``--update-lock`` regenerates the lockfile
+                deterministically (byte-identical when nothing
+                changed), then exits.
 
 Exit-code contract (stable, CI-gateable):
   0 = no error-severity findings (warnings never gate)
@@ -96,7 +109,7 @@ import sys
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _ANALYZERS = ("trace", "registry", "program", "jaxpr", "spmd", "cost",
               "serving", "telemetry", "cache", "comm", "fault", "ckpt",
-              "concurrency", "numerics")
+              "concurrency", "numerics", "drift")
 
 
 def _source_paths(paths, include_tests=False):
@@ -343,13 +356,22 @@ def _run_numerics(paths, include_tests=False):
     return findings
 
 
+def _run_drift(_paths, include_tests=False):
+    """PD12xx: retrace + fingerprint every representative program and
+    compare against the committed ``programs.lock.json`` (see
+    analysis/drift_check.py). ``--update-lock`` regenerates the lock."""
+    from paddle_tpu.analysis.drift_check import check_drift
+
+    return check_drift()
+
+
 _RUNNERS = {"trace": _run_trace, "registry": _run_registry,
             "program": _run_program, "jaxpr": _run_jaxpr,
             "spmd": _run_spmd, "cost": _run_cost,
             "serving": _run_serving, "telemetry": _run_telemetry,
             "cache": _run_cache, "comm": _run_comm, "fault": _run_fault,
             "ckpt": _run_ckpt, "concurrency": _run_concurrency,
-            "numerics": _run_numerics}
+            "numerics": _run_numerics, "drift": _run_drift}
 
 # analyzer -> its finding-code family prefix, so a crash finding
 # (<PREFIX>999) stays visible under --select filters for that family
@@ -357,7 +379,7 @@ _FAMILY_PREFIX = {"trace": "TS", "registry": "RC", "program": "PV",
                   "jaxpr": "JX", "spmd": "SP", "cost": "CM",
                   "serving": "JX", "telemetry": "OB", "cache": "CC",
                   "comm": "QZ", "fault": "FT", "ckpt": "CK",
-                  "concurrency": "CX", "numerics": "NM"}
+                  "concurrency": "CX", "numerics": "NM", "drift": "PD"}
 
 
 def run_analyzers(selected=_ANALYZERS, paths=None, include_tests=False):
@@ -442,7 +464,20 @@ def main(argv=None) -> int:
     parser.add_argument("--ignore", action="append", metavar="CODES",
                         help="drop findings whose code starts with one of "
                              "these comma-separated prefixes")
+    parser.add_argument("--update-lock", action="store_true",
+                        help="regenerate programs.lock.json from a fresh "
+                             "build of every representative program "
+                             "(deterministic: byte-identical when nothing "
+                             "changed), then exit without linting")
     args = parser.parse_args(argv)
+
+    if args.update_lock:
+        from paddle_tpu.analysis.drift_check import lock_digest, update_lock
+
+        path = update_lock()
+        print(f"tools.lint: wrote {path} "
+              f"(sha256 {lock_digest(path)[:16]})")
+        return 0
 
     selected = tuple(dict.fromkeys(args.analyzer)) if args.analyzer else _ANALYZERS
     findings, crashed, timings = run_analyzers(selected, args.paths,

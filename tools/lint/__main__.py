@@ -1,5 +1,14 @@
+import os
 import sys
 
-from . import main
+# Mirror tests/conftest.py: the drift family's gspmd/zero1 demo tiers
+# need 8 devices, so expose them on the CPU host platform before jax
+# imports (a real TPU backend ignores this flag entirely).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+from . import main  # noqa: E402
 
 sys.exit(main())
