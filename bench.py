@@ -731,7 +731,14 @@ def _decode_serving_bench(max_new=64, seconds_cap=120.0):
       streams equal the slot-pool oracle and the sequential runs bit for
       bit;
     - ``kv_pool_utilization`` — live tokens / allocated page tokens, the
-      bench_trend HIGHER_IS_BETTER extra.
+      bench_trend HIGHER_IS_BETTER extra;
+    - ``spec_*`` (ISSUE 20) — a third arm at the SAME pool bytes runs
+      self-speculative decoding (k=4, full-depth draft on this 1-layer
+      model): ``spec_net_tokens_per_sec`` / ``spec_speedup_vs_paged``
+      must beat the plain paged arm (each round commits up to k+1
+      tokens for 2 dispatches instead of k+1), ``spec_accept_rate``
+      rides bench_trend, and ``spec_bit_exact_vs_paged`` proves the
+      greedy streams never moved.
     """
     import numpy as np
 
@@ -819,6 +826,32 @@ def _decode_serving_bench(max_new=64, seconds_cap=120.0):
     oracle.shutdown(drain=True)
     oracle_decode = oracle_report.get("decode") or {}
 
+    # speculation arm (ISSUE 20): same weights, same prompts, same pool
+    # bytes — k=4 proposals from the truncated-layer draft (full depth
+    # on this 1-layer bench model, so acceptance ~= 1 and the round
+    # commits k+1 tokens for 2 program dispatches where the paged arm
+    # pays k+1; the bench is dispatch-bound by design, the same regime
+    # accelerator decode serving runs in)
+    spec_stats = ServingStats()
+    spec = serving.DecodeEngine(
+        model, max_slots=16, max_seq=MAX_SEQ, seq_buckets=SEQ_BUCKETS,
+        prefill_max_batch=1, stats=spec_stats, kv_mode="paged",
+        page_size=PAGE, pool_pages=(SLOT_CAP + 1) * MAX_SEQ // PAGE - 1,
+        speculate_k=4, spec_draft_layers=1, spec_min_accept=0.0)
+    spec.warmup()
+    spec_bytes = spec.kv_pool.device_bytes()
+    t0 = time.perf_counter()
+    spec_reqs = [spec.submit(f"tenant{i % 2}", p, max_new_tokens=max_new)
+                 for i, p in enumerate(prompts)]
+    spec_outs = [r.result(seconds_cap) for r in spec_reqs]
+    spec_wall = time.perf_counter() - t0
+    spec_decode_s = spec_wall - spec_stats._decode["prefill_s"]
+    spec_report = spec.serving_report()
+    spec.shutdown(drain=True)
+    spec_decode = spec_report.get("decode") or {}
+    spec_tokens = sum(len(o) for o in spec_outs)
+    spec_tps = spec_tokens / spec_decode_s if spec_decode_s > 0 else None
+
     paged_peak = decode.get("slot_occupancy_peak") or 0
     slot_peak = oracle_decode.get("slot_occupancy_peak") or 0
     cont_tps = tokens / cont_decode_s if cont_decode_s > 0 else None
@@ -860,6 +893,22 @@ def _decode_serving_bench(max_new=64, seconds_cap=120.0):
         "decode_slots": engine.max_slots,
         "decode_expired": report.get("expired", 0),
         "decode": decode,
+        # the self-speculation arm (trend-gated: spec_accept_rate and
+        # spec_net_tokens_per_sec ride bench_trend DEFAULT_EXTRAS)
+        "spec_k": spec_report.get("speculate_k"),
+        "spec_draft_layers": spec_report.get("spec_draft_layers"),
+        "spec_tokens": spec_tokens,
+        "spec_net_tokens_per_sec": round(spec_tps, 1) if spec_tps else None,
+        "spec_speedup_vs_paged": (round(spec_tps / cont_tps, 2)
+                                  if spec_tps and cont_tps else None),
+        "spec_accept_rate": spec_decode.get("spec_accept_rate"),
+        "spec_net_tokens_per_full_pass": spec_decode.get(
+            "spec_net_tokens_per_full_pass"),
+        "spec_rounds": spec_decode.get("spec_rounds"),
+        "spec_bit_exact_vs_paged": bool(all(
+            np.array_equal(a, b) for a, b in zip(spec_outs, outs))),
+        "spec_compiles_after_warmup": spec_report["compiles_after_warmup"],
+        "spec_pool_bytes_equal": bool(spec_bytes == bytes_at_warmup),
     }
 
 

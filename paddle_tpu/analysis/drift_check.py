@@ -347,9 +347,12 @@ def _serving_fingerprints(programs, rung_grids) -> None:
 
 def _decode_fingerprints(programs, rung_grids) -> None:
     """The paged-decode rung grid: every ``("decode", b, t)`` /
-    ``("prefill", b, s)`` specialization of a 1-layer tiny GPT over a
-    KVPagePool, retraced abstractly (``make_jaxpr`` over the program
-    bodies with the rungs' own zero-arg templates — zero compiles)."""
+    ``("prefill", b, s)`` / ``("draft", b, t)`` / ``("verify", b, t)``
+    specialization of a 1-layer tiny GPT over a KVPagePool, retraced
+    abstractly (``make_jaxpr`` over the program bodies with the rungs'
+    own zero-arg templates — zero compiles). Speculation rungs use
+    ``speculate_k=2`` with a full-depth (1-layer) draft — the same
+    degenerate-draft shape the demo decode engine audits."""
     import jax
     import numpy as np
 
@@ -367,19 +370,21 @@ def _decode_fingerprints(programs, rung_grids) -> None:
                       num_heads=2, head_dim=16)
     progs = PagedDecodePrograms(model, pool, seq_ladder=[8, 16],
                                 prefill_batch_rungs=[1, 2],
-                                decode_rungs=[1, 2], max_seq=16)
+                                decode_rungs=[1, 2], max_seq=16,
+                                speculate_k=2, draft_layers=1)
 
     def sds(a):
         return jax.ShapeDtypeStruct(np.shape(a), a.dtype)
 
-    params_sds = jax.tree_util.tree_map(sds, progs.params)
     donation = tuple(f"arg{i}" for i in progs._donate)
+    fns = {"decode": progs._decode_fn, "prefill": progs._prefill_fn,
+           "draft": progs._draft_fn, "verify": progs._verify_fn}
     grid = []
     for key in progs.rungs:
         arg_sds = tuple(sds(a) for a in progs._zero_args(key))
-        fn = progs._decode_fn if key[0] == "decode" else progs._prefill_fn
-        closed = jax.make_jaxpr(fn)(params_sds, sds(pool.k), sds(pool.v),
-                                    *arg_sds)
+        params_sds = jax.tree_util.tree_map(sds, progs._call_params(key))
+        closed = jax.make_jaxpr(fns[key[0]])(params_sds, sds(pool.k),
+                                             sds(pool.v), *arg_sds)
         rung = ":".join(str(p) for p in key)
         grid.append(rung)
         programs[f"decode/paged:{rung}"] = fingerprint_jaxpr(

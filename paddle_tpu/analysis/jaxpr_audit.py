@@ -78,6 +78,15 @@ JX333  slot leak               KV slots remain allocated with no active
                                request: a retired sequence never released
                                its slot and the pool will exhaust
                                (warning)
+JX334  page fragmentation      mean utilization of in-use KV pages sits
+                               under the fragmentation watermark: the page
+                               size is too coarse for the traffic
+                               (warning)
+JX335  spec rung parity        a speculating decode engine's draft/verify
+                               program grids disagree with each other or
+                               with the plain decode grid — the first
+                               speculation round on an uncovered (batch ×
+                               table) shape traces mid-traffic (warning)
 
 Entry points: ``CompiledFunction.audit()`` / ``TrainStep.audit()`` (this
 module's :func:`audit_compiled_function`), and the ``jaxpr`` analyzer of
@@ -564,6 +573,27 @@ def audit_serving(engine) -> List[Finding]:
                     "little of the pages they hold; shrink "
                     "FLAGS_serving_page_size so residency tracks live "
                     "tokens, not page granularity", name))
+    # JX335: self-speculation rung-grid parity (paged decode engines
+    # built with speculate_k > 0). The draft and verify families must
+    # cover the SAME (batch × table) grid as plain decode — any hole is
+    # a cold-path retrace waiting for the first speculation round that
+    # assembles at that shape (warning: it bites only when it lands).
+    progs = getattr(engine, "programs", None)
+    if progs is not None and getattr(progs, "speculate_k", 0):
+        grid = list(getattr(progs, "warmed", None)
+                    or getattr(progs, "rungs", ()) or ())
+        decodes = {k[1:] for k in grid if k[0] == "decode"}
+        drafts = {k[1:] for k in grid if k[0] == "draft"}
+        verifies = {k[1:] for k in grid if k[0] == "verify"}
+        holes = sorted((drafts ^ verifies)
+                       | (decodes - drafts) | (decodes - verifies))
+        if holes:
+            findings.append(Finding(
+                "serving", "JX335", "warning",
+                f"draft/verify rung grid out of parity at {holes}: every "
+                "(batch × table) rung plain decode serves needs BOTH a "
+                "draft and a verify executable, or toggling speculation "
+                "mid-flight compiles inside the request latency", name))
     return findings
 
 
@@ -616,9 +646,10 @@ def record_demo_decode_engine():
     ``serving`` lint analyzer audits alongside the batch demo: a tiny GPT
     behind a paged KV pool, two tenants' mixed prompts joining and
     leaving the running batch. Exercises the full KV path — prefill
-    grid, (batch × table) decode rungs, page alloc/release — so
-    JX330-JX334 all see real state. One definition so the CLI and the
-    test gate audit the SAME engine."""
+    grid, (batch × table) decode rungs, draft/verify speculation rungs,
+    page alloc/release and speculative rollback — so JX330-JX335 all
+    see real state. One definition so the CLI and the test gate audit
+    the SAME engine."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -648,7 +679,8 @@ def record_demo_decode_engine():
     from ..serving import DecodeEngine
 
     engine = DecodeEngine(model, max_slots=2, max_seq=16, seq_buckets=[8],
-                          prefill_max_batch=2, stats=ServingStats())
+                          prefill_max_batch=2, speculate_k=2,
+                          spec_draft_layers=1, stats=ServingStats())
     engine.warmup()
     rs = np.random.RandomState(0)
     reqs = [engine.submit(t, rs.randint(0, 512, size=n).astype(np.int32),

@@ -194,6 +194,22 @@ define_flag("serving_frag_warn_utilization", 0.2,
             "decode serving: JX334 page-fragmentation watermark — warn "
             "when mean live-token utilization of in-use pages sampled "
             "across the run falls below this fraction")
+define_flag("serving_spec_k", 0,
+            "decode serving: draft tokens proposed per self-speculation "
+            "round — one truncated-layer draft program proposes k "
+            "tokens, one full-model verify pass scores all k+1 "
+            "positions (serving/decode.py); 0 disables speculation and "
+            "the draft/verify program families entirely")
+define_flag("serving_spec_draft_layers", 1,
+            "decode serving: transformer layers in the truncated-layer "
+            "draft prefix of self-speculative decoding (clamped to the "
+            "model's layer count; the draft shares the serving weights "
+            "zero-copy — no second model, no extra weight memory)")
+define_flag("serving_spec_min_accept", 0.3,
+            "decode serving: rolling draft-acceptance floor — a "
+            "speculating request whose acceptance rate drops below this "
+            "fraction auto-disables its own speculation lane (the batch "
+            "falls back to plain decode once every lane has disabled)")
 define_flag("cost_while_default_trips", 1,
             "cost model: trip-count multiplier assumed for a while-loop "
             "whose counter pattern cannot be statically derived (1 keeps "

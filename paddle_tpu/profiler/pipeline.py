@@ -140,6 +140,14 @@ class ServingStats:
                 "prefill_steps": 0, "decode_steps": 0,
                 "prefill_s": 0.0, "decode_s": 0.0,
                 "prefill_ms": [], "decode_ms": [],   # bounded rings
+                # self-speculation split (ISSUE 20): draft/verify program
+                # calls keyed like the other step kinds, plus per-round
+                # acceptance accounting
+                "draft_steps": 0, "verify_steps": 0,
+                "draft_s": 0.0, "verify_s": 0.0,
+                "draft_ms": [], "verify_ms": [],     # bounded rings
+                "spec_rounds": 0, "spec_proposed": 0,
+                "spec_accepted": 0, "spec_committed": 0,
                 "tokens": 0, "t_first": None, "t_last": None,
                 "occ_sum": 0, "occ_samples": 0, "occ_peak": 0,
                 "slots": 0,
@@ -204,10 +212,12 @@ class ServingStats:
 
     def record_decode_step(self, kind: str, seconds: float, n_lanes: int,
                            n_tokens: int):
-        """One decode-tier program call: ``kind`` is ``"prefill"`` or
-        ``"decode"``; ``n_tokens`` real tokens were emitted by ``n_lanes``
-        real lanes (pad lanes excluded). Feeds the prefill-vs-decode
-        latency split and tokens/sec."""
+        """One decode-tier program call: ``kind`` is ``"prefill"``,
+        ``"decode"``, ``"draft"`` or ``"verify"``; ``n_tokens`` real
+        tokens were emitted by ``n_lanes`` real lanes (pad lanes
+        excluded — a draft call emits 0, its round's committed tokens
+        land on the verify call). Feeds the per-kind latency split and
+        tokens/sec."""
         now = time.perf_counter()
         with self._lock:
             cell = self._decode
@@ -221,6 +231,21 @@ class ServingStats:
             if cell["t_first"] is None:
                 cell["t_first"] = now - seconds
             cell["t_last"] = now
+
+    def record_spec_round(self, proposed: int, accepted: int,
+                          committed: int):
+        """One self-speculation round's acceptance accounting across its
+        lanes: ``proposed`` draft tokens, ``accepted`` of them matched
+        the full-model verify pass, ``committed`` tokens entered streams
+        (accepted + the verify-pass bonus token per lane, clipped by
+        eos/max_new). Feeds ``spec_accept_rate`` and
+        ``spec_net_tokens_per_full_pass`` in the summary."""
+        with self._lock:
+            cell = self._decode
+            cell["spec_rounds"] += 1
+            cell["spec_proposed"] += int(proposed)
+            cell["spec_accepted"] += int(accepted)
+            cell["spec_committed"] += int(committed)
 
     def record_slot_occupancy(self, in_use: int, capacity: int):
         """KV slot occupancy at a step boundary (peak proves slot reuse:
@@ -319,7 +344,7 @@ class ServingStats:
             v = self._pct(vals, q)
             return round(v, 3) if v is not None else None
 
-        return {
+        out = {
             "prefill_steps": cell["prefill_steps"],
             "decode_steps": cell["decode_steps"],
             "prefill_p50_ms": pct(prefill, 0.50),
@@ -335,6 +360,28 @@ class ServingStats:
             "slot_occupancy_peak": cell["occ_peak"],
             "slots": cell["slots"],
         }
+        if cell["spec_rounds"]:
+            draft = sorted(cell["draft_ms"])
+            verify = sorted(cell["verify_ms"])
+            out.update(
+                spec_rounds=cell["spec_rounds"],
+                spec_tokens_proposed=cell["spec_proposed"],
+                spec_tokens_accepted=cell["spec_accepted"],
+                spec_tokens_committed=cell["spec_committed"],
+                spec_accept_rate=(
+                    round(cell["spec_accepted"]
+                          / max(cell["spec_proposed"], 1), 4)),
+                # >1.0 is the whole point: tokens committed per FULL-model
+                # program call (verify) vs the 1.0 a plain decode step gets
+                spec_net_tokens_per_full_pass=(
+                    round(cell["spec_committed"]
+                          / max(cell["spec_rounds"], 1), 3)),
+                draft_steps=cell["draft_steps"],
+                verify_steps=cell["verify_steps"],
+                draft_p50_ms=pct(draft, 0.50),
+                verify_p50_ms=pct(verify, 0.50),
+            )
+        return out
 
     def _tenant_summary(self, cell: dict, window: float) -> dict:
         """Per-tenant breakdown (caller holds the lock): latency

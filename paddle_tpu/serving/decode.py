@@ -127,6 +127,7 @@ class DecodePrograms:
         self._heads = cfg.num_attention_heads
         self._head_dim = cfg.head_dim
         self._hidden = cfg.hidden_size
+        self._max_pos = int(cfg.max_position_embeddings)
         self._eps = float(cfg.layer_norm_epsilon)
         self._tied = bool(cfg.tie_word_embeddings)
         self._scale = 1.0 / math.sqrt(cfg.head_dim)
@@ -315,7 +316,8 @@ class DecodePrograms:
                 self.restored.append(key)
                 return
             lowered = self._jitted(key).lower(
-                self.params, self.pool.k, self.pool.v, *args)  # traces += 1
+                self._call_params(key), self.pool.k, self.pool.v,
+                *args)  # traces += 1
             compiled = lowered.compile()
             cc.store_executable(
                 digest, compiled,
@@ -325,9 +327,23 @@ class DecodePrograms:
         # in-memory warm: one traced call against the pad slot (harmless
         # writes land in the trash slot); outputs are committed so a
         # donation backend keeps the pool buffers alive
-        k, v, _ = self._jitted(key)(self.params, self.pool.k, self.pool.v,
-                                    *args)
+        k, v, _ = self._jitted(key)(self._call_params(key), self.pool.k,
+                                    self.pool.v, *args)
         self.pool.commit(k, v)
+
+    def _call_params(self, key) -> dict:
+        """The parameter pytree rung ``key`` runs against. The base
+        families serve everything from ``self.params``; the paged family
+        routes its draft rungs through the truncated-layer view."""
+        return self.params
+
+    def _flip_params(self, staged) -> None:
+        """The one reference assignment a hot swap commits (caller holds
+        the programs lock). Subclasses with DERIVED parameter views — the
+        paged family's truncated-layer draft tier — extend this so every
+        tier flips under the same lock acquisition: a draft program can
+        never observe pre-swap weights once ``swap_params`` returns."""
+        self.params = staged
 
     def swap_params(self, model) -> int:
         """Zero-downtime weight hot-swap for the decode tier: re-extract
@@ -364,7 +380,7 @@ class DecodePrograms:
         # assignment under the lock
         staged = jax.device_put(new_params)
         with self._lock:
-            self.params = staged
+            self._flip_params(staged)
         return len(new_leaves)
 
     # -------------------------------------------------------------- calls
@@ -404,16 +420,50 @@ class PagedDecodePrograms(DecodePrograms):
       / top-p / raw uint32 PRNG key pair): sampling is data too, never
       a retrace. ``temp == 0`` lanes take the argmax branch bit-exactly
       — the greedy audit mode the slot oracle is compared against.
+
+    With ``speculate_k > 0`` two more program families join the same
+    (batch rung × table rung) grid — self-speculative decoding over the
+    page pool (ISSUE 20):
+
+    - ``draft``: ``speculate_k`` UNROLLED decode steps through a
+      truncated-layer prefix of the SAME weights (``draft_layers``
+      blocks, shared zero-copy — no second model, no extra weight
+      memory). One dispatch proposes k tokens, writing the draft
+      layers' K/V along the way.
+    - ``verify``: one batched FULL-model pass over all ``k + 1``
+      positions (last committed token + the k proposals), rewriting
+      every layer's K/V at those positions with true-token inputs and
+      choosing a token at each position with the request's canonical
+      ``[seed, token_index]`` key. Committed tokens always come from
+      the verify pass, so both the greedy and the sampled stream equal
+      the non-speculative stream token for token; the draft only
+      decides HOW MANY commit per round.
+
+    Both families bake ``k`` and ``draft_layers`` into ``_model_key``
+    (compile-time constants) and warm with everything else, so flipping
+    speculation on or off mid-flight never traces.
     """
 
     def __init__(self, model, pool: KVPagePool, *,
                  seq_ladder: Sequence[int],
                  prefill_batch_rungs: Sequence[int],
                  decode_rungs: Sequence[int],
-                 max_seq: int):
+                 max_seq: int,
+                 speculate_k: int = 0,
+                 draft_layers: Optional[int] = None):
+        import jax
+
         from ..jit.bucketing import table_ladder
 
         self.max_seq = int(max_seq)
+        self.speculate_k = max(int(speculate_k), 0)
+        n_layers = int(model.config.num_hidden_layers)
+        dl = int(get_flag("serving_spec_draft_layers")
+                 if draft_layers is None else draft_layers)
+        # clamp, never reject: a 1-layer demo model drafts with its one
+        # block — a degenerate full-depth draft that accepts 100% and
+        # still wins on dispatch count (2 calls commit up to k+1 tokens)
+        self.draft_layers = max(1, min(dl, n_layers))
         # super() derives _model_key from pool.k.shape (already the page
         # layout) and jits self._prefill_fn/_decode_fn — the overrides
         # below, bound through normal method resolution
@@ -424,8 +474,40 @@ class PagedDecodePrograms(DecodePrograms):
         self.table_rungs = table_ladder(self.max_seq, pool.page_size)
         # disambiguate from a slot pool that happens to share shapes,
         # and cover the table ladder (it shapes the warmed rung set)
+        # plus the speculation constants unrolled into draft/verify
         self._model_key = self._model_key + (
-            "paged", int(pool.page_size), tuple(self.table_rungs))
+            "paged", int(pool.page_size), tuple(self.table_rungs),
+            "spec", self.speculate_k, self.draft_layers)
+        self.draft_params = (self._draft_view(self.params)
+                             if self.speculate_k else None)
+        if self.speculate_k:
+            self._jit_draft = jax.jit(self._draft_fn,
+                                      donate_argnums=self._donate)
+            self._jit_verify = jax.jit(self._verify_fn,
+                                       donate_argnums=self._donate)
+
+    # -------------------------------------------------------- draft params
+    def _draft_view(self, params: dict) -> dict:
+        """The draft tier's parameter view: the first ``draft_layers``
+        transformer blocks plus the shared embedding / final-LN / head
+        leaves. Every leaf IS the full tree's leaf (no copy, no device
+        memory) — truncation drops the TOP of the stack, so the draft's
+        per-layer K/V is bitwise what the full model computes for those
+        layers, and verify can overwrite it in place."""
+        view = {k: v for k, v in params.items() if k != "blocks"}
+        view["blocks"] = list(params["blocks"][:self.draft_layers])
+        return view
+
+    def _flip_params(self, staged) -> None:
+        # one lock acquisition flips BOTH tiers: the draft view is
+        # re-derived from the staged tree, so a mid-speculation hot swap
+        # can never leave the draft proposing with stale weights
+        super()._flip_params(staged)
+        if self.speculate_k:
+            self.draft_params = self._draft_view(staged)
+
+    def _call_params(self, key) -> dict:
+        return self.draft_params if key[0] == "draft" else self.params
 
     # ----------------------------------------------------------- sampling
     def _choose_tokens(self, head, temps, top_ks, top_ps, rkeys):
@@ -482,22 +564,43 @@ class PagedDecodePrograms(DecodePrograms):
         cv = kvc.write_prompt_pages(cv, tables, vrows)
         return ck, cv, next_tok
 
-    def _decode_fn(self, params, ck, cv, tokens, tables, positions,
-                   temps, top_ks, top_ps, rkeys):
+    def _paged_step_trunk(self, params, ck, cv, tokens, tables, positions,
+                          *, bounded=False):
+        """One paged decode step's transformer body: ``[B]`` tokens at
+        ``[B]`` positions → (ck, cv, head logits ``[B, V]``), K/V
+        appended through the block tables. Shared verbatim by the plain
+        decode program (``bounded=False`` — the PR 18 trace, byte for
+        byte) and the draft program's unrolled steps.
+
+        ``bounded=True`` adds the speculative overflow clamps: a lane
+        whose draft position runs past ``max_seq`` (or the model's
+        position table) must not corrupt a LIVE page through index
+        clamping, so out-of-range writes are redirected to the pool's
+        pad page 0 and the wpe lookup is clamped. Such a lane's
+        proposals are garbage, but its verify tokens past the boundary
+        are never committed — the scheduler retires it at ``max_seq``.
+        """
         import jax
         import jax.numpy as jnp
 
-        self.traces += 1
         B, T = tables.shape
         ps = self.pool.page_size
         eps = self._eps
-        x = params["wte"][tokens] + params["wpe"][positions]
+        if bounded:
+            x = (params["wte"][tokens]
+                 + params["wpe"][jnp.minimum(positions, self._max_pos - 1)])
+        else:
+            x = params["wte"][tokens] + params["wpe"][positions]
         # the traced table maps token position -> page: column j of the
         # gathered view IS position j, so the slot program's mask and
         # softmax carry over unchanged (bit-exact greedy contract)
         col = jnp.arange(T * ps)
         page_idx = (positions // ps).astype(jnp.int32)
+        if bounded:
+            page_idx = jnp.minimum(page_idx, T - 1)
         pages = jnp.take_along_axis(tables, page_idx[:, None], axis=1)[:, 0]
+        if bounded:
+            pages = jnp.where(positions < self.max_seq, pages, 0)
         offsets = (positions % ps).astype(jnp.int32)
         for li, blk in enumerate(params["blocks"]):
             h = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
@@ -520,9 +623,104 @@ class PagedDecodePrograms(DecodePrograms):
             x = x + jax.nn.gelu(h2 @ blk["fc1_w"] + blk["fc1_b"],
                                 approximate=True) @ blk["fc2_w"] + blk["fc2_b"]
         hfin = _ln(x, params["lnf_w"], params["lnf_b"], eps)
-        next_tok = self._choose_tokens(self._logits_head(params, hfin),
-                                       temps, top_ks, top_ps, rkeys)
+        return ck, cv, self._logits_head(params, hfin)
+
+    def _decode_fn(self, params, ck, cv, tokens, tables, positions,
+                   temps, top_ks, top_ps, rkeys):
+        self.traces += 1
+        ck, cv, head = self._paged_step_trunk(params, ck, cv, tokens,
+                                              tables, positions)
+        next_tok = self._choose_tokens(head, temps, top_ks, top_ps, rkeys)
         return ck, cv, next_tok
+
+    @staticmethod
+    def _shift_keys(rkeys, j):
+        """The request's canonical sampling key for the j-th token of a
+        speculation round: host keys are ``[seed, len(generated)]`` at
+        round start, so offsetting the counter lane by j reproduces
+        EXACTLY the key the non-speculative stream would use for that
+        token index — per-seed determinism survives speculation."""
+        import jax.numpy as jnp
+
+        if j == 0:
+            return rkeys
+        return rkeys + jnp.asarray([0, j], jnp.uint32)[None, :]
+
+    def _draft_fn(self, params, ck, cv, tokens, tables, positions,
+                  temps, top_ks, top_ps, rkeys):
+        """``speculate_k`` decode steps through the truncated-layer
+        params, unrolled into ONE program — a speculation round costs
+        two dispatches (draft + verify) instead of k+1. Writes the
+        draft layers' K/V (verify rewrites the accepted positions with
+        full-model values anyway) and returns the proposals ``[B, k]``.
+        """
+        import jax.numpy as jnp
+
+        self.traces += 1
+        tok, pos, drafts = tokens, positions, []
+        for j in range(self.speculate_k):
+            ck, cv, head = self._paged_step_trunk(
+                params, ck, cv, tok, tables, pos, bounded=True)
+            tok = self._choose_tokens(head, temps, top_ks, top_ps,
+                                      self._shift_keys(rkeys, j))
+            drafts.append(tok)
+            pos = pos + 1
+        return ck, cv, jnp.stack(drafts, axis=1)
+
+    def _verify_fn(self, params, ck, cv, tokens, tables, positions,
+                   temps, top_ks, top_ps, rkeys):
+        """One batched full-model pass over all ``k + 1`` positions:
+        ``tokens[:, 0]`` is each lane's last committed token at its
+        write position p, ``tokens[:, 1:]`` the draft proposals at
+        p+1..p+k. Every layer's K/V is appended at ALL k+1 positions
+        before the gather, masked causally per query column, and a
+        token is chosen at each position with the canonical shifted
+        key — the j-th verify token is bitwise the token the plain
+        decode program would emit after committing tokens 0..j-1, which
+        is the whole bit-exactness contract."""
+        import jax
+        import jax.numpy as jnp
+
+        self.traces += 1
+        B, K1 = tokens.shape
+        T = tables.shape[1]
+        ps = self.pool.page_size
+        eps = self._eps
+        pos = positions[:, None] + jnp.arange(K1, dtype=jnp.int32)[None, :]
+        x = (params["wte"][tokens]
+             + params["wpe"][jnp.minimum(pos, self._max_pos - 1)])
+        col = jnp.arange(T * ps)
+        page_idx = jnp.minimum((pos // ps).astype(jnp.int32), T - 1)
+        pages = jnp.take_along_axis(tables, page_idx, axis=1)
+        pages = jnp.where(pos < self.max_seq, pages, 0)  # pad-page spill
+        offsets = (pos % ps).astype(jnp.int32)
+        # [B, heads, K1 queries, T*ps cols]: query j sees cols <= p+j
+        mask = col[None, None, None, :] <= pos[:, None, :, None]
+        for li, blk in enumerate(params["blocks"]):
+            h = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
+            qkv = (h @ blk["qkv_w"] + blk["qkv_b"]).reshape(
+                B, K1, self._heads, 3, self._head_dim)
+            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+            ck = kvc.append_token_paged(ck, li, pages, offsets, k)
+            cv = kvc.append_token_paged(cv, li, pages, offsets, v)
+            keys = kvc.gather_pages(ck, li, tables)  # [B, T*ps, h, d]
+            vals = kvc.gather_pages(cv, li, tables)
+            logits = jnp.einsum("bshd,bthd->bhst", q, keys) * self._scale
+            logits = jnp.where(mask, logits, -1e30)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(x.dtype)
+            att = jnp.einsum("bhst,bthd->bshd", probs, vals).reshape(
+                B, K1, self._hidden)
+            x = x + att @ blk["out_w"] + blk["out_b"]
+            h2 = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
+            x = x + jax.nn.gelu(h2 @ blk["fc1_w"] + blk["fc1_b"],
+                                approximate=True) @ blk["fc2_w"] + blk["fc2_b"]
+        hfin = _ln(x, params["lnf_w"], params["lnf_b"], eps)
+        head = self._logits_head(params, hfin)  # [B, K1, V]
+        vtoks = [self._choose_tokens(head[:, j], temps, top_ks, top_ps,
+                                     self._shift_keys(rkeys, j))
+                 for j in range(K1)]
+        return ck, cv, jnp.stack(vtoks, axis=1)
 
     # -------------------------------------------------------------- rungs
     def _prefill_table_cols(self, seq_rung: int) -> int:
@@ -532,9 +730,19 @@ class PagedDecodePrograms(DecodePrograms):
     def rungs(self) -> List[tuple]:
         """``("decode", b, t)`` over (batch × table) rungs plus
         ``("prefill", b, s)`` over the (batch × seq) grid — the prefill
-        table width is a function of the seq rung, not a third axis."""
+        table width is a function of the seq rung, not a third axis.
+        With speculation enabled, ``("draft", b, t)`` and ``("verify",
+        b, t)`` join over the SAME (batch × table) grid — every batch
+        shape a plain decode step can take, a speculation round can
+        take too, so toggling speculation mid-flight never meets a cold
+        rung (JX335 audits the parity)."""
         out = [("decode", b, t) for b in self.decode_rungs
                for t in self.table_rungs]
+        if self.speculate_k:
+            out += [("draft", b, t) for b in self.decode_rungs
+                    for t in self.table_rungs]
+            out += [("verify", b, t) for b in self.decode_rungs
+                    for t in self.table_rungs]
         out += [("prefill", b, s) for b in self.prefill_batch_rungs
                 for s in self.seq_ladder]
         return out
@@ -544,16 +752,29 @@ class PagedDecodePrograms(DecodePrograms):
             return (np.zeros(b, np.float32), np.zeros(b, np.int32),
                     np.ones(b, np.float32), np.zeros((b, 2), np.uint32))
 
-        if key[0] == "decode":
+        if key[0] in ("decode", "draft"):
             _, b, t = key
             return (np.zeros(b, np.int32),          # tokens
                     np.zeros((b, t), np.int32),     # tables -> pad page
                     np.zeros(b, np.int32),          # positions
                     *sample_args(b))
+        if key[0] == "verify":
+            _, b, t = key
+            return (np.zeros((b, self.speculate_k + 1), np.int32),
+                    np.zeros((b, t), np.int32),
+                    np.zeros(b, np.int32),
+                    *sample_args(b))
         _, b, s = key
         t = self._prefill_table_cols(s)
         return (np.zeros((b, s), np.int32), np.ones(b, np.int32),
                 np.zeros((b, t), np.int32), *sample_args(b))
+
+    def _jitted(self, key):
+        if key[0] == "draft":
+            return self._jit_draft
+        if key[0] == "verify":
+            return self._jit_verify
+        return super()._jitted(key)
 
     # -------------------------------------------------------------- calls
     def prefill(self, ck, cv, tokens, lengths, tables,
@@ -573,6 +794,26 @@ class PagedDecodePrograms(DecodePrograms):
         if ex is not None:
             return ex(self.params, ck, cv, *args)
         return self._jit_decode(self.params, ck, cv, *args)
+
+    def draft(self, ck, cv, tokens, tables, positions,
+              temps, top_ks, top_ps, rkeys):
+        """One draft dispatch: k truncated-layer steps, proposals [B, k]."""
+        key = ("draft", int(tokens.shape[0]), int(tables.shape[1]))
+        args = (tokens, tables, positions, temps, top_ks, top_ps, rkeys)
+        ex = self._aot.get(key)
+        if ex is not None:
+            return ex(self.draft_params, ck, cv, *args)
+        return self._jit_draft(self.draft_params, ck, cv, *args)
+
+    def verify(self, ck, cv, tokens, tables, positions,
+               temps, top_ks, top_ps, rkeys):
+        """One verify dispatch: full-model scores at all k+1 positions."""
+        key = ("verify", int(tokens.shape[0]), int(tables.shape[1]))
+        args = (tokens, tables, positions, temps, top_ks, top_ps, rkeys)
+        ex = self._aot.get(key)
+        if ex is not None:
+            return ex(self.params, ck, cv, *args)
+        return self._jit_verify(self.params, ck, cv, *args)
 
 
 class DecodeEngine(EngineBase):
@@ -614,6 +855,9 @@ class DecodeEngine(EngineBase):
                  kv_mode: str = "paged",
                  page_size: Optional[int] = None,
                  pool_pages: Optional[int] = None,
+                 speculate_k: Optional[int] = None,
+                 spec_draft_layers: Optional[int] = None,
+                 spec_min_accept: Optional[float] = None,
                  max_queue: Optional[int] = None,
                  tenant_quota: Optional[int] = None,
                  request_ttl_ms: Optional[float] = None,
@@ -651,9 +895,18 @@ class DecodeEngine(EngineBase):
         if seq_buckets[-1] > max_seq:
             raise ValueError(f"seq bucket {seq_buckets[-1]} exceeds "
                              f"max_seq {max_seq}")
+        spec_k = int(get_flag("serving_spec_k")
+                     if speculate_k is None else speculate_k)
+        spec_k = max(spec_k, 0)
+        if kv_mode == "slots" and spec_k > 0:
+            raise ValueError(
+                "self-speculative decoding rides the paged block tables; "
+                "the slots-mode engine is the greedy oracle — use "
+                "kv_mode='paged' for speculate_k > 0")
         self.kv_mode = kv_mode
         self.max_slots = max_slots  # max concurrent lanes in either mode
         self.eos_id = eos_id
+        self.speculate_k = spec_k
         self._model = model  # the weight source swap_weights re-extracts
         from ..reliability.policy import RetryPolicy
 
@@ -688,12 +941,16 @@ class DecodeEngine(EngineBase):
                 seq_ladder=seq_buckets,
                 prefill_batch_rungs=powers_of_two_buckets(1, prefill_max),
                 decode_rungs=powers_of_two_buckets(1, max_slots),
-                max_seq=max_seq)
+                max_seq=max_seq,
+                speculate_k=spec_k,
+                draft_layers=spec_draft_layers)
             self._scheduler = PagedDecodeScheduler(
                 self.queue, self.programs, self.kv_pool,
                 max_lanes=max_slots, prefill_max_batch=prefill_max,
                 eos_id=eos_id, stats=stats, retry=retry,
-                breakers=self.breakers)
+                breakers=self.breakers,
+                speculate_k=spec_k,
+                spec_min_accept=spec_min_accept)
 
     # ------------------------------------------------------------ lifecycle
     def warmup(self) -> "DecodeEngine":
@@ -708,7 +965,8 @@ class DecodeEngine(EngineBase):
     # ------------------------------------------------------------- serving
     def submit(self, tenant: str, prompt, max_new_tokens: int = 16,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 1.0, seed: int = 0) -> DecodeRequest:
+               top_p: float = 1.0, seed: int = 0,
+               speculate: Optional[bool] = None) -> DecodeRequest:
         """Enqueue one generation request; returns the future. The prompt
         must fit the seq ladder; generation stops at ``max_new_tokens``,
         the engine's ``eos_id``, or the ``max_seq`` capacity — whichever
@@ -719,15 +977,27 @@ class DecodeEngine(EngineBase):
         top-p truncation from the request's own PRNG stream (``seed``):
         deterministic per seed, independent of batch composition. The
         sampling knobs ride the compiled programs as traced data (paged
-        engines); a slots-mode engine serves greedy only."""
+        engines); a slots-mode engine serves greedy only.
+
+        ``speculate`` opts the request in or out of self-speculative
+        decoding (``None`` = the engine default: on iff the engine was
+        built with ``speculate_k > 0``). Speculation never changes the
+        token stream — committed tokens always come from the full-model
+        verify pass — only how many commit per full-model call."""
         if self.kv_mode == "slots" and temperature > 0:
             raise ValueError("sampled decoding needs kv_mode='paged'; "
                              "the slot-pool engine is the greedy oracle")
+        if speculate and not self.speculate_k:
+            raise ValueError(
+                "speculate=True needs an engine built with speculate_k > 0 "
+                "(or FLAGS_serving_spec_k) — the draft/verify programs are "
+                "compile-time families, not a per-request switch")
         if not self._started:
             raise RuntimeError("engine not started: call warmup() first")
+        spec = bool(self.speculate_k) if speculate is None else bool(speculate)
         req = DecodeRequest(tenant, prompt, max_new_tokens,
                             temperature=temperature, top_k=top_k,
-                            top_p=top_p, seed=seed)
+                            top_p=top_p, seed=seed, speculate=spec)
         top = self.programs.seq_ladder[-1]
         if req.prompt.size > top:
             raise ValueError(
@@ -753,6 +1023,20 @@ class DecodeEngine(EngineBase):
         """Sequences currently holding a slot (decoding or awaiting
         prefill) — the JX333 slot-leak audit's liveness source."""
         return self._scheduler.active_count()
+
+    def set_speculation(self, enabled: bool) -> bool:
+        """Master toggle for self-speculative decoding, safe mid-flight:
+        the scheduler picks the plain-decode or draft+verify path per
+        step, and both program families were warmed together, so flipping
+        this under live traffic costs zero retraces (the churn test's
+        contract). Requires an engine built with ``speculate_k > 0``.
+        Returns the previous setting."""
+        if not self.speculate_k:
+            raise ValueError("engine was built without speculation "
+                             "(speculate_k == 0); nothing to toggle")
+        prev = self._scheduler.spec_enabled
+        self._scheduler.spec_enabled = bool(enabled)
+        return prev
 
     # ------------------------------------------------------------ hot swap
     def swap_weights(self, source) -> dict:
@@ -864,4 +1148,10 @@ class DecodeEngine(EngineBase):
                 kv_pool_utilization=round(util["mean"], 4),
                 kv_shed_requests=self._scheduler.shed_count,
             )
+            if self.speculate_k:
+                report.update(
+                    speculate_k=self.speculate_k,
+                    spec_draft_layers=self.programs.draft_layers,
+                    spec_enabled=self._scheduler.spec_enabled,
+                )
         return report

@@ -127,11 +127,13 @@ class DecodeRequest(Request):
     denominated in slots for the decode tier."""
 
     __slots__ = ("prompt", "max_new_tokens", "generated", "slot", "seq_rung",
-                 "pages", "temperature", "top_k", "top_p", "seed")
+                 "pages", "temperature", "top_k", "top_p", "seed",
+                 "speculate", "spec_live", "spec_proposed", "spec_accepted")
 
     def __init__(self, tenant: str, prompt, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, seed: int = 0):
+                 top_p: float = 1.0, seed: int = 0,
+                 speculate: bool = False):
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("decode request needs a non-empty prompt")
@@ -148,6 +150,17 @@ class DecodeRequest(Request):
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.seed = int(seed)
+        # self-speculative decoding lane policy (ISSUE 20): ``speculate``
+        # is the per-request opt-in; ``spec_live`` drops to False when
+        # the rolling acceptance (accepted/proposed) falls below
+        # FLAGS_serving_spec_min_accept — drafts for this lane are
+        # wasted work, the scheduler stops speculating once every
+        # opted-in lane has disabled. The committed stream is identical
+        # either way (only the tokens-per-full-pass chunking changes).
+        self.speculate = bool(speculate)
+        self.spec_live = bool(speculate)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
     @property
     def position(self) -> int:

@@ -522,13 +522,27 @@ class PagedDecodeScheduler(DecodeScheduler):
                  max_lanes: int, prefill_max_batch: int,
                  eos_id: Optional[int] = None, stats=None,
                  on_step: Optional[Callable] = None, retry=None,
-                 breakers=None):
+                 breakers=None, speculate_k: int = 0,
+                 spec_min_accept: Optional[float] = None):
+        from ..base.flags import get_flag
+
         super().__init__(queue, programs, pool,
                          prefill_max_batch=prefill_max_batch,
                          eos_id=eos_id, stats=stats, on_step=on_step,
                          retry=retry, breakers=breakers)
         self.max_lanes = max(int(max_lanes), 1)
         self.max_seq = int(programs.max_seq)
+        # self-speculation lane policy (ISSUE 20): a beat runs one
+        # draft+verify round instead of one decode step whenever the
+        # master toggle is on AND any lane still speculates — opted-out
+        # lanes ride the round anyway (their committed tokens come from
+        # the same full-model verify pass, so their stream is identical;
+        # only the chunking differs)
+        self.speculate_k = max(int(speculate_k), 0)
+        self.spec_min_accept = float(
+            get_flag("serving_spec_min_accept")
+            if spec_min_accept is None else spec_min_accept)
+        self.spec_enabled = self.speculate_k > 0
         # _active is keyed by request id here (no slot identity exists)
         self.shed_count = 0
         self._starved = set()  # lane ids waiting on a page (gate admission)
@@ -608,8 +622,14 @@ class PagedDecodeScheduler(DecodeScheduler):
             self.pool.release(r.pages)
             r.pages = []
 
-    def _ensure_pages(self, lanes):
-        """Grow each lane's block table to cover its next write position.
+    def _ensure_pages(self, lanes, lookahead: int = 0):
+        """Grow each lane's block table to cover its next write position
+        (plus ``lookahead`` speculative positions — a draft+verify round
+        writes up to k positions past the committed one, and those rows
+        must land in lane-owned pages; the uncommitted suffix rolls back
+        via the free-list after acceptance). The lookahead is capped at
+        the last legal position — overflow writes spill to the pad page
+        inside the bounded programs, never into a live page.
         Returns the lanes ready to step. An INJECTED ``kv.page_alloc``
         fault sheds its lane (the chaos contract: prove the shed path).
         Natural exhaustion is gentler: the starved lane simply sits out
@@ -622,7 +642,8 @@ class PagedDecodeScheduler(DecodeScheduler):
 
         ready, starved = [], []
         for r in lanes:
-            need = int(r.position) // self.pool.page_size + 1
+            last = min(int(r.position) + lookahead, self.max_seq - 1)
+            need = last // self.pool.page_size + 1
             try:
                 while len(r.pages) < need:
                     r.pages.extend(self.pool.alloc(1))
@@ -697,6 +718,10 @@ class PagedDecodeScheduler(DecodeScheduler):
         from ..jit.bucketing import bucket_for
         from ..observability.tracing import tracer
 
+        if (self.speculate_k > 0 and self.spec_enabled
+                and any(r.spec_live for r in self._active.values())):
+            self._spec_round()
+            return
         lanes = sorted(self._active.values(), key=lambda r: r.id)
         lanes = self._ensure_pages(lanes)
         if not lanes:
@@ -723,6 +748,138 @@ class PagedDecodeScheduler(DecodeScheduler):
             toks = np.asarray(toks)
         self._absorb(lanes, toks, kind="decode",
                      seconds=time.perf_counter() - t0, rung=(b_rung, t_rung))
+
+    def _spec_round(self) -> None:
+        """One self-speculation round (ISSUE 20): ONE draft dispatch
+        proposes k tokens per lane through the truncated-layer program,
+        ONE verify dispatch scores all k+1 positions with the full
+        model, then the host commits each lane's longest accepted prefix
+        plus the verify pass's own next token — ≥ 1 token per round,
+        up to k+1, always bitwise the tokens the plain decode loop
+        would have produced. Pages grown for the speculative suffix
+        roll back through the pool free-list in ``_absorb_spec``."""
+        from ..jit.bucketing import bucket_for
+        from ..observability.tracing import tracer
+
+        k = self.speculate_k
+        lanes = sorted(self._active.values(), key=lambda r: r.id)
+        lanes = self._ensure_pages(lanes, lookahead=k)
+        if not lanes:
+            return
+        self._step_lanes = list(lanes)  # the fault wall's blast radius
+        b_rung = bucket_for(len(lanes), self.programs.decode_rungs)
+        t_rung = bucket_for(max(len(r.pages) for r in lanes),
+                            self.programs.table_rungs)
+        tokens = np.zeros(b_rung, np.int32)
+        tables = np.zeros((b_rung, t_rung), np.int32)  # 0 = pad page
+        positions = np.zeros(b_rung, np.int32)
+        for i, r in enumerate(lanes):
+            tokens[i] = r.generated[-1]
+            tables[i, :len(r.pages)] = r.pages
+            positions[i] = r.position
+        sample = self._sample_args(lanes, b_rung)
+        with tracer.span("serving.decode", track="serving.scheduler",
+                         kind="speculate", rung=(b_rung, t_rung),
+                         lanes=len(lanes), k=k):
+            t0 = time.perf_counter()
+            ck, cv, drafts = self._program_call(lambda: self.programs.draft(
+                self.pool.k, self.pool.v, tokens, tables, positions,
+                *sample))
+            self.pool.commit(ck, cv)
+            drafts = np.asarray(drafts)       # [b_rung, k] proposals
+            t_draft = time.perf_counter() - t0
+            vin = np.zeros((b_rung, k + 1), np.int32)
+            vin[:, 0] = tokens                # last committed token at p
+            vin[:, 1:] = drafts               # proposals at p+1..p+k
+            t1 = time.perf_counter()
+            ck, cv, vtoks = self._program_call(lambda: self.programs.verify(
+                self.pool.k, self.pool.v, vin, tables, positions, *sample))
+            self.pool.commit(ck, cv)
+            vtoks = np.asarray(vtoks)         # [b_rung, k+1] true tokens
+            t_verify = time.perf_counter() - t1
+        self._absorb_spec(lanes, drafts, vtoks, t_draft=t_draft,
+                          t_verify=t_verify, rung=(b_rung, t_rung))
+
+    def _absorb_spec(self, lanes, drafts, vtoks, *, t_draft: float,
+                     t_verify: float, rung) -> None:
+        """Acceptance + commit + rollback for one speculation round.
+        Lane i's accepted prefix length m is the longest run of draft
+        proposals the verify pass reproduced; verify tokens 0..m commit
+        (the tokens the plain loop would emit, in order, under the same
+        per-index sampling keys), stopping early at eos/max_new/max_seq
+        exactly like ``_absorb``. Block-table pages past the new write
+        position — grown for the speculative suffix — release back to
+        the free-list: the rollback contract."""
+        self._step_lanes = []  # the calls succeeded: nothing to fail
+        if self.breakers is not None:
+            for tenant in {r.tenant for r in lanes}:
+                self.breakers.record_success(tenant)
+        k = self.speculate_k
+        proposed = accepted = committed = 0
+        for i, r in enumerate(lanes):
+            m = 0
+            while m < k and int(drafts[i, m]) == int(vtoks[i, m]):
+                m += 1
+            r.spec_proposed += k
+            r.spec_accepted += m
+            proposed += k
+            accepted += m
+            done = False
+            for j in range(m + 1):
+                tok = int(vtoks[i, j])
+                r.generated.append(tok)
+                committed += 1
+                done = (len(r.generated) >= r.max_new_tokens
+                        or (self.eos_id is not None and tok == self.eos_id)
+                        or r.position >= self.max_seq)
+                if done:
+                    break
+            # rolling-acceptance lane policy: once a request has seen a
+            # fair window (two full rounds' worth of proposals) and its
+            # acceptance rate sits under the floor, drafting for it costs
+            # more than it saves — the lane opts itself out; the batch
+            # falls back to plain decode when every lane has
+            if (r.spec_live and r.spec_proposed >= 2 * k
+                    and r.spec_accepted
+                    < self.spec_min_accept * r.spec_proposed):
+                r.spec_live = False
+            if done:
+                self._retire(r)
+            else:
+                keep = int(r.position) // self.pool.page_size + 1
+                if len(r.pages) > keep:  # speculative-suffix rollback
+                    self.pool.release(r.pages[keep:])
+                    del r.pages[keep:]
+                self._active[r.id] = r
+        live_tokens = sum(int(r.prompt.size) + len(r.generated)
+                          for r in self._active.values())
+        self.pool.note_utilization(live_tokens)
+        if self.stats is not None:
+            self.stats.record_decode_step("draft", t_draft, len(lanes), 0)
+            self.stats.record_decode_step("verify", t_verify, len(lanes),
+                                          committed)
+            self.stats.record_spec_round(proposed, accepted, committed)
+            self.stats.record_slot_occupancy(self.active_count(),
+                                             self.max_lanes)
+        try:
+            from ..observability.metrics import registry
+
+            registry.counter(
+                "serving.spec_rounds",
+                "self-speculation rounds (one draft + one verify "
+                "dispatch each) run by the decode scheduler").inc()
+            registry.counter(
+                "serving.spec_tokens_proposed",
+                "draft tokens proposed by self-speculation "
+                "rounds").inc(proposed)
+            registry.counter(
+                "serving.spec_tokens_accepted",
+                "draft tokens the full-model verify pass accepted "
+                "(the rest rolled back)").inc(accepted)
+        except Exception:
+            pass
+        if self.on_step is not None:
+            self.on_step("speculate", len(lanes), rung, committed)
 
     def _absorb(self, lanes, toks, *, kind: str, seconds: float,
                 rung) -> None:

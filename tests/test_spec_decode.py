@@ -1,0 +1,315 @@
+"""Self-speculative decoding tests (ISSUE 20): the draft/verify tier
+over the paged KV pool — greedy bit-exactness vs the non-speculative
+stream (solo and batched lanes, k ∈ {2,4,8}, including a prompt that
+decodes into the max_seq boundary), sampled-mode per-seed determinism
+with speculation on, the acceptance auto-disable threshold, zero
+retraces under spec on/off churn and per-request opt-out, mid-flight
+weight hot-swap across both parameter tiers, the JX335 rung-parity
+audit and the spec-rollback chaos scenario.
+
+Engine economy: the suite shares ONE plain reference engine and ONE
+k=4 speculative engine (module fixtures, a deliberately small rung
+grid — 2 batch × 3 table rungs, 2 seq buckets); only the k ∈ {2,8}
+matrix arms build their own short-lived engines.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.profiler.pipeline import ServingStats
+
+
+def _model2(seed=0):
+    """Two transformer blocks so the 1-layer draft is a REAL truncation
+    (a 1-layer model's draft degenerates to the full stack)."""
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(seed)
+    model = GPTForCausalLM(gpt_tiny(vocab_size=128, num_hidden_layers=2,
+                                    hidden_size=8, num_attention_heads=1,
+                                    max_position_embeddings=128))
+    model.eval()
+    return model
+
+
+COMMON = dict(max_slots=2, max_seq=128, seq_buckets=[32, 128],
+              prefill_max_batch=2, page_size=32, kv_mode="paged")
+
+# mixed table rungs; 120+8 decodes INTO the max_seq boundary, so the
+# k-token lookahead past position 127 exercises the clamped draft path
+SIZES = [20, 60, 120, 31]
+
+
+def _prompts(sizes, seed=3):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 128, size=int(n)).astype(np.int32)
+            for n in sizes]
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _model2()
+
+
+@pytest.fixture(scope="module")
+def plain(model):
+    """The non-speculative paged engine: the token-stream ground truth."""
+    eng = serving.DecodeEngine(model, stats=ServingStats(),
+                               **COMMON).warmup()
+    yield eng
+    eng.shutdown(drain=True)
+
+
+@pytest.fixture(scope="module")
+def spec(model):
+    eng = serving.DecodeEngine(model, speculate_k=4, spec_draft_layers=1,
+                               spec_min_accept=0.0, stats=ServingStats(),
+                               **COMMON).warmup()
+    yield eng
+    eng.shutdown(drain=True)
+
+
+@pytest.fixture(scope="module")
+def refs(plain):
+    return [plain.generate("ref", p, max_new_tokens=8)
+            for p in _prompts(SIZES)]
+
+
+def _decode_cell(eng):
+    return dict(eng.stats.summary()["decode"] or {})
+
+
+# ------------------------------------------------- bit-exactness matrix
+class TestBitExactMatrix:
+    def test_solo_bit_exact_k4(self, spec, refs):
+        """The contract: committed tokens always come from the verify
+        pass, so speculation NEVER changes the stream — only how many
+        tokens commit per full-model call."""
+        for p, ref in zip(_prompts(SIZES), refs):
+            assert np.array_equal(spec.generate("solo", p,
+                                                max_new_tokens=8), ref)
+
+    def test_batched_bit_exact_k4(self, spec, refs):
+        futs = [spec.submit("bat", p, max_new_tokens=8)
+                for p in _prompts(SIZES)]
+        for f, ref in zip(futs, refs):
+            assert np.array_equal(f.result(60), ref)
+
+    @pytest.mark.parametrize("k", [2, 8])
+    def test_k_matrix_bit_exact(self, model, refs, k):
+        """k=4 lives in the shared engine; the k∈{2,8} arms build their
+        own (same-config) engines so the whole {2,4,8} matrix rides the
+        one reference stream."""
+        eng = serving.DecodeEngine(model, speculate_k=k,
+                                   spec_draft_layers=1, spec_min_accept=0.0,
+                                   stats=ServingStats(), **COMMON).warmup()
+        try:
+            prompts = _prompts(SIZES)
+            solo = [eng.generate("m", prompts[0], max_new_tokens=8)]
+            futs = [eng.submit("m", p, max_new_tokens=8)
+                    for p in prompts]
+            assert np.array_equal(solo[0], refs[0])
+            for f, ref in zip(futs, refs):
+                assert np.array_equal(f.result(60), ref)
+            assert eng.serving_report()["compiles_after_warmup"] == 0
+            assert eng.kv_pool.in_use() == 0
+        finally:
+            eng.shutdown(drain=True)
+
+    def test_sampled_per_seed_deterministic_and_matches_nonspec(
+            self, plain, spec):
+        """Verify samples with the SAME shifted key index the plain
+        stream would use at each position — a sampled stream is
+        bit-identical with speculation on, and repeatable per seed."""
+        prompt = _prompts([60], seed=21)[0]
+        kw = dict(max_new_tokens=10, temperature=0.8, top_k=20, seed=42)
+        ref = plain.submit("s", prompt, **kw).result(60)
+        a = spec.submit("s", prompt, **kw).result(60)
+        b = spec.submit("s", prompt, **kw).result(60)
+        assert np.array_equal(a, ref)
+        assert np.array_equal(a, b)
+        c = spec.submit("s", prompt, max_new_tokens=10, temperature=0.8,
+                        top_k=20, seed=43).result(60)
+        assert not np.array_equal(a, c)  # seeds still decorrelate
+
+
+# ------------------------------------------------------ lane policy
+class TestSpecPolicy:
+    def test_spec_rounds_replace_plain_steps(self, spec):
+        """With a healthy draft every token commits through draft+verify
+        rounds: zero plain decode steps, and more than one token lands
+        per full-model (verify) pass — the speedup's origin."""
+        before = _decode_cell(spec)
+        req = spec.submit("net", _prompts([40], seed=5)[0],
+                          max_new_tokens=12)
+        assert len(req.result(60)) == 12
+        after = _decode_cell(spec)
+        assert after.get("decode_steps", 0) == before.get("decode_steps", 0)
+        assert after.get("spec_rounds", 0) > before.get("spec_rounds", 0)
+        assert req.spec_live is True
+        assert req.spec_proposed > 0
+        assert after["spec_net_tokens_per_full_pass"] > 1.0
+
+    def test_auto_disable_below_min_accept(self, spec):
+        """An unreachable acceptance floor trips the per-request lane
+        policy after the 2k-proposal window: the lane leaves speculation
+        and finishes on plain decode steps."""
+        sched = spec._scheduler
+        old = sched.spec_min_accept
+        sched.spec_min_accept = 1.01  # acceptance can never reach this
+        try:
+            before = _decode_cell(spec)
+            req = spec.submit("dis", _prompts([24], seed=6)[0],
+                              max_new_tokens=20)
+            assert len(req.result(60)) == 20
+        finally:
+            sched.spec_min_accept = old
+        after = _decode_cell(spec)
+        assert req.spec_live is False
+        assert req.spec_proposed >= 2 * spec.speculate_k
+        # the post-disable tail decoded plain
+        assert after.get("decode_steps", 0) > before.get("decode_steps", 0)
+
+    def test_speculate_true_on_plain_engine_refused(self, plain):
+        with pytest.raises(ValueError, match="speculate_k"):
+            plain.submit("x", _prompts([8])[0], max_new_tokens=2,
+                         speculate=True)
+
+    def test_slots_engine_refuses_speculation(self, model):
+        with pytest.raises(ValueError, match="paged"):
+            serving.DecodeEngine(model, kv_mode="slots", speculate_k=2,
+                                 max_slots=2, max_seq=128,
+                                 seq_buckets=[32, 128],
+                                 stats=ServingStats())
+
+    def test_report_surfaces_spec_keys(self, spec):
+        rep = spec.serving_report()
+        assert rep["speculate_k"] == 4
+        assert rep["spec_draft_layers"] == 1
+        assert rep["spec_enabled"] is True
+
+
+# ------------------------------------------------- on/off churn
+class TestSpecChurn:
+    def test_toggle_and_optout_zero_retrace(self, spec, refs):
+        """Flipping speculation mid-flight — the master toggle AND the
+        per-request opt-out — replays warmed executables only: both
+        program families joined the rung grid at warmup."""
+        prompts = _prompts(SIZES)
+        assert spec.set_speculation(False) is True
+        try:
+            before = _decode_cell(spec)
+            assert np.array_equal(
+                spec.generate("ch", prompts[0], max_new_tokens=8), refs[0])
+            after = _decode_cell(spec)
+            # disabled ⇒ the plain decode path served it
+            assert after.get("decode_steps", 0) > before.get(
+                "decode_steps", 0)
+        finally:
+            assert spec.set_speculation(True) is False
+        assert np.array_equal(
+            spec.generate("ch", prompts[1], max_new_tokens=8), refs[1])
+        # mixed batch: one opted-out lane rides the verify pass of the
+        # speculating batch and still gets the identical stream
+        futs = [spec.submit("ch", prompts[2], max_new_tokens=8,
+                            speculate=False),
+                spec.submit("ch", prompts[3], max_new_tokens=8)]
+        assert np.array_equal(futs[0].result(60), refs[2])
+        assert np.array_equal(futs[1].result(60), refs[3])
+        # a SOLO opted-out lane falls back to plain decode entirely
+        before = _decode_cell(spec)
+        assert np.array_equal(
+            spec.submit("ch", prompts[0], max_new_tokens=8,
+                        speculate=False).result(60), refs[0])
+        after = _decode_cell(spec)
+        assert after.get("decode_steps", 0) > before.get("decode_steps", 0)
+        assert spec.serving_report()["compiles_after_warmup"] == 0
+
+
+# ------------------------------------------------- weight hot swap
+class TestHotSwapDraftTier:
+    def test_swap_flips_both_tiers_mid_speculation(self, spec, model,
+                                                   refs):
+        """ISSUE 20 satellite: ``swap_weights`` must flip the base AND
+        the truncated-layer draft view under one lock — a draft program
+        can never keep attending with pre-swap weights."""
+        import jax
+
+        twin = _model2(seed=1)
+        futs = [spec.submit("sw", p, max_new_tokens=16)
+                for p in _prompts([60, 20], seed=7)]
+        spec.swap_weights(twin)  # lands between rounds, lanes live
+        assert [len(f.result(60)) for f in futs] == [16, 16]
+        progs = spec.programs
+        base_leaves = jax.tree_util.tree_leaves(
+            progs.params["blocks"][:progs.draft_layers])
+        draft_leaves = jax.tree_util.tree_leaves(
+            progs.draft_params["blocks"])
+        assert len(base_leaves) == len(draft_leaves)
+        for b, d in zip(base_leaves, draft_leaves):
+            assert b is d  # zero-copy view, post-swap identity
+        assert spec.serving_report()["compiles_after_warmup"] == 0
+        # swap back: the original stream returns bit-exact
+        spec.swap_weights(model)
+        assert np.array_equal(
+            spec.generate("sw", _prompts(SIZES)[0], max_new_tokens=8),
+            refs[0])
+
+
+# ------------------------------------------------- JX335 rung parity
+class TestJX335RungParity:
+    class _Duck:
+        """audit_serving duck-type: counters + a program set whose
+        draft/verify families cover (or fail to cover) the decode grid."""
+        compiles_after_warmup = 0
+
+        class programs:
+            speculate_k = 2
+            warmed = None
+            rungs = ()
+
+    def test_seeded_parity_hole_fires(self):
+        from paddle_tpu.analysis.jaxpr_audit import audit_serving
+
+        duck = self._Duck()
+        duck.programs.warmed = [("decode", 1, 1), ("decode", 2, 1),
+                                ("draft", 1, 1), ("draft", 2, 1),
+                                ("verify", 1, 1)]  # (2,1) verify missing
+        findings = [f for f in audit_serving(duck) if f.code == "JX335"]
+        assert len(findings) == 1
+        assert findings[0].severity == "warning"
+        assert "(2, 1)" in findings[0].message
+        assert "parity" in findings[0].message
+
+    def test_full_parity_clean(self):
+        from paddle_tpu.analysis.jaxpr_audit import audit_serving
+
+        duck = self._Duck()
+        duck.programs.warmed = [(kind, b, 1) for kind in
+                                ("decode", "draft", "verify")
+                                for b in (1, 2)]
+        assert [f for f in audit_serving(duck)
+                if f.code == "JX335"] == []
+
+    def test_live_spec_engine_audit_clean(self, spec):
+        from paddle_tpu.analysis.jaxpr_audit import audit_serving
+
+        spec.generate("audit", _prompts([31], seed=9)[0],
+                      max_new_tokens=4)
+        assert audit_serving(spec) == []
+
+
+# ------------------------------------------------- chaos regression
+class TestChaosSpecRollback:
+    def test_scenario_spec_rollback_green(self):
+        from tools.chaos import scenario_spec_rollback
+
+        out = scenario_spec_rollback(0)
+        assert out["ok"] is True, out
+        assert out["bit_exact_vs_nonspec"] is True
+        assert out["spec_rounds"] > 0
+        assert out["shed_admission_error"] > 0
+        assert out["kv_pages_leaked"] == 0
+        assert out["injected"] > 0
+        assert out["compiles_after_warmup"] == 0

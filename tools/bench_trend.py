@@ -47,13 +47,19 @@ DEFAULT_METRIC = "gpt_tiny_train_tokens_per_sec_cpu"
 # snapshot path changed) and the mid-traffic weight-hot-swap latency
 # spike (bench extras.swap, ISSUE 15) and the paged-KV pool's live-token
 # share of allocated page bytes (bench extras.serving, ISSUE 18 —
-# higher means less fragmentation stranding HBM); each gates only once
-# two rounds carry it
+# higher means less fragmentation stranding HBM) and the
+# self-speculative decode arm's draft acceptance rate and net decode
+# delivery rate (bench extras.serving, ISSUE 20 — both higher-is-better:
+# a falling acceptance rate means the draft stopped predicting the full
+# model and every round pays its verify for nothing); each gates only
+# once two rounds carry it
 DEFAULT_EXTRAS = ("coldstart.train_warm_speedup_x",
                   "comm.allreduce_bytes_saved_ratio",
                   "zero1.opt_state_bytes_ratio",
                   "serving.decode_tokens_per_sec",
                   "serving.kv_pool_utilization",
+                  "serving.spec_accept_rate",
+                  "serving.spec_net_tokens_per_sec",
                   "resilience.recovery_steps",
                   "swap.pause_ms_p99")
 

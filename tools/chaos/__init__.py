@@ -318,6 +318,85 @@ def scenario_page_pressure(seed: int) -> dict:
             "compiles_after_warmup": engine.compiles_after_warmup}
 
 
+def scenario_spec_rollback(seed: int) -> dict:
+    """Self-speculative decoding under page-allocation faults (ISSUE
+    20): seeded ``kv.page_alloc`` failures land mid-speculation — while
+    lanes grow lookahead pages for the draft/verify round — and the
+    contract holds anyway: zero pages leak (speculative-suffix rollback
+    plus the shed path both drain through the free-list), every
+    COMPLETED greedy stream is token-for-token the non-speculative
+    stream, the serving audit stays clean and nothing retraces."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import reliability as rel
+    from paddle_tpu.analysis.jaxpr_audit import audit_serving
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.profiler.pipeline import ServingStats
+    from paddle_tpu.serving import AdmissionError, DecodeEngine
+
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_tiny(
+        num_hidden_layers=2, hidden_size=32, num_attention_heads=2,
+        max_position_embeddings=32))
+    model.eval()
+    kw = dict(kv_mode="paged", max_slots=3, max_seq=32,
+              seq_buckets=[8, 16], prefill_max_batch=2, page_size=8)
+    rs = np.random.RandomState(seed)
+    cases = [(t, rs.randint(0, 512, size=n).astype(np.int32))
+             for t, n in (("a", 4), ("b", 9), ("a", 3), ("b", 12),
+                          ("a", 6), ("b", 5), ("a", 10), ("b", 7))]
+    # the non-speculative reference streams, faults disarmed
+    ref_engine = DecodeEngine(model, stats=ServingStats(), **kw)
+    ref_engine.warmup()
+    ref = [np.asarray(ref_engine.generate(t, p, max_new_tokens=8))
+           for t, p in cases]
+    ref_engine.shutdown(drain=True)
+
+    engine = DecodeEngine(model, speculate_k=4, spec_draft_layers=1,
+                          spec_min_accept=0.0, stats=ServingStats(), **kw)
+    engine.warmup()
+    inj = rel.arm(rel.FaultInjector(seed=seed)
+                  .plan("kv.page_alloc", rate=0.25))
+    outs = [None] * len(cases)
+    completed = shed = other = 0
+    try:
+        reqs = [engine.submit(t, p, max_new_tokens=8) for t, p in cases]
+        for i, r in enumerate(reqs):
+            try:
+                outs[i] = np.asarray(r.result(60))
+                completed += 1
+            except AdmissionError as e:
+                assert e.reason == "kv_pages", e.reason
+                shed += 1
+            except Exception:
+                other += 1
+    finally:
+        rel.disarm()
+    engine.shutdown(drain=True)
+    findings = [str(f) for f in audit_serving(engine)]
+    pages_leaked = engine.kv_pool.in_use()
+    summary = inj.summary()
+    exact = all(o is None or np.array_equal(o, r)
+                for o, r in zip(outs, ref))
+    spec_rounds = (engine.stats.summary()["decode"] or {}).get(
+        "spec_rounds", 0)
+    ok = (completed + shed == len(cases) and other == 0 and shed > 0
+          and completed > 0 and exact and spec_rounds > 0
+          and pages_leaked == 0 and not findings
+          and summary["total_injected"] > 0
+          and engine.compiles_after_warmup == 0)
+    return {"ok": bool(ok), "requests": len(cases), "completed": completed,
+            "shed_admission_error": shed, "other_failures": other,
+            "bit_exact_vs_nonspec": bool(exact),
+            "spec_rounds": spec_rounds,
+            "kv_pages_leaked": pages_leaked,
+            "audit_findings": findings,
+            "injected": summary["total_injected"],
+            "injected_by_site": summary["by_site"],
+            "compiles_after_warmup": engine.compiles_after_warmup}
+
+
 def scenario_prefetch_crash(seed: int) -> dict:
     """A killed prefetch thread must fail fit, not deadlock it."""
     import numpy as np
@@ -535,6 +614,7 @@ _SCENARIOS = (
     ("serving_retry", scenario_serving_retry),
     ("decode_faults", scenario_decode_faults),
     ("page_pressure", scenario_page_pressure),
+    ("spec_rollback", scenario_spec_rollback),
     ("prefetch_crash", scenario_prefetch_crash),
     ("cache_corruption", scenario_cache_corruption),
     ("ckpt_torn_write", scenario_ckpt_torn_write),
